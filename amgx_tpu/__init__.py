@@ -1,0 +1,68 @@
+"""amgx_tpu — a TPU-native algebraic-multigrid solver framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capability surface of
+NVIDIA AmgX (reference: mattmartineau/AMGX): Classical Ruge-Stuben and
+Unsmoothed-Aggregation AMG, standalone or preconditioning CG / BiCGSTAB /
+GMRES / FGMRES / IDR, over scalar or small-block CSR matrices, in
+fp32/fp64/mixed precision, on one TPU core or a multi-chip mesh via
+jax.sharding + XLA collectives.
+
+Quick start::
+
+    import amgx_tpu as amgx
+    amgx.initialize()
+    A = amgx.gallery.poisson("7pt", 32, 32, 32)
+    cfg = amgx.Config.from_file("configs/FGMRES_AGGREGATION.json")
+    slv = amgx.create_solver(cfg)
+    slv.setup(A)
+    sol = slv.solve(b)
+"""
+from __future__ import annotations
+
+import jax
+
+# double precision is the reference's default mode (dDDI); enable x64 so
+# float64 vectors/matrices work (TPU executes f64 via emulation, CPU natively)
+jax.config.update("jax_enable_x64", True)
+
+from . import config as _config_mod  # noqa: E402
+from . import errors, modes, registry, gallery  # noqa: E402,F401
+from .config import Config, AMG_Config  # noqa: E402,F401
+from .matrix import CsrMatrix  # noqa: E402,F401
+from .errors import RC, AMGXError  # noqa: E402,F401
+from . import ops  # noqa: E402,F401
+
+_initialized = False
+
+
+def initialize():
+    """AMGX_initialize analog (src/amgx_c.cu:2360 -> src/core.cu:723):
+    imports all pluggable components so they self-register into the
+    factories. Safe to call more than once."""
+    global _initialized
+    if _initialized:
+        return
+    from . import solvers  # noqa: F401  (registers solvers + convergence)
+    from . import amg  # noqa: F401      (registers levels/cycles/selectors)
+    from . import eigen  # noqa: F401    (registers eigensolvers)
+    from . import io  # noqa: F401       (registers readers/writers)
+    from . import scalers  # noqa: F401  (registers scalers)
+    _initialized = True
+
+
+def finalize():
+    global _initialized
+    _initialized = False
+
+
+def create_solver(cfg: Config, scope: str = "default"):
+    """Build the root solver tree from a config (AMG_Solver analog)."""
+    initialize()
+    from .solvers.base import make_solver
+    name, child_scope = cfg.get_solver("solver", scope)
+    return make_solver(name, cfg, child_scope)
+
+
+__version__ = "0.1.0"
+# API-parity version info (AMGX_get_api_version)
+API_VERSION = (2, 0)

@@ -1,0 +1,135 @@
+"""Matrix gallery: Poisson stencils and random matrices.
+
+Analog of the bundled CUSP gallery the reference uses as its test-fixture
+backbone (include/cusp/gallery/poisson.h:55-99 — poisson5pt/7pt/9pt/27pt,
+used by e.g. src/tests/fgmres_convergence_poisson.cu:33-52) and of the
+random CSR generators in include/test_utils.h:541-701. Structure assembly
+is host-side numpy (it is a fixture generator, not a solve-path kernel);
+the returned matrices live on device.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .errors import BadParametersError
+from .matrix import CsrMatrix
+
+# stencil offsets (dx, dy, dz, coefficient-sign slot filled below)
+_STENCILS = {
+    "5pt": [(0, 0, 0), (-1, 0, 0), (1, 0, 0), (0, -1, 0), (0, 1, 0)],
+    "7pt": [(0, 0, 0), (-1, 0, 0), (1, 0, 0), (0, -1, 0), (0, 1, 0),
+            (0, 0, -1), (0, 0, 1)],
+    "9pt": [(dx, dy, 0) for dy in (-1, 0, 1) for dx in (-1, 0, 1)],
+    "27pt": [(dx, dy, dz) for dz in (-1, 0, 1) for dy in (-1, 0, 1)
+             for dx in (-1, 0, 1)],
+}
+
+
+def poisson(points: str, nx: int, ny: int = 1, nz: int = 1,
+            dtype=np.float64) -> CsrMatrix:
+    """Finite-difference Poisson matrix on a regular grid with Dirichlet
+    boundaries. `points` in {'5pt','7pt','9pt','27pt'}; diagonal equals the
+    stencil size minus one, off-diagonals are -1 (matches
+    cusp::gallery::poisson semantics)."""
+    if points not in _STENCILS:
+        raise BadParametersError(f"unknown poisson stencil {points!r}")
+    offsets = _STENCILS[points]
+    n = nx * ny * nz
+    ix, iy, iz = np.meshgrid(np.arange(nx), np.arange(ny), np.arange(nz),
+                             indexing="ij")
+    # row index with x fastest (matches a natural lexicographic ordering)
+    idx = (iz * ny + iy) * nx + ix
+    rows_l, cols_l, vals_l = [], [], []
+    diag_val = float(len(offsets) - 1)
+    for (dx, dy, dz) in offsets:
+        jx, jy, jz = ix + dx, iy + dy, iz + dz
+        mask = ((jx >= 0) & (jx < nx) & (jy >= 0) & (jy < ny)
+                & (jz >= 0) & (jz < nz))
+        val = diag_val if (dx, dy, dz) == (0, 0, 0) else -1.0
+        rows_l.append(idx[mask].ravel())
+        cols_l.append(((jz * ny + jy) * nx + jx)[mask].ravel())
+        vals_l.append(np.full(mask.sum(), val, dtype=dtype))
+    rows = np.concatenate(rows_l)
+    cols = np.concatenate(cols_l)
+    vals = np.concatenate(vals_l)
+    order = np.lexsort((cols, rows))
+    rows, cols, vals = rows[order], cols[order], vals[order]
+    counts = np.bincount(rows, minlength=n)
+    row_offsets = np.zeros(n + 1, np.int32)
+    np.cumsum(counts, out=row_offsets[1:])
+    return CsrMatrix.from_scipy_like(row_offsets, cols.astype(np.int32),
+                                     jnp.asarray(vals), n, n)
+
+
+def poisson5pt(nx, ny, dtype=np.float64):
+    return poisson("5pt", nx, ny, 1, dtype)
+
+
+def poisson7pt(nx, ny, nz, dtype=np.float64):
+    return poisson("7pt", nx, ny, nz, dtype)
+
+
+def poisson9pt(nx, ny, dtype=np.float64):
+    return poisson("9pt", nx, ny, 1, dtype)
+
+
+def poisson27pt(nx, ny, nz, dtype=np.float64):
+    return poisson("27pt", nx, ny, nz, dtype)
+
+
+def random_matrix(n: int, max_nnz_per_row: int = 8, seed: int = 0,
+                  symmetric: bool = False, diag_dominant: bool = True,
+                  block_dims=(1, 1), dtype=np.float64) -> CsrMatrix:
+    """Random sparse matrix with guaranteed diagonal, optionally symmetric
+    and diagonally dominant (generateMatrixRandomStruct analog,
+    include/test_utils.h:541-701)."""
+    rng = np.random.default_rng(seed)
+    rows_l, cols_l = [np.arange(n)], [np.arange(n)]       # diagonal first
+    for i in range(n):
+        k = rng.integers(0, max_nnz_per_row)
+        if k:
+            c = rng.choice(n, size=min(k, n), replace=False)
+            c = c[c != i]
+            rows_l.append(np.full(c.size, i))
+            cols_l.append(c)
+    rows = np.concatenate(rows_l)
+    cols = np.concatenate(cols_l)
+    if symmetric:
+        rows, cols = np.concatenate([rows, cols]), np.concatenate([cols, rows])
+    key = rows.astype(np.int64) * n + cols
+    _, uniq = np.unique(key, return_index=True)
+    rows, cols = rows[uniq], cols[uniq]
+    bx, by = block_dims
+    if bx * by > 1:
+        vals = rng.standard_normal((rows.size, bx, by)).astype(dtype)
+    else:
+        vals = rng.standard_normal(rows.size).astype(dtype)
+    if symmetric:
+        # symmetrize: average entry (i,j) with (j,i) — blocks must also be
+        # transposed so that block(i,j) == block(j,i)^T
+        order = np.lexsort((cols, rows))
+        order_t = np.lexsort((rows, cols))
+        vt = vals[order_t]
+        if bx * by > 1:
+            vt = np.swapaxes(vt, -1, -2)
+        vals = 0.5 * (vals[order] + vt)
+        rows, cols = rows[order], cols[order]
+    if diag_dominant:
+        abssum = np.zeros(n, dtype)
+        flat = np.abs(vals).reshape(vals.shape[0], -1).sum(-1)
+        np.add.at(abssum, rows, flat)
+        is_diag = rows == cols
+        if bx * by > 1:
+            eye = np.eye(bx, by, dtype=dtype)
+            vals[is_diag] = (abssum[rows[is_diag], None, None] + 1.0) * eye
+        else:
+            vals[is_diag] = abssum[rows[is_diag]] + 1.0
+    order = np.lexsort((cols, rows))
+    rows, cols, vals = rows[order], cols[order], vals[order]
+    counts = np.bincount(rows, minlength=n)
+    row_offsets = np.zeros(n + 1, np.int32)
+    np.cumsum(counts, out=row_offsets[1:])
+    return CsrMatrix.from_scipy_like(row_offsets, cols.astype(np.int32),
+                                     jnp.asarray(vals), n, n,
+                                     block_dims=block_dims)

@@ -1,0 +1,273 @@
+"""Core sparse-matrix container.
+
+TPU-native analog of the reference Matrix/MatrixBase (include/matrix.h:65,
+src/matrix.cu): a block-CSR container held as a JAX pytree so it can flow
+through jit/shard_map. Differences from the reference, by design:
+
+- no explicit memory spaces (XLA owns placement);
+- "initialization" precomputes static gather/scatter auxiliaries
+  (per-nnz row ids, diagonal indices, padded-ELL layout) instead of
+  launching setup kernels — these are what make SpMV / smoothers map onto
+  the TPU vector units as dense gathers + segmented reductions;
+- the DIAG property (externally stored diagonal, include/matrix.h:24-26)
+  is the `diag` field being non-None.
+
+Shapes are static: one compiled program per (num_rows, nnz, block) bucket,
+matching XLA's compilation model.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .errors import BadParametersError
+
+Array = jax.Array
+
+
+def _seg_sum(data, seg_ids, num_segments):
+    return jax.ops.segment_sum(data, seg_ids, num_segments=num_segments,
+                               indices_are_sorted=True)
+
+
+@functools.partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["row_offsets", "col_indices", "values", "diag",
+                 "row_ids", "diag_idx", "ell_cols", "ell_vals"],
+    meta_fields=["num_rows", "num_cols", "block_dimx", "block_dimy",
+                 "initialized"],
+)
+@dataclasses.dataclass(frozen=True)
+class CsrMatrix:
+    """Block-CSR matrix. `values` is (nnz,) for scalar matrices or
+    (nnz, block_dimx, block_dimy) for block matrices. When `diag` is not
+    None the diagonal blocks are stored externally (DIAG property) and
+    `values` holds only off-diagonal entries."""
+
+    row_offsets: Array                 # (n+1,) int32
+    col_indices: Array                 # (nnz,) int32
+    values: Array                      # (nnz,) | (nnz, bx, by)
+    diag: Optional[Array] = None       # (n,) | (n, bx, by) external diagonal
+    # auxiliaries built by .init() (None until then)
+    row_ids: Optional[Array] = None    # (nnz,) row of each entry
+    diag_idx: Optional[Array] = None   # (n,) values-index of diagonal entry
+    ell_cols: Optional[Array] = None   # (n, k) padded column ids
+    ell_vals: Optional[Array] = None   # (n, k) | (n, k, bx, by)
+    num_rows: int = 0
+    num_cols: int = 0
+    block_dimx: int = 1
+    block_dimy: int = 1
+    initialized: bool = False
+
+    # ------------------------------------------------------------------
+    @property
+    def nnz(self) -> int:
+        return self.values.shape[0]
+
+    @property
+    def shape(self):
+        return (self.num_rows, self.num_cols)
+
+    @property
+    def block_size(self) -> int:
+        return self.block_dimx * self.block_dimy
+
+    @property
+    def is_block(self) -> bool:
+        return self.block_size > 1
+
+    @property
+    def has_external_diag(self) -> bool:
+        return self.diag is not None
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    # ------------------------------------------------------------------
+    def init(self, ell: str = "auto", ell_max_ratio: float = 3.0) -> "CsrMatrix":
+        """`set_initialized` analog: precompute SpMV auxiliaries.
+
+        - `row_ids`: per-nnz row index (drives segmented reductions);
+        - `diag_idx`: index of each row's diagonal entry in `values`
+          (or -1) — used by Jacobi/GS/DILU smoothers;
+        - padded ELL layout when the row-length distribution is tight
+          (`ell='auto'`), which turns SpMV into dense gather+reduce, the
+          TPU-friendly execution shape. `ell='never'`/'always' force it.
+        """
+        n = self.num_rows
+        row_nnz = jnp.diff(self.row_offsets)
+        row_ids = jnp.repeat(
+            jnp.arange(n, dtype=jnp.int32), row_nnz,
+            total_repeat_length=self.nnz)
+        if self.has_external_diag:
+            diag_idx = None
+        else:
+            is_diag = (self.col_indices == row_ids)
+            # rows without a stored diagonal keep -1
+            diag_idx = jnp.full((n,), -1, dtype=jnp.int32)
+            diag_idx = diag_idx.at[jnp.where(is_diag, row_ids, n)[
+                ...]].set(jnp.arange(self.nnz, dtype=jnp.int32),
+                          mode="drop")
+        ell_cols = ell_vals = None
+        if n > 0 and ell != "never" and self.nnz > 0:
+            max_k = int(jnp.max(row_nnz))
+            mean = max(float(self.nnz) / max(n, 1), 1e-30)
+            want_ell = (ell == "always") or (
+                ell == "auto" and max_k > 0 and max_k / mean <= ell_max_ratio)
+            if want_ell and max_k > 0:
+                ell_cols, ell_vals = self._build_ell(row_ids, row_nnz, max_k)
+        return dataclasses.replace(
+            self, row_ids=row_ids, diag_idx=diag_idx,
+            ell_cols=ell_cols, ell_vals=ell_vals, initialized=True)
+
+    def _ell_slots(self, row_ids, max_k: int):
+        """Flat scatter targets mapping each CSR entry into (n, max_k)."""
+        pos_in_row = jnp.arange(self.nnz, dtype=jnp.int32) - \
+            self.row_offsets[row_ids]
+        return row_ids * max_k + pos_in_row
+
+    def _scatter_ell_vals(self, flat, max_k: int):
+        n = self.num_rows
+        if self.is_block:
+            bx, by = self.block_dimx, self.block_dimy
+            ev = jnp.zeros((n * max_k, bx, by), self.dtype).at[flat].set(
+                self.values)
+            return ev.reshape(n, max_k, bx, by)
+        ev = jnp.zeros((n * max_k,), self.dtype).at[flat].set(self.values)
+        return ev.reshape(n, max_k)
+
+    def _build_ell(self, row_ids, row_nnz, max_k: int):
+        """Scatter CSR entries into an (n, max_k) padded layout. Padding
+        slots point at column 0 with zero values so gathers stay in-bounds."""
+        n = self.num_rows
+        flat = self._ell_slots(row_ids, max_k)
+        ell_cols = jnp.zeros((n * max_k,), jnp.int32).at[flat].set(
+            self.col_indices)
+        return ell_cols.reshape(n, max_k), self._scatter_ell_vals(flat, max_k)
+
+    # ------------------------------------------------------------------
+    def diagonal(self) -> Array:
+        """Return the diagonal, (n,) scalar or (n, bx, by) block
+        (computeDiagonal analog, src/matrix.cu)."""
+        if self.has_external_diag:
+            return self.diag
+        A = self if self.initialized else self.init(ell="never")
+        safe = jnp.maximum(A.diag_idx, 0)
+        d = A.values[safe]
+        missing = (A.diag_idx < 0)
+        if self.is_block:
+            d = jnp.where(missing[:, None, None], 0.0, d)
+        else:
+            d = jnp.where(missing, 0.0, d)
+        return d
+
+    def to_dense(self) -> Array:
+        """Dense (n*bx, m*by) expansion — test/debug utility."""
+        n, m = self.num_rows, self.num_cols
+        bx, by = self.block_dimx, self.block_dimy
+        row_ids = self.row_ids
+        if row_ids is None:
+            row_nnz = jnp.diff(self.row_offsets)
+            row_ids = jnp.repeat(jnp.arange(n, dtype=jnp.int32), row_nnz,
+                                 total_repeat_length=self.nnz)
+        if self.is_block:
+            dense = jnp.zeros((n, m, bx, by), self.dtype)
+            dense = dense.at[row_ids, self.col_indices].add(self.values)
+            if self.has_external_diag:
+                dense = dense.at[jnp.arange(n), jnp.arange(n)].add(self.diag)
+            return dense.transpose(0, 2, 1, 3).reshape(n * bx, m * by)
+        dense = jnp.zeros((n, m), self.dtype)
+        dense = dense.at[row_ids, self.col_indices].add(self.values)
+        if self.has_external_diag:
+            dense = dense + jnp.diag(self.diag)
+        return dense
+
+    def with_values(self, values: Array, diag: Optional[Array] = None
+                    ) -> "CsrMatrix":
+        """Replace coefficients keeping structure
+        (AMGX_matrix_replace_coefficients analog)."""
+        if values.shape != self.values.shape:
+            raise BadParametersError(
+                f"replace_coefficients: value shape {values.shape} != "
+                f"{self.values.shape}")
+        new_diag = diag if diag is not None else self.diag
+        out = dataclasses.replace(self, values=values, diag=new_diag)
+        if self.initialized and self.ell_cols is not None:
+            # structure auxiliaries (row_ids, diag_idx, ell_cols) survive;
+            # only the padded ELL values depend on the coefficients
+            max_k = self.ell_cols.shape[1]
+            flat = out._ell_slots(self.row_ids, max_k)
+            out = dataclasses.replace(
+                out, ell_vals=out._scatter_ell_vals(flat, max_k))
+        return out
+
+    def interior_exterior_split(self, num_interior: int):
+        """Placeholder for the distributed INTERIOR/OWNED view split
+        (include/matrix.h:82-88); real splitting lives in
+        distributed/dist_matrix.py."""
+        return num_interior
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def from_coo(rows, cols, vals, num_rows: int, num_cols: int,
+                 block_dims=(1, 1), coalesce: bool = True,
+                 diag: Optional[Array] = None) -> "CsrMatrix":
+        """Build CSR from (unsorted) COO triplets; duplicates are summed
+        when `coalesce` (matches the upload semantics of
+        AMGX_matrix_upload_all, src/amgx_c.cu:3039)."""
+        rows = jnp.asarray(rows, jnp.int32)
+        cols = jnp.asarray(cols, jnp.int32)
+        vals = jnp.asarray(vals)
+        bx, by = block_dims
+        key = rows.astype(jnp.int64) * num_cols + cols.astype(jnp.int64)
+        order = jnp.argsort(key, stable=True)
+        rows, cols, vals, key = rows[order], cols[order], vals[order], key[order]
+        if coalesce and rows.shape[0] > 0:
+            newseg = jnp.concatenate(
+                [jnp.ones((1,), bool), key[1:] != key[:-1]])
+            seg = jnp.cumsum(newseg) - 1
+            nuniq = int(seg[-1]) + 1
+            first = jnp.nonzero(newseg, size=nuniq)[0]
+            vals = _seg_sum(vals, seg, nuniq)
+            rows, cols = rows[first], cols[first]
+        counts = jnp.bincount(rows, length=num_rows)
+        row_offsets = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32),
+             jnp.cumsum(counts).astype(jnp.int32)])
+        return CsrMatrix(row_offsets=row_offsets, col_indices=cols,
+                         values=vals, diag=diag, num_rows=num_rows,
+                         num_cols=num_cols, block_dimx=bx, block_dimy=by)
+
+    @staticmethod
+    def from_dense(dense, tol: float = 0.0) -> "CsrMatrix":
+        dense = np.asarray(dense)
+        rows, cols = np.nonzero(np.abs(dense) > tol)
+        return CsrMatrix.from_coo(rows, cols, jnp.asarray(dense[rows, cols]),
+                                  dense.shape[0], dense.shape[1])
+
+    @staticmethod
+    def from_scipy_like(row_offsets, col_indices, values, num_rows, num_cols,
+                        block_dims=(1, 1), diag=None) -> "CsrMatrix":
+        return CsrMatrix(
+            row_offsets=jnp.asarray(row_offsets, jnp.int32),
+            col_indices=jnp.asarray(col_indices, jnp.int32),
+            values=jnp.asarray(values), diag=None if diag is None
+            else jnp.asarray(diag),
+            num_rows=int(num_rows), num_cols=int(num_cols),
+            block_dimx=block_dims[0], block_dimy=block_dims[1])
+
+    def coo(self):
+        """Return (row_ids, col_indices, values) COO triplets. Computes
+        row_ids standalone when uninitialized (no need for the full init)."""
+        if self.row_ids is not None:
+            return self.row_ids, self.col_indices, self.values
+        row_nnz = jnp.diff(self.row_offsets)
+        row_ids = jnp.repeat(jnp.arange(self.num_rows, dtype=jnp.int32),
+                             row_nnz, total_repeat_length=self.nnz)
+        return row_ids, self.col_indices, self.values

@@ -1,0 +1,78 @@
+"""Precision-mode system.
+
+TPU-native analog of the reference TemplateConfig mode system
+(include/amgx_config.h:102-131). The reference explodes every algorithm
+class into explicit template instantiations per mode (dDDI, dFFI, ...);
+here a mode is just a small value object carrying dtypes, and every
+kernel is dtype-polymorphic through JAX tracing -- one implementation,
+compiled per dtype on demand.
+
+Mode string grammar (4 letters, same as the reference):
+  [0] memory space : 'd' (device) | 'h' (host) -- JAX manages placement,
+      kept for API parity only.
+  [1] vector precision : D=float64 F=float32 C=complex64 Z=complex128
+  [2] matrix precision : same alphabet
+  [3] index type : I=int32 (L=int64 accepted)
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .errors import RC, AMGXError
+
+_PREC = {
+    "D": np.float64,
+    "F": np.float32,
+    "C": np.complex64,
+    "Z": np.complex128,
+}
+_IND = {"I": np.int32, "L": np.int64}
+
+
+@dataclasses.dataclass(frozen=True)
+class Mode:
+    """Value analog of TemplateConfig<MemSpace, VecPrec, MatPrec, IndPrec>."""
+
+    name: str
+    mem_space: str          # 'd' or 'h' (informational)
+    vec_dtype: np.dtype
+    mat_dtype: np.dtype
+    ind_dtype: np.dtype
+
+    @property
+    def is_complex(self) -> bool:
+        return np.issubdtype(self.vec_dtype, np.complexfloating)
+
+    @property
+    def real_dtype(self) -> np.dtype:
+        """The real dtype matching vec precision (for norms/tolerances)."""
+        return np.dtype(np.zeros(0, self.vec_dtype).real.dtype)
+
+
+def parse_mode(name: str) -> Mode:
+    """Parse a 4-letter mode string like 'dDDI' (AMGX_mode_dDDI)."""
+    if len(name) != 4 or name[0] not in "dh" or name[1] not in _PREC \
+            or name[2] not in _PREC or name[3] not in _IND:
+        raise AMGXError(f"invalid mode string {name!r}", RC.BAD_MODE)
+    return Mode(
+        name=name,
+        mem_space=name[0],
+        vec_dtype=np.dtype(_PREC[name[1]]),
+        mat_dtype=np.dtype(_PREC[name[2]]),
+        ind_dtype=np.dtype(_IND[name[3]]),
+    )
+
+
+# the ten "real builds" the reference instantiates (AMGX_FORALL_BUILDS,
+# include/amgx_config.h) plus complex builds
+ALL_MODES = tuple(
+    parse_mode(m)
+    for m in (
+        "dDDI", "dDFI", "dFFI", "hDDI", "hDFI", "hFFI",
+        "dCCI", "dZZI", "hCCI", "hZZI",
+    )
+)
+
+DEFAULT_MODE = parse_mode("dDDI")

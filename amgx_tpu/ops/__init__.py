@@ -1,0 +1,7 @@
+from .spmv import spmv, multiply, residual, axmb  # noqa: F401
+from .blas import (  # noqa: F401
+    axpy, axpby, axpbypcz, scal, fill, dot, nrm1, nrm2, nrmmax, norm,
+    get_norm,
+)
+from .transpose import transpose  # noqa: F401
+from .spgemm import csr_multiply, galerkin_rap  # noqa: F401

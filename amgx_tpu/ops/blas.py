@@ -1,0 +1,98 @@
+"""BLAS-1 vector ops and norms.
+
+Analog of src/blas.cu + src/norm.cu (include/blas.h:17-85). On TPU these
+are trivially fused by XLA, so they are plain jnp expressions; the value
+of this module is the distributed contract: every reduction takes an
+optional `axis_name` and finishes with a `psum`/`pmax` so the same code
+runs inside shard_map over a device mesh (the reference finishes its
+device reductions with MPI allreduce, src/distributed/).
+
+Block norms: for block matrices the reference computes one norm per block
+component unless `use_scalar_norm` (src/core.cu:520-524); `norm` mirrors
+that via the `block_size` / `use_scalar_norm` arguments.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def axpy(x, y, a):
+    return a * x + y
+
+
+def axpby(x, y, a, b):
+    return a * x + b * y
+
+
+def axpbypcz(x, y, z, a, b, c):
+    return a * x + b * y + c * z
+
+
+def scal(x, a):
+    return a * x
+
+
+def fill(x, value):
+    return jnp.full_like(x, value)
+
+
+def _psum(v, axis_name):
+    return jax.lax.psum(v, axis_name) if axis_name else v
+
+
+def _pmax(v, axis_name):
+    return jax.lax.pmax(v, axis_name) if axis_name else v
+
+
+def dot(x, y, axis_name: Optional[str] = None, num_owned: Optional[int] = None):
+    """<x, y> (conjugating x for complex); distributed-safe via psum over
+    owned entries only."""
+    if num_owned is not None:
+        x, y = x[:num_owned], y[:num_owned]
+    return _psum(jnp.vdot(x, y), axis_name)
+
+
+def nrm1(x, axis_name: Optional[str] = None, num_owned: Optional[int] = None):
+    if num_owned is not None:
+        x = x[:num_owned]
+    return _psum(jnp.sum(jnp.abs(x)), axis_name)
+
+
+def nrm2(x, axis_name: Optional[str] = None, num_owned: Optional[int] = None):
+    if num_owned is not None:
+        x = x[:num_owned]
+    return jnp.sqrt(_psum(jnp.sum(jnp.abs(x) ** 2), axis_name))
+
+
+def nrmmax(x, axis_name: Optional[str] = None, num_owned: Optional[int] = None):
+    if num_owned is not None:
+        x = x[:num_owned]
+    return _pmax(jnp.max(jnp.abs(x)), axis_name)
+
+
+_NORMS = {"L1": nrm1, "L2": nrm2, "LMAX": nrmmax}
+
+
+def norm(x, norm_type: str = "L2", block_size: int = 1,
+         use_scalar_norm: bool = True, axis_name: Optional[str] = None,
+         num_owned: Optional[int] = None):
+    """Norm of a (flat) vector. With block_size>1 and use_scalar_norm=False
+    returns a (block_size,) per-component norm vector."""
+    fn = _NORMS[norm_type.upper()]
+    if block_size <= 1 or use_scalar_norm:
+        return fn(x, axis_name, num_owned)
+    xb = x.reshape(-1, block_size)
+    if num_owned is not None:
+        xb = xb[:num_owned]
+    if norm_type.upper() == "L1":
+        return _psum(jnp.sum(jnp.abs(xb), axis=0), axis_name)
+    if norm_type.upper() == "L2":
+        return jnp.sqrt(_psum(jnp.sum(jnp.abs(xb) ** 2, axis=0), axis_name))
+    return _pmax(jnp.max(jnp.abs(xb), axis=0), axis_name)
+
+
+def get_norm(norm_type: str):
+    return _NORMS[norm_type.upper()]

@@ -1,0 +1,94 @@
+"""Sparse general matrix-matrix multiply and the Galerkin triple product.
+
+TPU-native analog of CSR_Multiply / csr_galerkin_product
+(include/csr_multiply.h:78-96, src/csr_multiply.cu,
+src/csr_multiply_detail.cu). The reference uses GPU hash tables; hash
+tables do not map onto the TPU vector units, so this implementation is the
+sort-based expand/coalesce formulation:
+
+  expand:   every (i,k,a) of A pairs with every (k,j,b) of row k of B,
+            producing candidate triplets (i, j, a*b) — pure gathers with a
+            repeat-by-row-length index expansion;
+  coalesce: sort candidates by (i,j) and segment-sum duplicates.
+
+This is a *setup-time* operation (Galerkin products happen once per
+hierarchy build); it runs eagerly with concrete shapes so the output nnz
+can be data-dependent, every step dispatching XLA sort/gather/segment
+kernels on device.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..matrix import CsrMatrix
+
+
+def _fold_diag(A: CsrMatrix) -> CsrMatrix:
+    """Fold an externally-stored diagonal (DIAG property) back into the
+    CSR entries so the expand/coalesce formulation sees the full matrix."""
+    if not A.has_external_diag:
+        return A
+    rows, cols, vals = A.coo()
+    n = A.num_rows
+    d_rows = jnp.arange(n, dtype=jnp.int32)
+    return CsrMatrix.from_coo(
+        jnp.concatenate([rows, d_rows]),
+        jnp.concatenate([cols, d_rows]),
+        jnp.concatenate([vals, A.diag]),
+        n, A.num_cols, block_dims=(A.block_dimx, A.block_dimy))
+
+
+def _expand(A: CsrMatrix, B: CsrMatrix):
+    """Candidate COO triplets of A@B (indices only + source pointers)."""
+    a_rows, a_cols, _ = A.coo()
+    b_row_nnz = jnp.diff(B.row_offsets)
+    counts = b_row_nnz[a_cols]                       # per-A-nnz expansion
+    total = int(jnp.sum(counts))
+    cum = jnp.concatenate([jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)])
+    src_a = jnp.repeat(jnp.arange(A.nnz, dtype=jnp.int32), counts,
+                       total_repeat_length=total)
+    offset_in_row = jnp.arange(total, dtype=jnp.int32) - \
+        cum[src_a].astype(jnp.int32)
+    src_b = B.row_offsets[a_cols[src_a]] + offset_in_row
+    out_rows = a_rows[src_a]
+    out_cols = B.col_indices[src_b]
+    return out_rows, out_cols, src_a, src_b
+
+
+def csr_multiply(A: CsrMatrix, B: CsrMatrix) -> CsrMatrix:
+    """C = A @ B for scalar or block CSR (block: bxb @ bxb -> bxb)."""
+    assert A.num_cols == B.num_rows, (A.shape, B.shape)
+    A, B = _fold_diag(A), _fold_diag(B)
+    out_rows, out_cols, src_a, src_b = _expand(A, B)
+    if A.is_block:
+        prods = jnp.einsum("nxk,nky->nxy", A.values[src_a], B.values[src_b])
+    else:
+        prods = A.values[src_a] * B.values[src_b]
+    key = out_rows.astype(jnp.int64) * B.num_cols + out_cols.astype(jnp.int64)
+    order = jnp.argsort(key, stable=True)
+    key, out_rows, out_cols, prods = (key[order], out_rows[order],
+                                      out_cols[order], prods[order])
+    if key.shape[0] == 0:
+        return CsrMatrix.from_scipy_like(
+            jnp.zeros(A.num_rows + 1, jnp.int32), out_cols, prods,
+            A.num_rows, B.num_cols, (A.block_dimx, B.block_dimy))
+    newseg = jnp.concatenate([jnp.ones((1,), bool), key[1:] != key[:-1]])
+    seg = jnp.cumsum(newseg) - 1
+    nuniq = int(seg[-1]) + 1
+    first = jnp.nonzero(newseg, size=nuniq)[0]
+    vals = jax.ops.segment_sum(prods, seg, num_segments=nuniq,
+                               indices_are_sorted=True)
+    rows_u, cols_u = out_rows[first], out_cols[first]
+    counts = jnp.bincount(rows_u, length=A.num_rows)
+    row_offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts).astype(jnp.int32)])
+    return CsrMatrix.from_scipy_like(
+        row_offsets, cols_u, vals, A.num_rows, B.num_cols,
+        (A.block_dimx, B.block_dimy))
+
+
+def galerkin_rap(R: CsrMatrix, A: CsrMatrix, P: CsrMatrix) -> CsrMatrix:
+    """Coarse operator A_c = R @ A @ P (csr_galerkin_product analog,
+    include/csr_multiply.h:96)."""
+    return csr_multiply(csr_multiply(R, A), P)

@@ -1,0 +1,36 @@
+"""CSR transpose (src/transpose.cu analog).
+
+A stable argsort of column indices regroups COO entries by column; counts
+become the transposed row_offsets. Static shapes (nnz preserved), so this
+works both eagerly at setup time and inside jit.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..matrix import CsrMatrix
+
+
+def transpose(A: CsrMatrix) -> CsrMatrix:
+    row_ids, cols, vals = A.coo()
+    order = jnp.argsort(cols, stable=True)
+    new_rows = cols[order]
+    new_cols = row_ids[order]
+    new_vals = vals[order]
+    if A.is_block:
+        new_vals = jnp.swapaxes(new_vals, -1, -2)
+    counts = jnp.bincount(new_rows, length=A.num_cols)
+    row_offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts).astype(jnp.int32)])
+    out = CsrMatrix(row_offsets=row_offsets, col_indices=new_cols,
+                    values=new_vals, num_rows=A.num_cols, num_cols=A.num_rows,
+                    block_dimx=A.block_dimy, block_dimy=A.block_dimx)
+    if A.has_external_diag:
+        d = A.diag
+        if A.is_block:
+            d = jnp.swapaxes(d, -1, -2)
+        out = CsrMatrix(row_offsets=out.row_offsets,
+                        col_indices=out.col_indices, values=out.values,
+                        diag=d, num_rows=out.num_rows, num_cols=out.num_cols,
+                        block_dimx=out.block_dimx, block_dimy=out.block_dimy)
+    return out

@@ -1,0 +1,81 @@
+"""Factory registries.
+
+The reference's backbone is a set of static string-keyed factories
+(SolverFactory, CycleFactory, selectors, interpolators, ... registered in
+src/core.cu:546-691). This module is the TPU-native equivalent: one
+generic `Factory` class plus module-level registries for each pluggable
+kind. Components self-register at import time via decorators.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from .errors import BadParametersError
+
+
+class Factory:
+    """A named registry of constructors for one component kind."""
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._ctors: Dict[str, Callable] = {}
+
+    def register(self, name: str, ctor: Callable | None = None):
+        """Register a constructor. Usable as `f.register("NAME", ctor)` or as
+        a class decorator `@f.register("NAME")`."""
+        if ctor is None:
+            def deco(c):
+                self._ctors[name.upper()] = c
+                return c
+            return deco
+        self._ctors[name.upper()] = ctor
+        return ctor
+
+    def unregister(self, name: str):
+        self._ctors.pop(name.upper(), None)
+
+    def has(self, name: str) -> bool:
+        return name.upper() in self._ctors
+
+    def get(self, name: str) -> Callable:
+        try:
+            return self._ctors[name.upper()]
+        except KeyError:
+            raise BadParametersError(
+                f"{self.kind} factory: unknown name {name!r}; "
+                f"registered: {sorted(self._ctors)}") from None
+
+    def create(self, name: str, *args, **kwargs):
+        return self.get(name)(*args, **kwargs)
+
+    def names(self):
+        return sorted(self._ctors)
+
+
+# One registry per pluggable kind, mirroring registerClasses
+# (src/core.cu:583-691).
+solvers = Factory("Solver")
+eigensolvers = Factory("EigenSolver")
+cycles = Factory("Cycle")
+amg_levels = Factory("AMG_Level")
+classical_selectors = Factory("ClassicalSelector")
+aggregation_selectors = Factory("AggregationSelector")
+interpolators = Factory("Interpolator")
+energymin_interpolators = Factory("EnergyminInterpolator")
+strength = Factory("StrengthOfConnection")
+coarse_generators = Factory("CoarseAGenerator")
+matrix_coloring = Factory("MatrixColoring")
+convergence = Factory("Convergence")
+scalers = Factory("Scaler")
+matrix_io_readers = Factory("MatrixReader")
+matrix_io_writers = Factory("MatrixWriter")
+
+ALL = {
+    f.kind: f
+    for f in (
+        solvers, eigensolvers, cycles, amg_levels, classical_selectors,
+        aggregation_selectors, interpolators, energymin_interpolators,
+        strength, coarse_generators, matrix_coloring, convergence, scalers,
+        matrix_io_readers, matrix_io_writers,
+    )
+}

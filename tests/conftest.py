@@ -1,0 +1,36 @@
+"""Test harness configuration.
+
+Runs the whole suite on CPU with 8 virtual devices so the distributed
+(mesh/shard_map) paths are unit-testable on a single host — the gap the
+reference leaves open (its unit binary is single-process; multi-rank
+coverage only via MPI example programs, SURVEY.md §4).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# the axon TPU plugin ignores the JAX_PLATFORMS env var; the config flag
+# does stick — force the CPU backend (with 8 virtual devices) for tests
+jax.config.update("jax_platforms", "cpu")
+
+# persistent compilation cache makes repeated test runs cheap (eager setup
+# ops compile one XLA executable per shape bucket)
+jax.config.update("jax_compilation_cache_dir", "/tmp/amgx_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_enable_xla_caches",
+                  "xla_gpu_per_fusion_autotune_cache_dir")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(1234)

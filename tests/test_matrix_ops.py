@@ -1,0 +1,165 @@
+"""Container + kernel tests (analogs of src/tests/csr_multiply.cu,
+matrix_vector_multiply_tests.cu, norm_tests.cu)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from amgx_tpu import gallery, ops
+from amgx_tpu.matrix import CsrMatrix
+
+
+def dense_of(A):
+    return np.asarray(A.to_dense())
+
+
+class TestMatrix:
+    def test_poisson_5pt_structure(self):
+        A = gallery.poisson("5pt", 4, 4)
+        assert A.shape == (16, 16)
+        d = dense_of(A)
+        assert np.allclose(d, d.T)
+        assert np.all(np.diag(d) == 4.0)
+        # row sums are >= 0 (boundary rows positive)
+        assert np.all(d.sum(1) >= 0)
+
+    def test_poisson_7pt_rowsum(self):
+        A = gallery.poisson("7pt", 3, 4, 5)
+        d = dense_of(A)
+        assert d.shape == (60, 60)
+        assert np.all(np.diag(d) == 6.0)
+        interior = d.sum(1) == 0
+        assert interior.sum() == (3 - 2) * (4 - 2) * (5 - 2)
+
+    def test_from_coo_coalesce(self):
+        rows = [0, 0, 1, 0]
+        cols = [1, 1, 0, 0]
+        vals = [2.0, 3.0, 4.0, 1.0]
+        A = CsrMatrix.from_coo(rows, cols, vals, 2, 2)
+        d = dense_of(A)
+        assert np.allclose(d, [[1.0, 5.0], [4.0, 0.0]])
+
+    def test_diagonal_and_init(self):
+        A = gallery.poisson("5pt", 5, 5).init()
+        assert np.allclose(np.asarray(A.diagonal()), 4.0)
+        assert A.ell_cols is not None  # stencil rows are tight -> ELL chosen
+
+    def test_external_diag(self):
+        # A with diagonal stored outside (DIAG property)
+        rows = [0, 1]
+        cols = [1, 0]
+        vals = [-1.0, -2.0]
+        diag = jnp.asarray([3.0, 4.0])
+        A = CsrMatrix.from_coo(rows, cols, vals, 2, 2, diag=diag).init()
+        d = dense_of(A)
+        assert np.allclose(d, [[3.0, -1.0], [-2.0, 4.0]])
+        x = jnp.asarray([1.0, 2.0])
+        assert np.allclose(np.asarray(ops.spmv(A, x)), d @ np.asarray(x))
+
+    def test_replace_coefficients(self):
+        A = gallery.poisson("5pt", 4, 4).init()
+        A2 = A.with_values(A.values * 2.0)
+        assert np.allclose(dense_of(A2), 2 * dense_of(A))
+
+
+class TestSpmv:
+    @pytest.mark.parametrize("stencil,dims", [("5pt", (7, 5, 1)),
+                                              ("9pt", (6, 6, 1)),
+                                              ("27pt", (4, 3, 5))])
+    def test_vs_dense(self, stencil, dims):
+        A = gallery.poisson(stencil, *dims).init()
+        n = A.num_rows
+        x = jnp.asarray(np.random.default_rng(0).standard_normal(n))
+        y = ops.spmv(A, x)
+        assert np.allclose(np.asarray(y), dense_of(A) @ np.asarray(x))
+
+    def test_segsum_vs_ell(self):
+        A = gallery.poisson("7pt", 5, 5, 5)
+        a_ell = A.init(ell="always")
+        a_seg = A.init(ell="never")
+        x = jnp.asarray(np.random.default_rng(1).standard_normal(A.num_rows))
+        np.testing.assert_allclose(np.asarray(ops.spmv(a_ell, x)),
+                                   np.asarray(ops.spmv(a_seg, x)), rtol=1e-13)
+
+    def test_random_irregular(self):
+        A = gallery.random_matrix(120, max_nnz_per_row=9, seed=3).init()
+        x = jnp.asarray(np.random.default_rng(2).standard_normal(120))
+        np.testing.assert_allclose(np.asarray(ops.spmv(A, x)),
+                                   dense_of(A) @ np.asarray(x), rtol=1e-12)
+
+    def test_block_spmv(self):
+        A = gallery.random_matrix(40, max_nnz_per_row=5, seed=4,
+                                  block_dims=(3, 3)).init()
+        x = jnp.asarray(np.random.default_rng(5).standard_normal(40 * 3))
+        np.testing.assert_allclose(np.asarray(ops.spmv(A, x)),
+                                   dense_of(A) @ np.asarray(x), rtol=1e-12)
+
+    def test_residual(self):
+        A = gallery.poisson("5pt", 6, 6).init()
+        n = A.num_rows
+        rng = np.random.default_rng(7)
+        x = jnp.asarray(rng.standard_normal(n))
+        b = jnp.asarray(rng.standard_normal(n))
+        r = ops.residual(A, x, b)
+        assert np.allclose(np.asarray(r),
+                           np.asarray(b) - dense_of(A) @ np.asarray(x))
+
+
+class TestBlas:
+    def test_norms(self):
+        x = jnp.asarray([3.0, -4.0, 0.0])
+        assert float(ops.nrm1(x)) == 7.0
+        assert float(ops.nrm2(x)) == 5.0
+        assert float(ops.nrmmax(x)) == 4.0
+        assert float(ops.norm(x, "L2")) == 5.0
+
+    def test_block_norm(self):
+        x = jnp.asarray([3.0, 0.0, 0.0, 4.0])  # 2 blocks of size 2
+        bn = ops.norm(x, "L2", block_size=2, use_scalar_norm=False)
+        assert np.allclose(np.asarray(bn), [3.0, 4.0])
+
+    def test_dot(self):
+        x = jnp.asarray([1.0, 2.0])
+        y = jnp.asarray([3.0, 4.0])
+        assert float(ops.dot(x, y)) == 11.0
+
+
+class TestTranspose:
+    def test_transpose(self):
+        A = gallery.random_matrix(50, max_nnz_per_row=6, seed=9)
+        At = ops.transpose(A)
+        assert np.allclose(dense_of(At), dense_of(A).T)
+
+    def test_block_transpose(self):
+        A = gallery.random_matrix(12, max_nnz_per_row=4, seed=10,
+                                  block_dims=(2, 2))
+        At = ops.transpose(A)
+        assert np.allclose(dense_of(At), dense_of(A).T)
+
+
+class TestSpgemm:
+    def test_vs_dense(self):
+        A = gallery.random_matrix(40, max_nnz_per_row=5, seed=11)
+        B = gallery.random_matrix(40, max_nnz_per_row=4, seed=12)
+        C = ops.csr_multiply(A, B)
+        np.testing.assert_allclose(dense_of(C), dense_of(A) @ dense_of(B),
+                                   rtol=1e-12, atol=1e-12)
+
+    def test_poisson_squared(self):
+        A = gallery.poisson("5pt", 8, 8)
+        C = ops.csr_multiply(A, A)
+        np.testing.assert_allclose(dense_of(C), dense_of(A) @ dense_of(A),
+                                   rtol=1e-12)
+
+    def test_galerkin_rap(self):
+        A = gallery.poisson("5pt", 6, 6)
+        # a simple aggregation P: 2 fine -> 1 coarse
+        n = A.num_rows
+        nc = n // 2
+        rows = np.arange(n)
+        cols = rows // 2
+        P = CsrMatrix.from_coo(rows, cols, np.ones(n), n, nc)
+        R = ops.transpose(P)
+        Ac = ops.galerkin_rap(R, A, P)
+        Pd = dense_of(P)
+        np.testing.assert_allclose(dense_of(Ac), Pd.T @ dense_of(A) @ Pd,
+                                   rtol=1e-12, atol=1e-12)
