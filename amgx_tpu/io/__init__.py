@@ -1,0 +1,26 @@
+"""System IO: MatrixMarket + binary readers/writers (src/matrix_io.cu
+analog). `read_system`/`write_system` sniff the format."""
+from __future__ import annotations
+
+from . import matrix_market, binary  # noqa: F401  (registers formats)
+from ..errors import IOError_
+
+
+def read_system(path: str, dtype=None):
+    """Read (A, b|None, x|None), sniffing MatrixMarket vs binary."""
+    with open(path, "rb") as f:
+        head = f.read(16)
+    if head.startswith(binary._MAGIC):
+        return binary.read_system(path)
+    kwargs = {} if dtype is None else {"dtype": dtype}
+    if head.startswith(b"%%MatrixMarket"):
+        return matrix_market.read_system(path, **kwargs)
+    raise IOError_(f"{path}: unrecognized system file format")
+
+
+def write_system(path: str, A, b=None, x=None, fmt: str = "matrixmarket"):
+    if fmt.lower() == "matrixmarket":
+        return matrix_market.write_system(path, A, b, x)
+    if fmt.lower() == "binary":
+        return binary.write_system(path, A, b, x)
+    raise IOError_(f"unknown matrix_writer format {fmt!r}")

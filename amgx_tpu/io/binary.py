@@ -1,0 +1,83 @@
+"""Binary system format.
+
+Analog of the reference's NVAMGBinary reader/writer (src/readers.cu:1700,
+src/matrix_io.cu:301-390). The format here is our own (little-endian
+header + raw arrays) — the goal is a fast round-trip for large systems,
+not byte compatibility with the CUDA tool chain.
+
+Layout:
+  magic   b"AMGXTPU1"
+  header  7 x int64: num_rows num_cols nnz block_dimx block_dimy
+                     flags (bit0 diag, bit1 rhs, bit2 soln) dtype_code
+  arrays  row_offsets int32[n+1], col_indices int32[nnz],
+          values dtype[nnz*bx*by], [diag dtype[n*bx*by]],
+          [rhs dtype[n*bx]], [soln dtype[m*by]]
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..errors import IOError_
+from ..matrix import CsrMatrix
+from .. import registry
+
+_MAGIC = b"AMGXTPU1"
+_DTYPES = {0: np.float32, 1: np.float64, 2: np.complex64, 3: np.complex128}
+_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def write_system(path: str, A: CsrMatrix, b=None, x=None):
+    vals = np.asarray(A.values)
+    flags = (1 if A.has_external_diag else 0) | \
+            (2 if b is not None else 0) | (4 if x is not None else 0)
+    header = np.array(
+        [A.num_rows, A.num_cols, A.nnz, A.block_dimx, A.block_dimy, flags,
+         _CODES[vals.dtype]], np.int64)
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        f.write(header.tobytes())
+        f.write(np.asarray(A.row_offsets, np.int32).tobytes())
+        f.write(np.asarray(A.col_indices, np.int32).tobytes())
+        f.write(vals.tobytes())
+        if A.has_external_diag:
+            f.write(np.asarray(A.diag, vals.dtype).tobytes())
+        if b is not None:
+            f.write(np.asarray(b, vals.dtype).tobytes())
+        if x is not None:
+            f.write(np.asarray(x, vals.dtype).tobytes())
+
+
+def read_system(path: str, dtype=None):
+    with open(path, "rb") as f:
+        magic = f.read(len(_MAGIC))
+        if magic != _MAGIC:
+            raise IOError_(f"{path}: not an AMGXTPU binary system file")
+        header = np.frombuffer(f.read(7 * 8), np.int64)
+        n, m, nnz, bx, by, flags, code = (int(v) for v in header)
+        vdtype = np.dtype(_DTYPES[code])
+        row_offsets = np.frombuffer(f.read(4 * (n + 1)), np.int32)
+        col_indices = np.frombuffer(f.read(4 * nnz), np.int32)
+        bs = bx * by
+        values = np.frombuffer(f.read(vdtype.itemsize * nnz * bs), vdtype)
+        if bs > 1:
+            values = values.reshape(nnz, bx, by)
+        diag = b = x = None
+        if flags & 1:
+            diag = np.frombuffer(f.read(vdtype.itemsize * n * bs), vdtype)
+            if bs > 1:
+                diag = diag.reshape(n, bx, by)
+        if flags & 2:
+            b = jnp.asarray(np.frombuffer(f.read(vdtype.itemsize * n * bx),
+                                          vdtype))
+        if flags & 4:
+            x = jnp.asarray(np.frombuffer(f.read(vdtype.itemsize * m * by),
+                                          vdtype))
+    A = CsrMatrix.from_scipy_like(row_offsets, col_indices,
+                                  jnp.asarray(values), n, m, (bx, by),
+                                  diag=diag)
+    return A, b, x
+
+
+registry.matrix_io_readers.register("BINARY", read_system)
+registry.matrix_io_writers.register("BINARY", write_system)

@@ -1,0 +1,223 @@
+"""MatrixMarket system reader/writer.
+
+Analog of the reference MatrixMarket IO (src/matrix_io.cu,
+src/readers.cu): standard ``%%MatrixMarket matrix coordinate
+<field> <symmetry>`` files plus the AMGX extension line
+
+    %%AMGX <token>...
+
+with tokens: ``diagonal`` (externally-stored diagonal follows the
+entries), ``rhs`` / ``solution`` (vectors appended after the matrix),
+``base0`` (0-based indices), and one or two integers giving block
+dimensions. Parsing is host-side (numpy); returned containers are device
+pytrees.
+
+Unlike the reference we also accept ``pattern`` matrices (values of 1.0)
+rather than erroring.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..errors import IOError_
+from ..matrix import CsrMatrix
+from .. import registry
+
+
+def _parse_header(lines):
+    if not lines or not lines[0].startswith("%%MatrixMarket"):
+        raise IOError_("missing %%MatrixMarket header")
+    tokens = lines[0].split()[1:]
+    if not tokens or tokens[0] != "matrix":
+        raise IOError_("expecting 'matrix' keyword in MatrixMarket header")
+    fmt = tokens[1] if len(tokens) > 1 else "coordinate"
+    field = tokens[2] if len(tokens) > 2 else "real"
+    symmetry = tokens[3] if len(tokens) > 3 else "general"
+    amgx_tokens = []
+    body_start = 1
+    for i, ln in enumerate(lines[1:], start=1):
+        s = ln.strip()
+        if s.startswith("%%AMGX"):
+            amgx_tokens += s.split()[1:]
+            continue
+        if s.startswith("%") or not s:
+            continue
+        body_start = i
+        break
+    return fmt, field, symmetry, amgx_tokens, body_start
+
+
+def read_system(path: str, dtype=np.float64
+                ) -> Tuple[CsrMatrix, Optional[jnp.ndarray],
+                           Optional[jnp.ndarray]]:
+    """Read (A, rhs | None, solution | None) from a MatrixMarket file."""
+    with open(path) as f:
+        lines = f.readlines()
+    fmt, field, symmetry, amgx_tokens, body = _parse_header(lines)
+    if fmt != "coordinate":
+        raise IOError_(f"unsupported MatrixMarket format {fmt!r} "
+                       "(only 'coordinate')")
+    is_complex = field == "complex"
+    is_pattern = field == "pattern"
+    if is_complex:
+        dtype = np.complex128 if np.dtype(dtype) == np.float64 else np.complex64
+    symmetric = symmetry in ("symmetric", "skew-symmetric", "hermitian")
+    skew = symmetry == "skew-symmetric"
+    hermitian = symmetry == "hermitian"
+
+    has_diag = "diagonal" in amgx_tokens
+    has_rhs = "rhs" in amgx_tokens
+    has_soln = "solution" in amgx_tokens
+    base = 0 if "base0" in amgx_tokens else 1
+    block_sizes = [int(t) for t in amgx_tokens if t.isdigit()]
+    if len(block_sizes) == 2:
+        bx, by = block_sizes
+    elif len(block_sizes) == 1:
+        bx = by = block_sizes[0]
+    else:
+        bx = by = 1
+
+    size_line = lines[body].split()
+    rows_s, cols_s, entries_s = (int(size_line[0]), int(size_line[1]),
+                                 int(size_line[2]))
+    if rows_s % bx or cols_s % by or entries_s % (bx * by):
+        raise IOError_("matrix dimensions do not match block sizes")
+    n, m = rows_s // bx, cols_s // by
+
+    # bulk-parse the numeric body with numpy
+    per_entry = 2 + (0 if is_pattern else (2 if is_complex else 1))
+    body_vals = []
+    for ln in lines[body + 1:]:
+        s = ln.split()
+        if not s or s[0].startswith("%"):
+            continue
+        body_vals.extend(s)
+    data = np.array(body_vals, dtype=np.float64)
+    need = entries_s * per_entry
+    if data.size < need:
+        raise IOError_(f"matrix body truncated: {data.size} < {need} numbers")
+    ent = data[:need].reshape(entries_s, per_entry)
+    rest = data[need:]
+    r = ent[:, 0].astype(np.int64) - base
+    c = ent[:, 1].astype(np.int64) - base
+    if is_pattern:
+        v = np.ones(entries_s, dtype)
+    elif is_complex:
+        v = (ent[:, 2] + 1j * ent[:, 3]).astype(dtype)
+    else:
+        v = ent[:, 2].astype(dtype)
+
+    if symmetric:
+        off = r != c
+        rs, cs, vs = c[off], r[off], v[off]
+        if skew:
+            vs = -vs
+        elif hermitian:
+            vs = np.conj(vs)
+        r = np.concatenate([r, rs])
+        c = np.concatenate([c, cs])
+        v = np.concatenate([v, vs])
+
+    if bx * by > 1:
+        # scalar entries of an expanded block matrix: fold (r, c) into
+        # (block row, block col, in-block position)
+        br, ir = r // bx, r % bx
+        bc, ic = c // by, c % by
+        key = ((br * m + bc) * bx + ir) * by + ic
+        order = np.argsort(key, kind="stable")
+        nb = br.size // (bx * by)
+        blocks = v[order].reshape(nb, bx, by)
+        rb = br[order][:: bx * by]
+        cb = bc[order][:: bx * by]
+        A = CsrMatrix.from_coo(rb, cb, jnp.asarray(blocks), n, m,
+                               block_dims=(bx, by))
+    else:
+        A = CsrMatrix.from_coo(r, c, jnp.asarray(v), n, m)
+
+    pos = 0
+    if has_diag:
+        ndiag = n * bx * by
+        dvals = rest[pos:pos + ndiag].astype(dtype)
+        pos += ndiag
+        diag = jnp.asarray(dvals.reshape(n, bx, by) if bx * by > 1 else dvals)
+        A = CsrMatrix(row_offsets=A.row_offsets, col_indices=A.col_indices,
+                      values=A.values, diag=diag, num_rows=A.num_rows,
+                      num_cols=A.num_cols, block_dimx=bx, block_dimy=by)
+    b = x = None
+    if has_rhs:
+        nb_ = n * bx * (2 if is_complex else 1)
+        raw = rest[pos:pos + nb_]
+        pos += nb_
+        b = jnp.asarray(raw[0::2] + 1j * raw[1::2] if is_complex
+                        else raw.astype(dtype))
+    if has_soln:
+        nx_ = m * by * (2 if is_complex else 1)
+        raw = rest[pos:pos + nx_]
+        pos += nx_
+        x = jnp.asarray(raw[0::2] + 1j * raw[1::2] if is_complex
+                        else raw.astype(dtype))
+    return A, b, x
+
+
+def read_matrix(path: str, dtype=np.float64) -> CsrMatrix:
+    return read_system(path, dtype)[0]
+
+
+def write_system(path: str, A: CsrMatrix, b=None, x=None):
+    """Write (A [, rhs][, solution]) in MatrixMarket + %%AMGX format
+    (AMGX_write_system analog, src/matrix_io.cu)."""
+    n, m = A.num_rows, A.num_cols
+    bx, by = A.block_dimx, A.block_dimy
+    is_complex = np.issubdtype(np.asarray(A.values).dtype, np.complexfloating)
+    field = "complex" if is_complex else "real"
+    tokens = []
+    if bx * by > 1:
+        tokens += [str(bx), str(by)]
+    if A.has_external_diag:
+        tokens.append("diagonal")
+    if b is not None:
+        tokens.append("rhs")
+    if x is not None:
+        tokens.append("solution")
+    rows, cols, vals = (np.asarray(t) for t in A.coo())
+    with open(path, "w") as f:
+        f.write(f"%%MatrixMarket matrix coordinate {field} general\n")
+        if tokens:
+            f.write("%%AMGX " + " ".join(tokens) + "\n")
+        f.write(f"{n * bx} {m * by} {A.nnz * bx * by}\n")
+
+        def emit(i, j, val):
+            if is_complex:
+                f.write(f"{i} {j} {val.real:.17g} {val.imag:.17g}\n")
+            else:
+                f.write(f"{i} {j} {val:.17g}\n")
+
+        if bx * by > 1:
+            for e in range(vals.shape[0]):
+                for ii in range(bx):
+                    for jj in range(by):
+                        emit(rows[e] * bx + ii + 1, cols[e] * by + jj + 1,
+                             vals[e, ii, jj])
+        else:
+            for e in range(vals.size):
+                emit(int(rows[e]) + 1, int(cols[e]) + 1, vals[e])
+        if A.has_external_diag:
+            d = np.asarray(A.diag).reshape(-1)
+            for val in d:
+                f.write(f"{val:.17g}\n")
+        for vec in (b, x):
+            if vec is None:
+                continue
+            v = np.asarray(vec).reshape(-1)
+            for val in v:
+                if is_complex:
+                    f.write(f"{val.real:.17g} {val.imag:.17g}\n")
+                else:
+                    f.write(f"{val:.17g}\n")
+
+
+registry.matrix_io_readers.register("MATRIXMARKET", read_system)
+registry.matrix_io_writers.register("MATRIXMARKET", write_system)

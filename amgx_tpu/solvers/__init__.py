@@ -1,0 +1,9 @@
+"""Solver layer: registers all solver classes on import
+(registerClasses analog, src/core.cu:596-625)."""
+from . import base  # noqa: F401  (convergence criteria)
+from . import relaxation  # noqa: F401
+from . import direct  # noqa: F401
+from . import krylov  # noqa: F401
+from . import gmres  # noqa: F401
+
+from .base import Solver, SolveResult, make_solver  # noqa: F401

@@ -1,0 +1,350 @@
+"""Solver base: the composable solver tree.
+
+TPU-native analog of Solver<TConfig> + SolverFactory
+(include/solvers/solver.h:22,271; src/solvers/solver.cu). The reference
+architecture is kept — any solver can own a preconditioner child solver,
+configured per scope, built by a string-keyed factory — but the execution
+model is redesigned for XLA:
+
+- `setup(A)` runs once per matrix structure (host-orchestrated, device
+  math) and produces a *solve-data pytree*;
+- `solve()` compiles ONE XLA program: a `lax.while_loop` whose body is
+  the solver's `solve_iteration`, with convergence/divergence checks as
+  traced predicates — no host round-trips inside the iteration loop;
+- a preconditioner application is a pure function (fixed sweep count via
+  `lax.fori_loop`), so nesting solvers composes into a single fused
+  program instead of the reference's nested kernel launches.
+
+State is a plain dict pytree; the base manages the keys `x`, `r`,
+`iters`, `done`, `converged`, `res_norm`, `norm0`, `res_hist`.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import registry
+from ..config import Config
+from ..errors import BadParametersError
+from ..matrix import CsrMatrix
+from ..ops import blas
+from ..ops.spmv import residual as _residual
+
+# ---------------------------------------------------------------------------
+# convergence criteria (src/convergence/, registry src/core.cu:680-685)
+# ---------------------------------------------------------------------------
+
+
+class Convergence:
+    """Predicate deciding convergence from (res_norm, norm0)."""
+
+    def __init__(self, cfg: Config, scope: str):
+        self.tolerance = float(cfg.get("tolerance", scope))
+        self.alt_rel_tolerance = float(cfg.get("alt_rel_tolerance", scope))
+
+    def check(self, res_norm, norm0):
+        raise NotImplementedError
+
+
+@registry.convergence.register("ABSOLUTE")
+class AbsoluteConvergence(Convergence):
+    def check(self, res_norm, norm0):
+        return jnp.all(res_norm <= self.tolerance)
+
+
+@registry.convergence.register("RELATIVE_INI")
+@registry.convergence.register("RELATIVE_INI_CORE")
+class RelativeIniConvergence(Convergence):
+    def check(self, res_norm, norm0):
+        return jnp.all(res_norm <= self.tolerance * norm0)
+
+
+@registry.convergence.register("RELATIVE_MAX")
+@registry.convergence.register("RELATIVE_MAX_CORE")
+class RelativeMaxConvergence(Convergence):
+    """Relative to the max initial-residual component (block norms)."""
+
+    def check(self, res_norm, norm0):
+        return jnp.all(res_norm <= self.tolerance * jnp.max(norm0))
+
+
+@registry.convergence.register("COMBINED_REL_INI_ABS")
+class CombinedRelIniAbsConvergence(Convergence):
+    def check(self, res_norm, norm0):
+        return jnp.all((res_norm <= self.tolerance)
+                       | (res_norm <= self.alt_rel_tolerance * norm0))
+
+
+# ---------------------------------------------------------------------------
+# solve result
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SolveResult:
+    x: jax.Array
+    iterations: int
+    converged: bool
+    res_norm: float | np.ndarray
+    norm0: float | np.ndarray
+    res_history: Optional[np.ndarray] = None
+    setup_time: float = 0.0
+    solve_time: float = 0.0
+
+    @property
+    def status(self) -> str:
+        return "success" if self.converged else "diverged_or_max_iters"
+
+
+# ---------------------------------------------------------------------------
+# solver base
+# ---------------------------------------------------------------------------
+
+
+class Solver:
+    """Base solver. Subclasses implement `solver_setup`, `solve_init`,
+    `solve_iteration`, and may override `apply` (preconditioner action).
+
+    Reference skeleton: include/solvers/solver.h:126-156.
+    """
+
+    # does this solver read the "preconditioner" parameter?
+    uses_preconditioner = False
+    # smoothers can be used by AMG levels; they expose smooth()
+    is_smoother = False
+
+    def __init__(self, cfg: Config, scope: str = "default",
+                 name: str = "?"):
+        self.cfg = cfg
+        self.scope = scope
+        self.name = name
+        self.A: Optional[CsrMatrix] = None
+        self.max_iters = int(cfg.get("max_iters", scope))
+        self.monitor_residual = bool(cfg.get("monitor_residual", scope))
+        self.norm_type = str(cfg.get("norm", scope))
+        self.use_scalar_norm = bool(cfg.get("use_scalar_norm", scope))
+        self.store_res_history = bool(cfg.get("store_res_history", scope))
+        self.print_solve_stats = bool(cfg.get("print_solve_stats", scope))
+        self.obtain_timings = bool(cfg.get("obtain_timings", scope))
+        self.rel_div_tolerance = float(cfg.get("rel_div_tolerance", scope))
+        conv_name = str(cfg.get("convergence", scope))
+        self.convergence: Convergence = registry.convergence.create(
+            conv_name, cfg, scope)
+        self.preconditioner: Optional[Solver] = None
+        if self.uses_preconditioner:
+            pname, pscope = cfg.get_solver("preconditioner", scope)
+            if pname.upper() != "NOSOLVER":
+                self.preconditioner = make_solver(pname, cfg, pscope)
+        self._jit_cache: Dict[Any, Any] = {}
+        self.setup_time = 0.0
+
+    # -- norm ------------------------------------------------------------
+    def _norm(self, v, axis_name=None, num_owned=None):
+        bs = self.A.block_dimx if self.A is not None else 1
+        return blas.norm(v, self.norm_type, block_size=bs,
+                         use_scalar_norm=self.use_scalar_norm,
+                         axis_name=axis_name, num_owned=num_owned)
+
+    # -- setup -----------------------------------------------------------
+    def setup(self, A: CsrMatrix):
+        """Build solver state for matrix A (Solver::setup analog)."""
+        t0 = time.perf_counter()
+        if not A.initialized:
+            A = A.init()
+        self.A = A
+        # preconditioner first: solvers whose setup probes the
+        # preconditioned operator (e.g. Chebyshev eigen-estimation) need it
+        if self.preconditioner is not None:
+            self.preconditioner.setup(A)
+        self.solver_setup()
+        self._jit_cache.clear()
+        self.setup_time = time.perf_counter() - t0
+        return self
+
+    def resetup(self, A: CsrMatrix):
+        """Rebuild coefficients keeping structure where possible
+        (AMGX_solver_resetup analog)."""
+        return self.setup(A)
+
+    def solver_setup(self):
+        pass
+
+    # -- functional pieces (pure, jittable) ------------------------------
+    def solve_data(self) -> Dict[str, Any]:
+        """The pytree of device data the jitted solve needs. Includes the
+        preconditioner's data under 'precond'."""
+        d: Dict[str, Any] = {"A": self.A}
+        if self.preconditioner is not None:
+            d["precond"] = self.preconditioner.solve_data()
+        return d
+
+    def solve_init(self, data, b, x, r) -> Dict[str, Any]:
+        """Extra solver state (beyond x/r) before the first iteration."""
+        return {}
+
+    def solve_iteration(self, data, b, state) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def computes_residual(self) -> bool:
+        """True when solve_iteration maintains state['r'] itself; else the
+        driver recomputes r = b - Ax for monitoring."""
+        return True
+
+    def internal_res_norm(self, state):
+        """Optional cheap residual-norm estimate maintained by the solver
+        (e.g. GMRES |g[i+1]|). Return None to let the driver compute it."""
+        return None
+
+    def finalize(self, data, b, state):
+        """Post-loop fixup returning the final x (GMRES reconstructs x
+        from the Krylov basis here)."""
+        return state["x"]
+
+    def apply(self, data, rhs):
+        """Preconditioner action M^{-1} rhs: zero-init solve with a fixed
+        number of iterations (no convergence monitoring), fully traced."""
+        x0 = jnp.zeros_like(rhs)
+        r0 = rhs
+        st = {"x": x0, "r": r0}
+        st.update(self.solve_init(data, rhs, x0, r0))
+
+        def body(_, s):
+            return self.solve_iteration(data, rhs, s)
+
+        st = jax.lax.fori_loop(0, self.max_iters, body, st)
+        return st["x"]
+
+    # -- the jitted driver ----------------------------------------------
+    def _build_solve_fn(self):
+        max_iters = self.max_iters
+        monitor = self.monitor_residual
+        hist_len = max_iters + 1
+        div_tol = self.rel_div_tolerance
+        conv = self.convergence
+
+        def solve_fn(data, b, x0):
+            A = data["A"]
+            r0 = _residual(A, x0, b)
+            norm0 = self._norm(r0)
+            state = {"x": x0, "r": r0}
+            state.update(self.solve_init(data, b, x0, r0))
+            state["iters"] = jnp.asarray(0, jnp.int32)
+            state["done"] = conv.check(norm0, norm0) if monitor \
+                else jnp.asarray(False)
+            state["converged"] = state["done"]
+            state["res_norm"] = norm0
+            state["res_hist"] = jnp.zeros(
+                (hist_len,) + np.shape(norm0), norm0.dtype
+            ).at[0].set(norm0)
+
+            def cond(st):
+                return (~st["done"]) & (st["iters"] < max_iters)
+
+            def body(st):
+                iters = st["iters"]
+                core = {k: v for k, v in st.items()
+                        if k not in ("iters", "done", "converged",
+                                     "res_norm", "res_hist")}
+                core = self.solve_iteration(data, b, core)
+                new = dict(st)
+                new.update(core)
+                new["iters"] = iters + 1
+                if monitor:
+                    rn_int = self.internal_res_norm(core)
+                    if rn_int is not None:
+                        rn = rn_int
+                    elif self.computes_residual():
+                        rn = self._norm(core["r"])
+                    else:
+                        rn = self._norm(_residual(A, core["x"], b))
+                    new["res_norm"] = rn
+                    new["res_hist"] = st["res_hist"].at[iters + 1].set(rn)
+                    cvg = conv.check(rn, norm0)
+                    diverged = jnp.asarray(False)
+                    if div_tol > 0:
+                        diverged = jnp.any(rn > div_tol * norm0)
+                    new["converged"] = cvg
+                    new["done"] = cvg | diverged
+                return new
+
+            final = jax.lax.while_loop(cond, body, state)
+            x_final = self.finalize(data, b, final)
+            return (x_final, final["iters"], final["converged"],
+                    final["res_norm"], norm0, final["res_hist"])
+
+        return jax.jit(solve_fn)
+
+    def solve(self, b, x0=None, zero_initial_guess: bool = False
+              ) -> SolveResult:
+        """Solve A x = b (Solver::solve analog, include/solvers/solver.h)."""
+        if self.A is None:
+            raise BadParametersError(
+                f"solver {self.name}: solve() before setup()")
+        b = jnp.asarray(b)
+        if x0 is None or zero_initial_guess:
+            x0 = jnp.zeros_like(b)
+        else:
+            x0 = jnp.asarray(x0)
+        key = (b.shape, str(b.dtype))
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._build_solve_fn()
+        t0 = time.perf_counter()
+        x, iters, converged, res_norm, norm0, hist = self._jit_cache[key](
+            self.solve_data(), b, x0)
+        x.block_until_ready()
+        solve_time = time.perf_counter() - t0
+        iters_i = int(iters)
+        res = SolveResult(
+            x=x, iterations=iters_i, converged=bool(converged),
+            res_norm=np.asarray(res_norm), norm0=np.asarray(norm0),
+            res_history=np.asarray(hist)[:iters_i + 1]
+            if self.store_res_history else None,
+            setup_time=self.setup_time, solve_time=solve_time)
+        if self.print_solve_stats:
+            self._print_stats(res, np.asarray(hist))
+        return res
+
+    def _print_stats(self, res: SolveResult, hist):
+        print(f"    iter      Mem Usage (GB)       residual           rate")
+        print(f"    {'-' * 62}")
+        for i in range(res.iterations + 1):
+            rate = ""
+            if i > 0 and np.all(hist[i - 1] > 0):
+                rate = f"{float(np.max(hist[i] / hist[i - 1])):14.4f}"
+            tag = "Ini" if i == 0 else f"{i - 1:4d}"
+            print(f"    {tag}         {0.0:10.4f}      "
+                  f"{float(np.max(hist[i])):14.6e} {rate}")
+        print(f"    {'-' * 62}")
+        status = "success" if res.converged else "failed"
+        print(f"    Total Iterations: {res.iterations}")
+        print(f"    Avg Convergence Rate: "
+              f"{float((np.max(hist[res.iterations]) / max(np.max(hist[0]), 1e-300)) ** (1.0 / max(res.iterations, 1))):10.4f}")
+        print(f"    Final Residual: {float(np.max(res.res_norm)):.6e}")
+        print(f"    Solve Status: {status}")
+        if self.obtain_timings:
+            print(f"    Setup Time: {res.setup_time:.4f}s")
+            print(f"    Solve Time: {res.solve_time:.4f}s")
+
+    # -- smoother interface (AMG levels) ---------------------------------
+    def smooth(self, data, b, x, sweeps: int):
+        """Apply `sweeps` relaxation sweeps to x (pure function). Default:
+        run solve_iteration with monitoring off."""
+        st = {"x": x, "r": _residual(data["A"], x, b)}
+        st.update(self.solve_init(data, b, x, st["r"]))
+
+        def body(_, s):
+            return self.solve_iteration(data, b, s)
+
+        st = jax.lax.fori_loop(0, sweeps, body, st)
+        return st["x"]
+
+
+def make_solver(name: str, cfg: Config, scope: str = "default") -> Solver:
+    """SolverFactory::allocate analog."""
+    cls = registry.solvers.get(name)
+    return cls(cfg, scope, name=name.upper())

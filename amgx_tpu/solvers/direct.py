@@ -1,0 +1,58 @@
+"""Dense direct solver (coarse-grid solver).
+
+Analog of src/solvers/dense_lu_solver.cu (cuSolverDn getrf/getrs,
+:514-580): densify the (small) matrix once at setup, LU-factor it, and
+back-substitute per application. On TPU the batched triangular solves run
+on the MXU; the coarsest AMG level is replicated across the mesh, so the
+factorization is the `exact_coarse_solve` analog (the distributed layer
+all-gathers the coarse matrix before calling this, mirroring
+dense_lu_solver.cu:783-930).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.linalg as jsl
+
+from .. import registry
+from ..ops.spmv import residual
+from .base import Solver
+
+
+@registry.solvers.register("DENSE_LU_SOLVER")
+class DenseLUSolver(Solver):
+    def __init__(self, cfg, scope="default", name="DENSE_LU_SOLVER"):
+        super().__init__(cfg, scope, name)
+        self.dense_lu_num_rows = int(cfg.get("dense_lu_num_rows", scope))
+        self.dense_lu_max_rows = int(cfg.get("dense_lu_max_rows", scope))
+
+    def solver_setup(self):
+        dense = self.A.to_dense()
+        # guard singular rows (e.g. empty coarse rows) with unit diagonal
+        zero_rows = jnp.all(dense == 0, axis=1)
+        dense = jnp.where(
+            jnp.diag(zero_rows), jnp.eye(dense.shape[0], dtype=dense.dtype),
+            dense)
+        self._lu, self._piv = jsl.lu_factor(dense)
+
+    def solve_data(self):
+        d = super().solve_data()
+        d["lu"] = self._lu
+        d["piv"] = self._piv
+        return d
+
+    def _direct(self, data, rhs):
+        return jsl.lu_solve((data["lu"], data["piv"]), rhs)
+
+    def solve_iteration(self, data, b, st):
+        x = self._direct(data, b)
+        out = dict(st)
+        out["x"] = x
+        out["r"] = residual(data["A"], x, b)
+        return out
+
+    def apply(self, data, rhs):
+        return self._direct(data, rhs)
+
+    def smooth(self, data, b, x, sweeps):
+        return self._direct(data, b)

@@ -1,0 +1,234 @@
+"""Krylov solvers: CG / PCG / PCGF / BiCGStab / PBiCGStab / Chebyshev.
+
+Analogs of src/solvers/cg_solver.cu, pcg_solver.cu, pcgf_solver.cu,
+bicgstab_solver.cu, pbicgstab_solver.cu, cheb_solver.cu. Each iteration
+is a pure function over a dict state; the base driver compiles the whole
+iteration loop (SpMV + reductions + preconditioner application) into one
+XLA program, so dot products stay on device and distributed runs finish
+reductions with psum instead of MPI_Allreduce.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import registry
+from ..ops import blas
+from ..ops.spmv import spmv
+from .base import Solver
+
+
+def _safe_div(a, b):
+    return a / jnp.where(b == 0, 1.0, b) * (b != 0)
+
+
+class _KrylovBase(Solver):
+    def _precond(self, data, r):
+        if self.preconditioner is not None:
+            return self.preconditioner.apply(data["precond"], r)
+        return r
+
+
+@registry.solvers.register("CG")
+class CGSolver(_KrylovBase):
+    """Unpreconditioned conjugate gradients (cg_solver.cu)."""
+
+    def solve_init(self, data, b, x, r):
+        return {"p": r, "rz": blas.dot(r, r)}
+
+    def solve_iteration(self, data, b, st):
+        A = data["A"]
+        x, r, p, rz = st["x"], st["r"], st["p"], st["rz"]
+        Ap = spmv(A, p)
+        alpha = _safe_div(rz, blas.dot(p, Ap))
+        x = x + alpha * p
+        r = r - alpha * Ap
+        rz_new = blas.dot(r, r)
+        beta = _safe_div(rz_new, rz)
+        p = r + beta * p
+        return {**st, "x": x, "r": r, "p": p, "rz": rz_new}
+
+
+@registry.solvers.register("PCG")
+class PCGSolver(_KrylovBase):
+    """Preconditioned CG (pcg_solver.cu)."""
+
+    uses_preconditioner = True
+
+    def solve_init(self, data, b, x, r):
+        z = self._precond(data, r)
+        return {"p": z, "z": z, "rz": blas.dot(r, z)}
+
+    def solve_iteration(self, data, b, st):
+        A = data["A"]
+        x, r, p, rz = st["x"], st["r"], st["p"], st["rz"]
+        Ap = spmv(A, p)
+        alpha = _safe_div(rz, blas.dot(p, Ap))
+        x = x + alpha * p
+        r = r - alpha * Ap
+        z = self._precond(data, r)
+        rz_new = blas.dot(r, z)
+        beta = _safe_div(rz_new, rz)
+        p = z + beta * p
+        return {**st, "x": x, "r": r, "p": p, "z": z, "rz": rz_new}
+
+
+@registry.solvers.register("PCGF")
+class PCGFSolver(_KrylovBase):
+    """Flexible PCG (pcgf_solver.cu): Polak-Ribiere beta so the
+    preconditioner may vary between iterations."""
+
+    uses_preconditioner = True
+
+    def solve_init(self, data, b, x, r):
+        z = self._precond(data, r)
+        return {"p": z, "z": z, "r_old": r, "rz": blas.dot(r, z)}
+
+    def solve_iteration(self, data, b, st):
+        A = data["A"]
+        x, r, p, rz = st["x"], st["r"], st["p"], st["rz"]
+        Ap = spmv(A, p)
+        alpha = _safe_div(rz, blas.dot(p, Ap))
+        x = x + alpha * p
+        r_new = r - alpha * Ap
+        z = self._precond(data, r_new)
+        # flexible beta: <z, r_new - r> / <r, z_old-ish rz>
+        rz_new = blas.dot(r_new, z)
+        beta = _safe_div(blas.dot(r_new - r, z), rz)
+        p = z + beta * p
+        return {**st, "x": x, "r": r_new, "p": p, "z": z, "r_old": r,
+                "rz": rz_new}
+
+
+@registry.solvers.register("BICGSTAB")
+class BiCGStabSolver(_KrylovBase):
+    """BiCGStab (bicgstab_solver.cu)."""
+
+    def solve_init(self, data, b, x, r):
+        one = jnp.ones((), r.dtype)
+        return {"r_tld": r, "p": r, "v": jnp.zeros_like(r),
+                "rho": blas.dot(r, r), "alpha": one, "omega": one}
+
+    def solve_iteration(self, data, b, st):
+        A = data["A"]
+        x, r = st["x"], st["r"]
+        r_tld, p, rho = st["r_tld"], st["p"], st["rho"]
+        v = spmv(A, p)
+        alpha = _safe_div(rho, blas.dot(r_tld, v))
+        s = r - alpha * v
+        t = spmv(A, s)
+        omega = _safe_div(blas.dot(t, s), blas.dot(t, t))
+        x = x + alpha * p + omega * s
+        r = s - omega * t
+        rho_new = blas.dot(r_tld, r)
+        beta = _safe_div(rho_new * alpha, rho * omega)
+        p = r + beta * (p - omega * v)
+        return {**st, "x": x, "r": r, "p": p, "v": v, "rho": rho_new,
+                "alpha": alpha, "omega": omega}
+
+
+@registry.solvers.register("PBICGSTAB")
+class PBiCGStabSolver(_KrylovBase):
+    """Preconditioned BiCGStab (pbicgstab_solver.cu)."""
+
+    uses_preconditioner = True
+
+    def solve_init(self, data, b, x, r):
+        one = jnp.ones((), r.dtype)
+        return {"r_tld": r, "p": r, "v": jnp.zeros_like(r),
+                "rho": blas.dot(r, r), "alpha": one, "omega": one}
+
+    def solve_iteration(self, data, b, st):
+        A = data["A"]
+        x, r = st["x"], st["r"]
+        r_tld, rho = st["r_tld"], st["rho"]
+        p = st["p"]
+        p_hat = self._precond(data, p)
+        v = spmv(A, p_hat)
+        alpha = _safe_div(rho, blas.dot(r_tld, v))
+        s = r - alpha * v
+        s_hat = self._precond(data, s)
+        t = spmv(A, s_hat)
+        omega = _safe_div(blas.dot(t, s), blas.dot(t, t))
+        x = x + alpha * p_hat + omega * s_hat
+        r = s - omega * t
+        rho_new = blas.dot(r_tld, r)
+        beta = _safe_div(rho_new * alpha, rho * omega)
+        p = r + beta * (p - omega * v)
+        return {**st, "x": x, "r": r, "p": p, "v": v, "rho": rho_new,
+                "alpha": alpha, "omega": omega}
+
+
+@registry.solvers.register("CHEBYSHEV")
+class ChebyshevSolver(_KrylovBase):
+    """Chebyshev iteration (cheb_solver.cu) with eigenvalue-estimation
+    modes: 0 = user guesses (cheby_max_lambda/cheby_min_lambda), 1/2 =
+    power iteration on D^{-1}A at setup."""
+
+    uses_preconditioner = True
+    is_smoother = True
+
+    def __init__(self, cfg, scope="default", name="CHEBYSHEV"):
+        super().__init__(cfg, scope, name)
+        self.estimate_mode = int(cfg.get("chebyshev_lambda_estimate_mode",
+                                         scope))
+        self.lmax = float(cfg.get("cheby_max_lambda", scope))
+        self.lmin = float(cfg.get("cheby_min_lambda", scope))
+
+    def solver_setup(self):
+        if self.estimate_mode > 0:
+            precond_apply = None
+            if self.preconditioner is not None:
+                pdata = self.preconditioner.solve_data()
+                precond_apply = lambda v: self.preconditioner.apply(pdata, v)
+            lmax = _power_lambda_max(self.A, precond_apply)
+            self.lmax = float(lmax) * 1.05
+            self.lmin = self.lmax / 8.0  # standard smoothing interval
+        self._d = (self.lmax + self.lmin) / 2.0
+        self._c = (self.lmax - self.lmin) / 2.0
+
+    def computes_residual(self):
+        return False
+
+    def solve_init(self, data, b, x, r):
+        dt = x.dtype
+        return {"p": jnp.zeros_like(x), "rho": jnp.zeros((), dt),
+                "k": jnp.zeros((), jnp.int32)}
+
+    def solve_iteration(self, data, b, st):
+        A = data["A"]
+        d, c = self._d, self._c
+        sigma = d / c
+        x, p, rho, k = st["x"], st["p"], st["rho"], st["k"]
+        r = b - spmv(A, x)
+        z = self._precond(data, r)
+        first = (k == 0)
+        rho_new = jnp.where(first, 1.0 / sigma,
+                            1.0 / (2.0 * sigma - rho))
+        p = jnp.where(first, z / d,
+                      rho_new * rho * p + (2.0 * rho_new / c) * z)
+        x = x + p
+        return {**st, "x": x, "p": p, "rho": rho_new, "k": k + 1}
+
+
+def _power_lambda_max(A, precond_apply=None, iters: int = 20, seed: int = 0):
+    """Power-iteration estimate of lambda_max of the (preconditioned)
+    operator M^{-1}A (setup-time; cheb_solver.cu eigenvalue estimation)."""
+    import numpy as np
+    n = A.num_rows * A.block_dimx
+    v = jnp.asarray(np.random.default_rng(seed).standard_normal(n),
+                    dtype=A.dtype)
+
+    def op(v):
+        w = spmv(A, v)
+        return precond_apply(w) if precond_apply is not None else w
+
+    def body(_, carry):
+        v, lam = carry
+        w = op(v)
+        lam = blas.nrm2(w)
+        return w / jnp.where(lam == 0, 1.0, lam), lam
+
+    _, lam = jax.lax.fori_loop(0, iters, body,
+                               (v / blas.nrm2(v), jnp.zeros((), v.dtype)))
+    return lam

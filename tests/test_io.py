@@ -1,0 +1,85 @@
+"""IO tests (analogs of capi_upload_tests.cu / matrix IO paths)."""
+import numpy as np
+import jax.numpy as jnp
+
+from amgx_tpu import gallery
+from amgx_tpu.io import read_system, write_system
+from amgx_tpu.matrix import CsrMatrix
+
+
+def dense(A):
+    return np.asarray(A.to_dense())
+
+
+def test_reference_example_matrix():
+    # the 12-row demo matrix shipped with the reference (examples/matrix.mtx)
+    A, b, x = read_system("/root/reference/examples/matrix.mtx")
+    assert A.shape == (12, 12)
+    assert A.nnz == 61
+    assert b is None and x is None
+    d = dense(A)
+    assert d[0, 0] == 1.0 and d[0, 1] == 2.0 and d[0, 3] == 3.0
+
+
+def test_roundtrip_matrixmarket(tmp_path):
+    A = gallery.poisson("5pt", 6, 5)
+    rng = np.random.default_rng(0)
+    b = jnp.asarray(rng.standard_normal(A.num_rows))
+    p = str(tmp_path / "sys.mtx")
+    write_system(p, A, b=b)
+    A2, b2, x2 = read_system(p)
+    assert np.allclose(dense(A2), dense(A))
+    assert np.allclose(np.asarray(b2), np.asarray(b))
+    assert x2 is None
+
+
+def test_roundtrip_block_diag(tmp_path):
+    A = gallery.random_matrix(10, max_nnz_per_row=4, seed=5,
+                              block_dims=(2, 2))
+    p = str(tmp_path / "blk.mtx")
+    write_system(p, A)
+    A2, _, _ = read_system(p)
+    assert A2.block_dimx == 2 and A2.block_dimy == 2
+    assert np.allclose(dense(A2), dense(A))
+
+
+def test_symmetric_expansion(tmp_path):
+    p = tmp_path / "sym.mtx"
+    p.write_text(
+        "%%MatrixMarket matrix coordinate real symmetric\n"
+        "3 3 4\n1 1 2.0\n2 1 -1.0\n2 2 2.0\n3 3 1.0\n")
+    A, _, _ = read_system(str(p))
+    d = dense(A)
+    assert np.allclose(d, [[2, -1, 0], [-1, 2, 0], [0, 0, 1]])
+
+
+def test_pattern_accepted(tmp_path):
+    p = tmp_path / "pat.mtx"
+    p.write_text(
+        "%%MatrixMarket matrix coordinate pattern general\n"
+        "2 2 3\n1 1\n1 2\n2 2\n")
+    A, _, _ = read_system(str(p))
+    assert np.allclose(dense(A), [[1, 1], [0, 1]])
+
+
+def test_roundtrip_binary(tmp_path):
+    A = gallery.poisson("7pt", 4, 4, 4)
+    rng = np.random.default_rng(1)
+    b = jnp.asarray(rng.standard_normal(A.num_rows))
+    x = jnp.asarray(rng.standard_normal(A.num_rows))
+    p = str(tmp_path / "sys.bin")
+    write_system(p, A, b=b, x=x, fmt="binary")
+    A2, b2, x2 = read_system(p)
+    assert np.allclose(dense(A2), dense(A))
+    assert np.allclose(np.asarray(b2), np.asarray(b))
+    assert np.allclose(np.asarray(x2), np.asarray(x))
+
+
+def test_external_diag_roundtrip(tmp_path):
+    A = CsrMatrix.from_coo([0, 1], [1, 0], [-1.0, -2.0], 2, 2,
+                           diag=jnp.asarray([3.0, 4.0]))
+    p = str(tmp_path / "diag.mtx")
+    write_system(p, A)
+    A2, _, _ = read_system(p)
+    assert A2.has_external_diag
+    assert np.allclose(dense(A2), dense(A))

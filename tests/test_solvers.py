@@ -1,0 +1,191 @@
+"""Solver-layer tests (analogs of fgmres_convergence_poisson.cu,
+nested_solvers.cu, solver behavior tests in src/tests/)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import amgx_tpu as amgx
+from amgx_tpu import gallery, ops
+from amgx_tpu.config import Config
+from amgx_tpu.solvers import make_solver
+
+amgx.initialize()
+
+
+@pytest.fixture(scope="module")
+def poisson32():
+    return gallery.poisson("5pt", 32, 32).init()
+
+
+@pytest.fixture(scope="module")
+def rhs32(poisson32):
+    return jnp.ones(poisson32.num_rows)
+
+
+def true_res(A, x, b):
+    return float(np.linalg.norm(np.asarray(ops.residual(A, x, b))))
+
+
+KRYLOV_CONFIGS = [
+    ("CG", "max_iters=400, monitor_residual=1, tolerance=1e-10", 400),
+    ("BICGSTAB", "max_iters=400, monitor_residual=1, tolerance=1e-10", 400),
+    ("GMRES", "max_iters=500, monitor_residual=1, tolerance=1e-10,"
+     " gmres_n_restart=20, preconditioner=NOSOLVER", 500),
+    ("PCG", "max_iters=400, monitor_residual=1, tolerance=1e-10,"
+     " preconditioner(j)=BLOCK_JACOBI, j:max_iters=3", 200),
+    ("PCGF", "max_iters=400, monitor_residual=1, tolerance=1e-10,"
+     " preconditioner(j)=BLOCK_JACOBI, j:max_iters=3", 200),
+    ("PBICGSTAB", "max_iters=400, monitor_residual=1, tolerance=1e-10,"
+     " preconditioner(j)=BLOCK_JACOBI, j:max_iters=3", 200),
+    ("FGMRES", "max_iters=400, monitor_residual=1, tolerance=1e-10,"
+     " gmres_n_restart=20, preconditioner(j)=BLOCK_JACOBI, j:max_iters=3",
+     200),
+]
+
+
+@pytest.mark.parametrize("name,opts,max_expected", KRYLOV_CONFIGS,
+                         ids=[c[0] for c in KRYLOV_CONFIGS])
+def test_krylov_converges_poisson(poisson32, rhs32, name, opts, max_expected):
+    """Residual must beat the configured tolerance (reference:
+    fgmres_convergence_poisson.cu semantics)."""
+    s = make_solver(name, Config.from_string(opts))
+    s.setup(poisson32)
+    res = s.solve(rhs32)
+    assert res.converged, f"{name} did not converge"
+    assert res.iterations <= max_expected
+    # the solver's own residual claim must match the true residual
+    tr = true_res(poisson32, res.x, rhs32)
+    assert tr <= 5e-9, f"{name}: true residual {tr}"
+
+
+def test_cg_matches_dense_solution(poisson32, rhs32):
+    s = make_solver("CG", Config.from_string(
+        "max_iters=2000, monitor_residual=1, tolerance=1e-12"))
+    s.setup(poisson32)
+    res = s.solve(rhs32)
+    x_ref = np.linalg.solve(np.asarray(poisson32.to_dense()),
+                            np.asarray(rhs32))
+    assert np.allclose(np.asarray(res.x), x_ref, atol=1e-8)
+
+
+def test_jacobi_reduces_residual(poisson32, rhs32):
+    s = make_solver("BLOCK_JACOBI", Config.from_string(
+        "max_iters=100, monitor_residual=1, tolerance=1e-30,"
+        " relaxation_factor=0.8"))
+    s.setup(poisson32)
+    res = s.solve(rhs32)
+    assert float(np.max(res.res_norm)) < float(np.max(res.norm0))
+
+
+def test_jacobi_l1_spd_monotone():
+    A = gallery.random_matrix(60, max_nnz_per_row=5, seed=3, symmetric=True,
+                              diag_dominant=True).init()
+    b = jnp.ones(60)
+    s = make_solver("JACOBI_L1", Config.from_string(
+        "max_iters=50, monitor_residual=1, tolerance=1e-12,"
+        " relaxation_factor=1.0, store_res_history=1"))
+    s.setup(A)
+    res = s.solve(b)
+    hist = res.res_history
+    assert hist is not None
+    assert hist[-1] < hist[0]
+
+
+def test_block_matrix_pcg():
+    A = gallery.random_matrix(50, max_nnz_per_row=4, seed=7, symmetric=True,
+                              diag_dominant=True, block_dims=(2, 2)).init()
+    b = jnp.ones(100)
+    s = make_solver("PCG", Config.from_string(
+        "max_iters=300, monitor_residual=1, tolerance=1e-10,"
+        " preconditioner(j)=BLOCK_JACOBI, j:max_iters=2"))
+    s.setup(A)
+    res = s.solve(b)
+    assert res.converged
+    assert true_res(A, res.x, b) < 1e-8
+
+
+def test_dense_lu_direct(poisson32, rhs32):
+    s = make_solver("DENSE_LU_SOLVER", Config.from_string(
+        "max_iters=1, monitor_residual=1, tolerance=1e-10"))
+    s.setup(poisson32)
+    res = s.solve(rhs32)
+    assert res.iterations == 1
+    assert true_res(poisson32, res.x, rhs32) < 1e-10
+
+
+def test_nested_solvers():
+    """Nested preconditioning: FGMRES <- PCG <- Jacobi
+    (nested_solvers.cu analog)."""
+    A = gallery.poisson("5pt", 16, 16).init()
+    b = jnp.ones(A.num_rows)
+    cfg = Config.from_string(
+        "max_iters=100, monitor_residual=1, tolerance=1e-10,"
+        " gmres_n_restart=10, preconditioner(p1)=PCG,"
+        " p1:max_iters=3, p1:preconditioner(p2)=BLOCK_JACOBI,"
+        " p2:max_iters=2")
+    s = make_solver("FGMRES", cfg)
+    s.setup(A)
+    res = s.solve(b)
+    assert res.converged
+    assert true_res(A, res.x, b) < 1e-8
+
+
+def test_convergence_criteria_relative_ini(poisson32, rhs32):
+    cfg = Config.from_string(
+        "max_iters=400, monitor_residual=1, tolerance=1e-6,"
+        " convergence=RELATIVE_INI")
+    s = make_solver("CG", cfg)
+    s.setup(poisson32)
+    res = s.solve(rhs32)
+    assert res.converged
+    assert float(np.max(res.res_norm)) <= 1e-6 * float(np.max(res.norm0))
+
+
+def test_divergence_detection():
+    """rel_div_tolerance aborts a diverging iteration."""
+    # -A is negative definite: plain CG diverges/stalls
+    A = gallery.poisson("5pt", 8, 8)
+    import jax.numpy as jnp2
+    A = A.with_values(A.values)  # keep structure
+    b = jnp2.ones(64)
+    s = make_solver("BLOCK_JACOBI", Config.from_string(
+        "max_iters=100, monitor_residual=1, tolerance=1e-12,"
+        " relaxation_factor=1.9, rel_div_tolerance=1e3"))
+    s.setup(A.init())
+    res = s.solve(b)
+    assert not res.converged
+    assert res.iterations < 100  # stopped early by divergence check
+
+
+def test_zero_rhs(poisson32):
+    """b = 0 must return x = 0 and converge immediately."""
+    s = make_solver("CG", Config.from_string(
+        "max_iters=10, monitor_residual=1, tolerance=1e-10"))
+    s.setup(poisson32)
+    res = s.solve(jnp.zeros(poisson32.num_rows))
+    assert res.converged
+    assert res.iterations == 0
+    assert float(np.max(np.abs(np.asarray(res.x)))) == 0.0
+
+
+def test_initial_guess(poisson32, rhs32):
+    """Starting from the exact solution converges in 0 iterations."""
+    x_ref = jnp.asarray(np.linalg.solve(np.asarray(poisson32.to_dense()),
+                                        np.asarray(rhs32)))
+    s = make_solver("CG", Config.from_string(
+        "max_iters=10, monitor_residual=1, tolerance=1e-8"))
+    s.setup(poisson32)
+    res = s.solve(rhs32, x0=x_ref)
+    assert res.iterations == 0
+
+
+def test_res_history_monotone_cg(poisson32, rhs32):
+    s = make_solver("PCG", Config.from_string(
+        "max_iters=200, monitor_residual=1, tolerance=1e-10,"
+        " store_res_history=1, preconditioner(j)=BLOCK_JACOBI,"
+        " j:max_iters=2"))
+    s.setup(poisson32)
+    res = s.solve(rhs32)
+    hist = res.res_history
+    assert hist[-1] <= 1e-10 * 1e12  # sanity
+    assert hist.shape[0] == res.iterations + 1
