@@ -1,0 +1,7 @@
+"""AMG hierarchy layer: registers level types, cycles, selectors and the
+"AMG" solver (registerClasses analog for L4)."""
+from . import hierarchy  # noqa: F401
+from . import aggregation  # noqa: F401
+from . import solver  # noqa: F401
+
+from .hierarchy import AMG, AMGLevel  # noqa: F401

@@ -1,0 +1,43 @@
+"""Unsmoothed-aggregation AMG level.
+
+Analog of src/aggregation/aggregation_amg_level.cu (2654 LoC): the
+selector builds an `aggregates` map, restriction/prolongation are
+segment-sum / gather with that map (no explicit CSR transfer operators),
+and the coarse matrix is the COO-relabel Galerkin product.
+"""
+from __future__ import annotations
+
+from ... import registry
+from ...config import Config
+from ...matrix import CsrMatrix
+from ..hierarchy import AMGLevel
+from . import selectors  # noqa: F401  (registers selectors)
+from .galerkin import (coarse_a_from_aggregates, prolongate_corr,
+                       restrict_vector)
+
+
+@registry.amg_levels.register("AGGREGATION")
+class AggregationAMGLevel(AMGLevel):
+    algorithm = "AGGREGATION"
+
+    def create_coarse_vertices(self):
+        sel_name = str(self.cfg.get("selector", self.scope))
+        sel = registry.aggregation_selectors.create(
+            sel_name, self.cfg, self.scope)
+        self.aggregates, self.coarse_size = sel.set_aggregates(self.A)
+
+    def create_coarse_matrix(self) -> CsrMatrix:
+        return coarse_a_from_aggregates(self.A, self.aggregates,
+                                        self.coarse_size)
+
+    def level_data(self):
+        d = super().level_data()
+        d["aggregates"] = self.aggregates
+        return d
+
+    def restrict(self, data, r):
+        return restrict_vector(data["aggregates"], self.coarse_size, r,
+                               self.A.block_dimx)
+
+    def prolongate(self, data, xc):
+        return prolongate_corr(data["aggregates"], xc, self.A.block_dimx)

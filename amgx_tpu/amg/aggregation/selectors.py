@@ -1,0 +1,254 @@
+"""Aggregation selectors: parallel-matching aggregation.
+
+Analogs of src/aggregation/selectors/ (size2_selector.cu 920 LoC,
+size4/size8, dummy). The reference's handshaking matching is re-expressed
+as fixed-point iterations of segmented gather/argmax ops (TPU-friendly:
+no atomics, deterministic by construction via smallest-index
+tie-breaking):
+
+  repeat:
+    every unaggregated vertex proposes its strongest unaggregated
+    neighbor (segment-max of edge weights + segment-min index tiebreak);
+    mutual proposals (handshakes) become aggregates of two.
+
+SIZE_4 / SIZE_8 run 2 / 3 matching passes, pairing *aggregates* in later
+passes through the coarse graph (same machinery as the Galerkin product).
+All of this is setup-time eager device code with concrete shapes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import registry
+from ...config import Config
+from ...matrix import CsrMatrix
+
+
+def _edge_weights(A: CsrMatrix, formula: int = 0):
+    """Symmetrized edge weights (reference weight_formula 0:
+    w_ij = 0.5(|a_ij|+|a_ji|)/max(|a_ii|,|a_jj|))."""
+    rows, cols, vals = A.coo()
+    if A.is_block:
+        # reference uses one block component (aggregation_edge_weight_
+        # component); the (0,0) entry
+        v = vals[:, 0, 0]
+        d = A.diagonal()[:, 0, 0]
+    else:
+        v = vals
+        d = A.diagonal()
+    absd = jnp.abs(d)
+    n = A.num_rows
+    # |a_ji| via scatter of |a_ij| into the transpose position: build a
+    # dense-free lookup by sorting the transposed key
+    key_t = cols.astype(jnp.int64) * n + rows.astype(jnp.int64)
+    key = rows.astype(jnp.int64) * n + cols.astype(jnp.int64)
+    order = jnp.argsort(key_t, stable=True)
+    # sorted transpose keys == sorted forward keys where symmetric pattern;
+    # look up |a_ji| by searching key in sorted key_t
+    sorted_kt = key_t[order]
+    pos = jnp.searchsorted(sorted_kt, key)
+    pos = jnp.clip(pos, 0, rows.shape[0] - 1)
+    match = sorted_kt[pos] == key
+    v_t = jnp.where(match, jnp.abs(v[order][pos]), 0.0)
+    if formula == 1:
+        w = -0.5 * (v / jnp.where(d[rows] == 0, 1.0, d[rows])
+                    + v_t / jnp.where(d[cols] == 0, 1.0, d[cols]))
+    else:
+        denom = jnp.maximum(absd[rows], absd[cols])
+        w = 0.5 * (jnp.abs(v) + v_t) / jnp.where(denom == 0, 1.0, denom)
+    w = jnp.where(rows == cols, 0.0, w)
+    return rows, cols, w
+
+
+def _edge_hash(rows, cols):
+    """Symmetric per-edge pseudo-random value in [0, 1): hash of the
+    unordered pair. Breaks weight ties so handshaking matches a constant
+    fraction per round (Luby-style) instead of forming chains; being a
+    pure hash it is deterministic across runs (determinism_flag for free)."""
+    a = jnp.minimum(rows, cols).astype(jnp.uint32)
+    b = jnp.maximum(rows, cols).astype(jnp.uint32)
+    h = a * jnp.uint32(73856093) ^ b * jnp.uint32(19349663)
+    h = (h ^ (h >> 13)) * jnp.uint32(0x5BD1E995)
+    return (h & jnp.uint32(0xFFFFF)).astype(jnp.float64) / float(1 << 20)
+
+
+def _matching_pass(rows, cols, w, n, max_iters: int,
+                   deterministic: bool = True):
+    """One size-2 matching: returns aggregate ids (pairs + singletons).
+    Unmatched vertices keep their own id; ids are NOT yet renumbered."""
+    agg = jnp.full((n,), -1, jnp.int32)          # -1 = unaggregated
+    INF_NEG = jnp.asarray(-1.0, w.dtype)
+    # tie-breaking perturbation, small relative to the weight scale
+    scale = float(jnp.max(w)) if w.shape[0] else 1.0
+    w = w * (1.0 + 1e-3 * _edge_hash(rows, cols).astype(w.dtype)) \
+        if scale > 0 else w
+
+    for _ in range(max_iters):
+        un = agg < 0
+        if not bool(jnp.any(un)):
+            break
+        # strongest unaggregated neighbor of each unaggregated vertex
+        valid = un[rows] & un[cols] & (w > 0)
+        we = jnp.where(valid, w, INF_NEG)
+        wmax = jax.ops.segment_max(we, rows, num_segments=n,
+                                   indices_are_sorted=True)
+        has = wmax > 0
+        is_best = valid & (we == wmax[rows])
+        # smallest-index tiebreak -> determinism
+        best = jax.ops.segment_min(jnp.where(is_best, cols, n), rows,
+                                   num_segments=n, indices_are_sorted=True)
+        best = jnp.where(has, best, n)
+        # handshake: best[best[i]] == i
+        best_of_best = jnp.where(best < n, best[jnp.clip(best, 0, n - 1)], n)
+        idx = jnp.arange(n, dtype=best.dtype)
+        paired = (best < n) & (best_of_best == idx)
+        leader = paired & (idx < best)
+        # aggregate id = leader index
+        agg = jnp.where(leader, idx, agg)
+        agg = jnp.where(paired & ~leader, best, agg)
+    # leftovers become singletons
+    idx = jnp.arange(n, dtype=jnp.int32)
+    agg = jnp.where(agg < 0, idx, agg)
+    return agg
+
+
+def _merge_singletons(rows, cols, w, agg, n):
+    """Merge singleton aggregates into their strongest neighbor aggregate
+    (merge_singletons=1 semantics)."""
+    sizes = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), agg,
+                                num_segments=n)
+    is_singleton = sizes[agg] == 1
+    valid = is_singleton[rows] & ~is_singleton[cols] & (w > 0)
+    we = jnp.where(valid, w, -1.0)
+    wmax = jax.ops.segment_max(we, rows, num_segments=n,
+                               indices_are_sorted=True)
+    has = wmax > 0
+    is_best = valid & (we == wmax[rows])
+    best = jax.ops.segment_min(jnp.where(is_best, cols, n), rows,
+                               num_segments=n, indices_are_sorted=True)
+    target = jnp.where(has & is_singleton,
+                       agg[jnp.clip(best, 0, n - 1)], agg)
+    return jnp.where(is_singleton, target, agg).astype(jnp.int32)
+
+
+def _renumber(agg, n):
+    """Compact aggregate ids to 0..nc-1 (order-preserving, determinstic)."""
+    present = jnp.zeros((n,), jnp.int32).at[agg].set(1)
+    new_id = jnp.cumsum(present) - 1
+    nc = int(new_id[-1]) + 1
+    return new_id[agg].astype(jnp.int32), nc
+
+
+def _coarse_graph(rows, cols, w, agg, nc):
+    """Collapse the weighted graph onto aggregates (for multi-pass
+    matching): returns (crows, ccols, cw) with duplicates summed."""
+    cr = agg[rows]
+    cc = agg[cols]
+    mask = cr != cc
+    key = cr.astype(jnp.int64) * nc + cc.astype(jnp.int64)
+    key = jnp.where(mask, key, -1)
+    order = jnp.argsort(key, stable=True)
+    key_s, cr_s, cc_s, w_s = key[order], cr[order], cc[order], w[order]
+    start = int(jnp.searchsorted(key_s, 0))  # skip collapsed self-edges
+    key_s, cr_s, cc_s, w_s = (key_s[start:], cr_s[start:], cc_s[start:],
+                              w_s[start:])
+    if key_s.shape[0] == 0:
+        z = jnp.zeros((0,), jnp.int32)
+        return z, z, jnp.zeros((0,), w.dtype)
+    newseg = jnp.concatenate([jnp.ones((1,), bool), key_s[1:] != key_s[:-1]])
+    seg = jnp.cumsum(newseg) - 1
+    nuniq = int(seg[-1]) + 1
+    first = jnp.nonzero(newseg, size=nuniq)[0]
+    wsum = jax.ops.segment_sum(w_s, seg, num_segments=nuniq,
+                               indices_are_sorted=True)
+    return cr_s[first], cc_s[first], wsum
+
+
+class AggregationSelector:
+    """Base selector: setAggregates returns (aggregates (n,), num_aggregates)
+    (agg_selector.cu analog)."""
+
+    def __init__(self, cfg: Config, scope: str):
+        self.cfg = cfg
+        self.scope = scope
+        self.max_matching_iterations = int(
+            cfg.get("max_matching_iterations", scope))
+        self.merge_singletons = int(cfg.get("merge_singletons", scope))
+        self.weight_formula = int(cfg.get("weight_formula", scope))
+        self.deterministic = bool(cfg.get("determinism_flag", scope))
+
+    def set_aggregates(self, A: CsrMatrix):
+        raise NotImplementedError
+
+
+class _SizeNSelector(AggregationSelector):
+    passes = 1  # SIZE_2; 2 -> SIZE_4; 3 -> SIZE_8
+
+    def set_aggregates(self, A: CsrMatrix):
+        n = A.num_rows
+        rows, cols, w = _edge_weights(A, self.weight_formula)
+        agg = _matching_pass(rows, cols, w, n,
+                             self.max_matching_iterations)
+        if self.merge_singletons:
+            agg = _merge_singletons(rows, cols, w, agg, n)
+        agg, nc = _renumber(agg, n)
+        # later passes pair aggregates through the collapsed graph
+        for _ in range(self.passes - 1):
+            crows, ccols, cw = _coarse_graph(rows, cols, w, agg, nc)
+            if crows.shape[0] == 0:
+                break
+            cagg = _matching_pass(crows, ccols, cw, nc,
+                                  self.max_matching_iterations)
+            if self.merge_singletons:
+                cagg = _merge_singletons(crows, ccols, cw, cagg, nc)
+            cagg, nc = _renumber(cagg, nc)
+            agg = cagg[agg]
+        return agg, nc
+
+
+@registry.aggregation_selectors.register("SIZE_2")
+class Size2Selector(_SizeNSelector):
+    passes = 1
+
+
+@registry.aggregation_selectors.register("SIZE_4")
+class Size4Selector(_SizeNSelector):
+    passes = 2
+
+
+@registry.aggregation_selectors.register("SIZE_8")
+class Size8Selector(_SizeNSelector):
+    passes = 3
+
+
+@registry.aggregation_selectors.register("MULTI_PAIRWISE")
+class MultiPairwiseSelector(_SizeNSelector):
+    """Pairwise aggregation repeated `aggregation_passes` times
+    (multi_pairwise.cu analog; Notay-style weights via weight_formula)."""
+
+    def __init__(self, cfg, scope):
+        super().__init__(cfg, scope)
+        self.passes = int(cfg.get("aggregation_passes", scope))
+
+
+@registry.aggregation_selectors.register("DUMMY")
+class DummySelector(AggregationSelector):
+    """Blocks of `aggregate_size` consecutive rows (dummy selector)."""
+
+    def set_aggregates(self, A: CsrMatrix):
+        size = int(self.cfg.get("aggregate_size", self.scope))
+        n = A.num_rows
+        agg = (jnp.arange(n, dtype=jnp.int32) // size)
+        nc = int(np.ceil(n / size))
+        return agg, nc
+
+
+@registry.aggregation_selectors.register("GEO")
+@registry.aggregation_selectors.register("PARALLEL_GREEDY")
+class ParallelGreedySelector(_SizeNSelector):
+    """Greedy matching selector (parallel_greedy_selector.cu analog);
+    shares the handshaking fixed-point with SIZE_2."""
+
+    passes = 1

@@ -1,0 +1,123 @@
+"""Multigrid cycles: V, W, F, CG (K-cycle), CG-flex.
+
+Analog of src/cycles/ (fixed_cycle.cu:25-248 implements presmooth ->
+residual -> restrict -> recurse -> prolongate+correct -> postsmooth;
+v/w/f/cg_cycle.cu choose the recursion shape; registry
+src/core.cu:631-635). Here the recursion is plain Python unrolled at
+trace time over the static hierarchy depth, so a whole cycle is one XLA
+program.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops import blas
+from ..ops.spmv import residual, spmv
+
+
+def _smooth(level, data, b, x, sweeps: int):
+    if sweeps <= 0 or level.smoother is None:
+        return x
+    return level.smoother.smooth(data["smoother"], b, x, sweeps)
+
+
+def _coarse_solve(amg, data, bc, xc):
+    """Coarsest-level solve (launchCoarseSolver analog,
+    include/amg_level.h:229-242)."""
+    return amg.coarse_solver.apply(data["coarse"], bc)
+
+
+def _cycle(amg, shape: str, data, lvl: int, b, x):
+    """FixedCycle::cycle analog. `shape` in {V, W, F}; recursion count per
+    level: V=1, W=2, F=(F then V)."""
+    levels = amg.levels
+    if lvl == len(levels):
+        return _coarse_solve(amg, data, b, x)
+    level = levels[lvl]
+    ldata = data["levels"][lvl]
+    x = _smooth(level, ldata, b, x, amg._sweeps(lvl, pre=True))
+    r = residual(ldata["A"], x, b)
+    bc = level.restrict(ldata, r)
+    xc = jnp.zeros_like(bc)
+    if shape == "V":
+        xc = _cycle(amg, "V", data, lvl + 1, bc, xc)
+    elif shape == "W":
+        xc = _cycle(amg, "W", data, lvl + 1, bc, xc)
+        if lvl + 1 < len(levels):   # second visit (W shape)
+            xc = _cycle(amg, "W", data, lvl + 1, bc, xc)
+    elif shape == "F":
+        xc = _cycle(amg, "F", data, lvl + 1, bc, xc)
+        if lvl + 1 < len(levels):   # F = one F-visit then one V-visit
+            xc = _cycle(amg, "V", data, lvl + 1, bc, xc)
+    else:
+        raise ValueError(f"unknown fixed cycle {shape!r}")
+    x = x + level.prolongate(ldata, xc)
+    x = _smooth(level, ldata, b, x, amg._sweeps(lvl, pre=False))
+    return x
+
+
+def _kcycle(amg, data, lvl: int, b, x, flex: bool):
+    """CG / CGF cycle (cg_cycle.cu, cg_flex_cycle.cu): the coarse-grid
+    correction is accelerated by `cycle_iters` steps of (flexible) CG
+    whose preconditioner is the next-coarser cycle."""
+    levels = amg.levels
+    if lvl == len(levels):
+        return _coarse_solve(amg, data, b, x)
+    level = levels[lvl]
+    ldata = data["levels"][lvl]
+    x = _smooth(level, ldata, b, x, amg._sweeps(lvl, pre=True))
+    r = residual(ldata["A"], x, b)
+    bc = level.restrict(ldata, r)
+    Ac_data_lvl = lvl + 1
+
+    def M(v):
+        return _kcycle(amg, data, Ac_data_lvl, v, jnp.zeros_like(v), flex)
+
+    def Ac_mv(v):
+        if Ac_data_lvl == len(levels):
+            return spmv_coarsest(amg, data, v)
+        return spmv(data["levels"][Ac_data_lvl]["A"], v)
+
+    # a few steps of preconditioned CG on the coarse equation
+    xc = jnp.zeros_like(bc)
+    rc = bc
+    z = M(rc)
+    p = z
+    rz = blas.dot(rc, z)
+    for _ in range(max(amg.cycle_iters, 1)):
+        Ap = Ac_mv(p)
+        denom = blas.dot(p, Ap)
+        alpha = rz / jnp.where(denom == 0, 1.0, denom) * (denom != 0)
+        xc = xc + alpha * p
+        rc_old = rc
+        rc = rc - alpha * Ap
+        z = M(rc)
+        if flex:
+            # flexible (Polak-Ribiere) beta tolerates a varying M
+            num = blas.dot(rc - rc_old, z)
+        else:
+            num = blas.dot(rc, z)
+        beta = num / jnp.where(rz == 0, 1.0, rz) * (rz != 0)
+        rz = blas.dot(rc, z)
+        p = z + beta * p
+    x = x + level.prolongate(ldata, xc)
+    x = _smooth(level, ldata, b, x, amg._sweeps(lvl, pre=False))
+    return x
+
+
+def spmv_coarsest(amg, data, v):
+    """SpMV with the coarsest matrix (its CSR lives in the coarse-solver
+    data only when that solver keeps it; fall back to the stored matrix)."""
+    cd = data["coarse"]
+    return spmv(cd["A"], v)
+
+
+def run_cycle(amg, name: str, data, b, x):
+    name = name.upper()
+    if name in ("V", "W", "F"):
+        return _cycle(amg, name, data, 0, b, x)
+    if name == "CG":
+        return _kcycle(amg, data, 0, b, x, flex=False)
+    if name == "CGF":
+        return _kcycle(amg, data, 0, b, x, flex=True)
+    raise ValueError(f"unknown cycle {name!r}")

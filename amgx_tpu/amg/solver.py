@@ -1,0 +1,39 @@
+"""AMG as a Solver (registry name "AMG").
+
+Analog of AlgebraicMultigrid_Solver (src/solvers/
+algebraic_multigrid_solver.cu:34-59): setup delegates to AMG::setup, one
+solve iteration is one multigrid cycle.
+"""
+from __future__ import annotations
+
+from .. import registry
+from ..solvers.base import Solver
+from .hierarchy import AMG
+
+
+@registry.solvers.register("AMG")
+class AlgebraicMultigridSolver(Solver):
+    is_smoother = False
+
+    def __init__(self, cfg, scope="default", name="AMG"):
+        super().__init__(cfg, scope, name)
+        self.amg = AMG(cfg, scope)
+
+    def solver_setup(self):
+        self.amg.setup(self.A)
+
+    def solve_data(self):
+        d = super().solve_data()
+        d["amg"] = self.amg.solve_data()
+        return d
+
+    def computes_residual(self):
+        return False
+
+    def solve_iteration(self, data, b, st):
+        out = dict(st)
+        out["x"] = self.amg.cycle(data["amg"], b, st["x"])
+        return out
+
+    def grid_stats(self):
+        return self.amg.grid_stats()

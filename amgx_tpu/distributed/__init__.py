@@ -1,0 +1,21 @@
+"""Distributed runtime: domain decomposition over a jax.sharding.Mesh.
+
+TPU-native replacement of src/distributed/ (SURVEY §2.6): partitioning +
+halo maps (partition.py), halo exchange via XLA collectives
+(dist_matrix.py), the psum reduction context (comms.py), and the SPMD
+solve wrapper (solver.py).
+"""
+from . import comms  # noqa: F401
+from .partition import (partition_matrix, partition_vector,  # noqa: F401
+                        unpartition_vector, DistPartition)
+from .dist_matrix import ShardMatrix, shard_matrix_from_partition  # noqa: F401
+from .solver import DistributedSolver, default_mesh  # noqa: F401
+
+
+def generate_distributed_poisson7pt(nx, ny, nz, n_ranks):
+    """AMGX_generate_distributed_poisson_7pt analog
+    (src/amgx_c.cu:4731): a 7-pt Poisson partitioned into z-slabs whose
+    halos are rank +/- 1 (exercises the ppermute ring path)."""
+    from ..gallery import poisson
+    A = poisson("7pt", nx, ny, nz)
+    return A, partition_matrix(A, n_ranks)

@@ -1,0 +1,38 @@
+"""Collective-communication context.
+
+Analog of DistributedComms (include/distributed/distributed_comms.h:
+26-250) re-imagined for single-program SPMD: there is no comms *object*
+with send/recv — XLA collectives (psum / pmax / ppermute / all_gather)
+are emitted by the traced program itself. What remains of the reference
+interface is (a) this context, which tells the BLAS reductions which mesh
+axis to psum over while a distributed solve is being traced, and (b) the
+halo-exchange implementations in dist_matrix.py (the exchange_halo /
+add_from_halo analogs).
+
+The reference's two backends (MPI host-buffer staging vs GPU-direct,
+comms_mpi_hostbuffer_stream.cu / comms_mpi_gpudirect.cu) collapse to one:
+collectives ride ICI/DCN directly, chosen by the mesh topology.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+_ACTIVE_AXIS: Optional[str] = None
+
+
+@contextlib.contextmanager
+def collective_axis(name: Optional[str]):
+    """Declare the mesh axis reductions must finish over (active during
+    tracing of a shard_mapped solve)."""
+    global _ACTIVE_AXIS
+    prev = _ACTIVE_AXIS
+    _ACTIVE_AXIS = name
+    try:
+        yield
+    finally:
+        _ACTIVE_AXIS = prev
+
+
+def active_axis() -> Optional[str]:
+    return _ACTIVE_AXIS

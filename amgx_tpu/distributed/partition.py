@@ -1,0 +1,175 @@
+"""Row-block partitioning and halo-map construction.
+
+TPU-native analog of DistributedManager + DistributedArranger
+(src/distributed/distributed_manager.cu, distributed_arranger.cu). The
+reference machinery — detect neighbors from global column ids, build
+per-neighbor B2L (boundary-to-local) index maps, renumber
+interior->boundary->halo — collapses in the SPMD mesh formulation:
+
+- every shard owns `n_local = ceil(n / n_shards)` contiguous rows, padded
+  to equal size (the XLA static-shape requirement); empty padded rows are
+  harmless (zero values, zero rhs);
+- off-owned column references become one *halo gather map* per shard,
+  padded to the max halo size over shards;
+- when the partition is a 1-D domain decomposition whose halos only touch
+  ranks +/- 1 (the Poisson-slab case), per-neighbor send/recv maps are
+  built for a `ppermute` ring exchange (the B2L ring analog); otherwise
+  the exchange falls back to all_gather + static gather.
+
+Partitioning happens once at upload time on host (numpy), mirroring the
+reference's uploadMatrix/renumber path (SURVEY §3.5); everything
+downstream is device SPMD.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..errors import BadParametersError
+from ..matrix import CsrMatrix
+
+
+@dataclasses.dataclass(frozen=True)
+class DistPartition:
+    """Host-side partition product: stacked (n_ranks, ...) device arrays
+    ready to be shard_mapped over the mesh axis."""
+
+    # stacked local CSR (cols < n_local owned; >= n_local -> halo slot)
+    row_offsets: jnp.ndarray        # (R, n_local+1) int32
+    col_indices: jnp.ndarray        # (R, max_nnz) int32
+    values: jnp.ndarray             # (R, max_nnz)
+    row_ids: jnp.ndarray            # (R, max_nnz) int32 (pre-initialized)
+    diag: jnp.ndarray               # (R, n_local) local diagonal (pad 1.0)
+    halo_src: jnp.ndarray           # (R, n_halo) global row id (pad 0)
+    # ring maps (None unless neighbor-only): send rows / recv halo slots
+    send_prev: Optional[jnp.ndarray]   # (R, max_send) local row (pad n_local)
+    send_next: Optional[jnp.ndarray]
+    recv_prev: Optional[jnp.ndarray]   # (R, max_send) halo slot (pad n_halo)
+    recv_next: Optional[jnp.ndarray]
+    n_global: int
+    n_local: int
+    n_halo: int
+    n_ranks: int
+    neighbor_only: bool
+
+
+def partition_matrix(A: CsrMatrix, n_ranks: int) -> DistPartition:
+    """Split a global CsrMatrix into equal row blocks with halo maps
+    (loadDistributedMatrix / create_B2L / renumber_to_local analog)."""
+    if A.is_block:
+        raise BadParametersError(
+            "distributed block matrices not yet supported; flatten blocks")
+    if A.has_external_diag:
+        raise BadParametersError("fold external diagonal before partitioning")
+    n = A.num_rows
+    n_local = -(-n // n_ranks)
+    row_offsets = np.asarray(A.row_offsets)
+    col_indices = np.asarray(A.col_indices)
+    values = np.asarray(A.values)
+
+    ranks = []
+    max_nnz = 1
+    max_halo = 1
+    for r in range(n_ranks):
+        lo = min(r * n_local, n)
+        hi = min(lo + n_local, n)
+        s, e = int(row_offsets[lo]), int(row_offsets[hi])
+        cols_g = col_indices[s:e]
+        owned = (cols_g >= lo) & (cols_g < hi)
+        halo_global = np.unique(cols_g[~owned])
+        ranks.append((lo, hi, s, e, cols_g, owned, halo_global))
+        max_nnz = max(max_nnz, e - s)
+        max_halo = max(max_halo, halo_global.size)
+
+    R = n_ranks
+    ro = np.zeros((R, n_local + 1), np.int32)
+    ci = np.zeros((R, max_nnz), np.int32)
+    va = np.zeros((R, max_nnz), values.dtype)
+    rid = np.full((R, max_nnz), n_local - 1, np.int32)
+    dg = np.ones((R, n_local), values.dtype)
+    halo_src = np.zeros((R, max_halo), np.int64)
+    for r, (lo, hi, s, e, cols_g, owned, hg) in enumerate(ranks):
+        nr = hi - lo
+        nnz_r = e - s
+        ro[r, : nr + 1] = row_offsets[lo:hi + 1] - s
+        ro[r, nr + 1:] = ro[r, nr]
+        slot = np.searchsorted(hg, cols_g)
+        ci[r, :nnz_r] = np.where(owned, cols_g - lo, n_local + slot)
+        va[r, :nnz_r] = values[s:e]
+        rid[r, :nnz_r] = np.repeat(np.arange(nr),
+                                   np.diff(row_offsets[lo:hi + 1]))
+        halo_src[r, : hg.size] = hg
+        # local diagonal
+        local_rows = rid[r, :nnz_r]
+        is_diag = (cols_g == local_rows + lo)
+        dg[r, local_rows[is_diag]] = values[s:e][is_diag]
+
+    # ring eligibility: all halo rows on ranks r-1 / r+1
+    neighbor_only = n_ranks > 1
+    for r, (*_, hg) in enumerate(ranks):
+        if hg.size and not np.all((hg // n_local >= r - 1)
+                                  & (hg // n_local <= r + 1)):
+            neighbor_only = False
+            break
+
+    send_prev = send_next = recv_prev = recv_next = None
+    if neighbor_only:
+        max_send = 1
+        sp = [np.zeros(0, np.int64)] * R
+        sn = [np.zeros(0, np.int64)] * R
+        rp = [np.zeros(0, np.int64)] * R
+        rn_ = [np.zeros(0, np.int64)] * R
+        for r, (lo, hi, *_, hg) in enumerate(ranks):
+            src_rank = np.clip(hg // n_local, 0, R - 1)
+            from_prev = hg[src_rank == r - 1]
+            from_next = hg[src_rank == r + 1]
+            # my halo slots for those rows (hg sorted -> searchsorted)
+            rp[r] = np.searchsorted(hg, from_prev)
+            rn_[r] = np.searchsorted(hg, from_next)
+            # the neighbor must send those rows (local to the neighbor)
+            if r - 1 >= 0:
+                sn[r - 1] = from_prev - (r - 1) * n_local
+            if r + 1 < R:
+                sp[r + 1] = from_next - (r + 1) * n_local
+        for r in range(R):
+            max_send = max(max_send, sp[r].size, sn[r].size)
+        send_prev = np.full((R, max_send), n_local, np.int32)
+        send_next = np.full((R, max_send), n_local, np.int32)
+        recv_prev = np.full((R, max_send), max_halo, np.int32)
+        recv_next = np.full((R, max_send), max_halo, np.int32)
+        for r in range(R):
+            send_prev[r, : sp[r].size] = sp[r]
+            send_next[r, : sn[r].size] = sn[r]
+            recv_prev[r, : rp[r].size] = rp[r]
+            recv_next[r, : rn_[r].size] = rn_[r]
+        send_prev = jnp.asarray(send_prev)
+        send_next = jnp.asarray(send_next)
+        recv_prev = jnp.asarray(recv_prev)
+        recv_next = jnp.asarray(recv_next)
+
+    return DistPartition(
+        row_offsets=jnp.asarray(ro), col_indices=jnp.asarray(ci),
+        values=jnp.asarray(va), row_ids=jnp.asarray(rid),
+        diag=jnp.asarray(dg), halo_src=jnp.asarray(halo_src),
+        send_prev=send_prev, send_next=send_next,
+        recv_prev=recv_prev, recv_next=recv_next,
+        n_global=n, n_local=n_local, n_halo=max_halo, n_ranks=n_ranks,
+        neighbor_only=neighbor_only)
+
+
+def partition_vector(v, n_ranks: int):
+    """Split + zero-pad a global vector into stacked (n_ranks, n_local)."""
+    v = np.asarray(v)
+    n = v.shape[0]
+    n_local = -(-n // n_ranks)
+    out = np.zeros((n_ranks, n_local), v.dtype)
+    out.reshape(-1)[:n] = v
+    return jnp.asarray(out)
+
+
+def unpartition_vector(vl, n_global: int):
+    """Inverse of partition_vector (gather shards back to one host array)."""
+    return jnp.asarray(np.asarray(vl).reshape(-1)[:n_global])

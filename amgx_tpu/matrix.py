@@ -38,9 +38,9 @@ def _seg_sum(data, seg_ids, num_segments):
 @functools.partial(
     jax.tree_util.register_dataclass,
     data_fields=["row_offsets", "col_indices", "values", "diag",
-                 "row_ids", "diag_idx", "ell_cols", "ell_vals"],
+                 "row_ids", "diag_idx", "ell_cols", "ell_vals", "dia_vals"],
     meta_fields=["num_rows", "num_cols", "block_dimx", "block_dimy",
-                 "initialized"],
+                 "initialized", "dia_offsets"],
 )
 @dataclasses.dataclass(frozen=True)
 class CsrMatrix:
@@ -58,6 +58,8 @@ class CsrMatrix:
     diag_idx: Optional[Array] = None   # (n,) values-index of diagonal entry
     ell_cols: Optional[Array] = None   # (n, k) padded column ids
     ell_vals: Optional[Array] = None   # (n, k) | (n, k, bx, by)
+    dia_offsets: Optional[tuple] = None  # static tuple of diagonal offsets
+    dia_vals: Optional[Array] = None   # (k, n) per-diagonal values
     num_rows: int = 0
     num_cols: int = 0
     block_dimx: int = 1
@@ -96,9 +98,12 @@ class CsrMatrix:
         - `row_ids`: per-nnz row index (drives segmented reductions);
         - `diag_idx`: index of each row's diagonal entry in `values`
           (or -1) — used by Jacobi/GS/DILU smoothers;
-        - padded ELL layout when the row-length distribution is tight
-          (`ell='auto'`), which turns SpMV into dense gather+reduce, the
-          TPU-friendly execution shape. `ell='never'`/'always' force it.
+        - with `ell='auto'` (default): a banded DIA layout when the
+          sparsity has few distinct diagonals (stencils; SpMV becomes
+          shifted dense multiply-adds — the TPU roofline path), else a
+          padded ELL layout when the row-length distribution is tight
+          (dense gather+reduce); `ell='always'` forces ELL, `ell='never'`
+          keeps plain CSR+segsum.
         """
         n = self.num_rows
         row_nnz = jnp.diff(self.row_offsets)
@@ -115,7 +120,11 @@ class CsrMatrix:
                 ...]].set(jnp.arange(self.nnz, dtype=jnp.int32),
                           mode="drop")
         ell_cols = ell_vals = None
-        if n > 0 and ell != "never" and self.nnz > 0:
+        dia_offsets = dia_vals = None
+        if n > 0 and self.nnz > 0 and not self.is_block \
+                and not self.has_external_diag and ell == "auto":
+            dia_offsets, dia_vals = self._try_build_dia(row_ids)
+        if dia_offsets is None and n > 0 and ell != "never" and self.nnz > 0:
             max_k = int(jnp.max(row_nnz))
             mean = max(float(self.nnz) / max(n, 1), 1e-30)
             want_ell = (ell == "always") or (
@@ -124,7 +133,35 @@ class CsrMatrix:
                 ell_cols, ell_vals = self._build_ell(row_ids, row_nnz, max_k)
         return dataclasses.replace(
             self, row_ids=row_ids, diag_idx=diag_idx,
-            ell_cols=ell_cols, ell_vals=ell_vals, initialized=True)
+            ell_cols=ell_cols, ell_vals=ell_vals,
+            dia_offsets=dia_offsets, dia_vals=dia_vals, initialized=True)
+
+    # ------------------------------------------------------------------
+    DIA_MAX_OFFSETS = 32
+    DIA_FILL_RATIO = 3.0
+
+    def _try_build_dia(self, row_ids):
+        """Diagonal (DIA) storage when the sparsity is banded with few
+        distinct offsets (stencil matrices). On TPU this is the fast SpMV
+        layout: shifted dense multiply-adds, no gather at all."""
+        offs = jnp.unique(self.col_indices.astype(jnp.int64)
+                          - row_ids.astype(jnp.int64))
+        k = int(offs.shape[0])
+        n = self.num_rows
+        if k > self.DIA_MAX_OFFSETS or k * n > self.DIA_FILL_RATIO * \
+                max(self.nnz, 1):
+            return None, None
+        offsets = tuple(int(o) for o in offs)
+        return offsets, self._build_dia_vals(offsets, row_ids)
+
+    def _build_dia_vals(self, offsets, row_ids):
+        """Scatter-add CSR values onto (k, n) diagonals (duplicates sum,
+        matching the segsum/ELL paths). Shared by init and with_values."""
+        offs = jnp.asarray(offsets, jnp.int64)
+        d_idx = jnp.searchsorted(offs, self.col_indices.astype(jnp.int64)
+                                 - row_ids.astype(jnp.int64))
+        return jnp.zeros((len(offsets), self.num_rows), self.dtype).at[
+            d_idx, row_ids].add(self.values)
 
     def _ell_slots(self, row_ids, max_k: int):
         """Flat scatter targets mapping each CSR entry into (n, max_k)."""
@@ -205,6 +242,10 @@ class CsrMatrix:
             flat = out._ell_slots(self.row_ids, max_k)
             out = dataclasses.replace(
                 out, ell_vals=out._scatter_ell_vals(flat, max_k))
+        if self.initialized and self.dia_offsets is not None:
+            out = dataclasses.replace(
+                out, dia_vals=out._build_dia_vals(self.dia_offsets,
+                                                  self.row_ids))
         return out
 
     def interior_exterior_split(self, num_interior: int):

@@ -4,4 +4,4 @@ from .blas import (  # noqa: F401
     get_norm,
 )
 from .transpose import transpose  # noqa: F401
-from .spgemm import csr_multiply, galerkin_rap  # noqa: F401
+from .spgemm import csr_multiply, csr_add, galerkin_rap  # noqa: F401

@@ -39,11 +39,20 @@ def fill(x, value):
     return jnp.full_like(x, value)
 
 
+def _axis(axis_name):
+    if axis_name is not None:
+        return axis_name
+    from ..distributed import comms
+    return comms.active_axis()
+
+
 def _psum(v, axis_name):
+    axis_name = _axis(axis_name)
     return jax.lax.psum(v, axis_name) if axis_name else v
 
 
 def _pmax(v, axis_name):
+    axis_name = _axis(axis_name)
     return jax.lax.pmax(v, axis_name) if axis_name else v
 
 

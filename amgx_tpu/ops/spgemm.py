@@ -88,6 +88,19 @@ def csr_multiply(A: CsrMatrix, B: CsrMatrix) -> CsrMatrix:
         (A.block_dimx, B.block_dimy))
 
 
+def csr_add(A: CsrMatrix, B: CsrMatrix) -> CsrMatrix:
+    """C = A + B by COO concatenation + coalesce (csr_RAP_sparse_add
+    analog, include/csr_multiply.h)."""
+    assert A.shape == B.shape
+    ar, ac, av = _fold_diag(A).coo()
+    br, bc, bv = _fold_diag(B).coo()
+    rows = jnp.concatenate([ar, br])
+    cols = jnp.concatenate([ac, bc])
+    vals = jnp.concatenate([av, bv])
+    return CsrMatrix.from_coo(rows, cols, vals, A.num_rows, A.num_cols,
+                              block_dims=(A.block_dimx, A.block_dimy))
+
+
 def galerkin_rap(R: CsrMatrix, A: CsrMatrix, P: CsrMatrix) -> CsrMatrix:
     """Coarse operator A_c = R @ A @ P (csr_galerkin_product analog,
     include/csr_multiply.h:96)."""

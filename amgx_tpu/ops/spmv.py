@@ -72,10 +72,32 @@ def spmv_ell(A: CsrMatrix, x: jax.Array) -> jax.Array:
     return y
 
 
-def spmv(A: CsrMatrix, x: jax.Array) -> jax.Array:
-    """Single-device y = A @ x; dispatches on the layout chosen at init
-    (multiply_block_size analog, src/multiply.cu:50)."""
+def spmv_dia(A: CsrMatrix, x: jax.Array) -> jax.Array:
+    """y = A @ x in DIA (diagonal) storage: for each stored diagonal with
+    offset d, y += vals_d * shift(x, d). Pure dense vector multiply-adds
+    with static slices — the TPU roofline layout for stencil matrices
+    (no gather; ~2 HBM streams per diagonal)."""
+    n = A.num_rows
+    offs = A.dia_offsets
+    left = max(0, -min(offs))
+    right = max(0, n - A.num_cols + max(offs))
+    xp = jnp.pad(x, (left, right))
+    y = jnp.zeros((n,), x.dtype)
+    for i, d in enumerate(offs):
+        y = y + A.dia_vals[i] * jax.lax.dynamic_slice(xp, (left + d,), (n,))
+    return y
+
+
+def spmv(A, x: jax.Array) -> jax.Array:
+    """y = A @ x; dispatches on the layout chosen at init
+    (multiply_block_size analog, src/multiply.cu:50). Non-CsrMatrix
+    operands (distributed shard matrices, solve-operators) provide their
+    own .spmv — the Operator abstraction of include/operators/operator.h."""
+    if not isinstance(A, CsrMatrix):
+        return A.spmv(x)
     _ensure_init(A, x)
+    if A.dia_offsets is not None:
+        return spmv_dia(A, x)
     if A.ell_cols is not None:
         return spmv_ell(A, x)
     return spmv_csr_segsum(A, x)

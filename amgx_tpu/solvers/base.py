@@ -221,6 +221,8 @@ class Solver:
 
     # -- the jitted driver ----------------------------------------------
     def _build_solve_fn(self):
+        """Return the raw (unjitted) solve function; jit happens in
+        solve(), and the distributed layer shard_maps it instead."""
         max_iters = self.max_iters
         monitor = self.monitor_residual
         hist_len = max_iters + 1
@@ -277,7 +279,7 @@ class Solver:
             return (x_final, final["iters"], final["converged"],
                     final["res_norm"], norm0, final["res_hist"])
 
-        return jax.jit(solve_fn)
+        return solve_fn
 
     def solve(self, b, x0=None, zero_initial_guess: bool = False
               ) -> SolveResult:
@@ -292,7 +294,7 @@ class Solver:
             x0 = jnp.asarray(x0)
         key = (b.shape, str(b.dtype))
         if key not in self._jit_cache:
-            self._jit_cache[key] = self._build_solve_fn()
+            self._jit_cache[key] = jax.jit(self._build_solve_fn())
         t0 = time.perf_counter()
         x, iters, converged, res_norm, norm0, hist = self._jit_cache[key](
             self.solve_data(), b, x0)

@@ -1,0 +1,125 @@
+"""Benchmark entry point (run on the real TPU chip by the driver).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
+
+Headline: FGMRES + aggregation-AMG solve wall-clock on a 7-pt Poisson
+(the BASELINE.md north-star configuration, scaled to one chip).
+`vs_baseline` is measured against the reference's roofline on its own
+hardware: AmgX SpMV is HBM-bandwidth-bound, so we report our achieved
+SpMV bandwidth as a fraction of A100 peak (1555 GB/s) — the honest
+single-chip proxy until a side-by-side A100 run exists (the reference
+repo publishes no benchmark tables, BASELINE.md).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/amgx_tpu_jax_cache_tpu")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import amgx_tpu as amgx  # noqa: E402
+from amgx_tpu.config import Config  # noqa: E402
+
+A100_HBM_GBPS = 1555.0  # A2 SXM A100-40GB peak memory bandwidth
+
+
+def bench_spmv(n: int = 128, reps: int = 50):
+    """SpMV GB/s on 7-pt Poisson n^3 (ELL layout, float32 values +
+    float32 compute: the bandwidth-bound regime the reference's csrmv
+    lives in)."""
+    A = amgx.gallery.poisson("7pt", n, n, n, dtype=np.float32).init()
+    x = jnp.ones(A.num_rows, jnp.float32)
+
+    @jax.jit
+    def loop(x):
+        def body(_, x):
+            return amgx.ops.spmv(A, x) * (1.0 / 6.0)
+        return jax.lax.fori_loop(0, reps, body, x)
+
+    loop(x).block_until_ready()              # compile
+    t0 = time.perf_counter()
+    loop(x).block_until_ready()
+    dt = (time.perf_counter() - t0) / reps
+    # bytes: DIA values (k*n) + x reads per diagonal + y write
+    if A.dia_vals is not None:
+        bytes_moved = A.dia_vals.size * 4 * 2 + A.num_rows * 4
+    else:
+        bytes_moved = A.ell_cols.size * (4 + 4 + 4) + A.num_rows * 4 * 2
+    return bytes_moved / dt / 1e9, dt
+
+
+def bench_fgmres_amg(n: int = 32):
+    """FGMRES + aggregation-AMG to 1e-6 relative on 7-pt Poisson n^3
+    (FGMRES_AGGREGATION.json — milestone config 1/3 of BASELINE.md).
+
+    The hierarchy is built on the CPU backend (the eager setup path
+    compiles one executable per shape; over the axon tunnel that is
+    minutes — jit-bucketed device setup is the planned fix) and the
+    solve-phase pytree is device_put to the TPU, where the whole
+    FGMRES+V-cycle loop runs as one compiled program."""
+    cpu = jax.devices("cpu")[0]
+    tpu = jax.devices()[0]
+    cfg = Config.from_file("configs/FGMRES_AGGREGATION.json")
+    with jax.default_device(cpu):
+        A = amgx.gallery.poisson("7pt", n, n, n).init()
+        b = jnp.ones(A.num_rows)
+        slv = amgx.create_solver(cfg)
+        t0 = time.perf_counter()
+        slv.setup(A)
+        setup_s = time.perf_counter() - t0
+    data = jax.device_put(slv.solve_data(), tpu)
+    bt = jax.device_put(b, tpu)
+    x0 = jnp.zeros_like(bt)
+    fn = jax.jit(slv._build_solve_fn())
+    out = fn(data, bt, x0)
+    out[0].block_until_ready()                # compile
+    t0 = time.perf_counter()
+    x, iters, conv, rn, n0, _ = fn(data, bt, x0)
+    x.block_until_ready()
+    solve_s = time.perf_counter() - t0
+    return setup_s, solve_s, int(iters), bool(conv), \
+        float(np.max(np.asarray(rn)) / np.max(np.asarray(n0)))
+
+
+def main():
+    amgx.initialize()
+    extra = {}
+    spmv_gbps, spmv_s = bench_spmv()
+    extra["spmv_7pt_128^3_f32_gbps"] = round(spmv_gbps, 2)
+    extra["spmv_7pt_128^3_f32_ms"] = round(spmv_s * 1e3, 4)
+    try:
+        setup_s, solve_s, iters, conv, rel = bench_fgmres_amg()
+        extra.update({
+            "fgmres_agg_32^3_setup_s": round(setup_s, 3),
+            "fgmres_agg_32^3_solve_s": round(solve_s, 4),
+            "fgmres_agg_32^3_iters": iters,
+            "fgmres_agg_32^3_converged": conv,
+            "fgmres_agg_32^3_rel_residual": rel,
+        })
+        value = solve_s
+        metric = "poisson7pt_32^3 FGMRES+AggAMG solve wall-clock"
+        unit = "s"
+    except Exception as e:  # pragma: no cover - bench robustness
+        extra["fgmres_error"] = str(e)[:200]
+        value = spmv_s * 1e3
+        metric = "poisson7pt_128^3 SpMV"
+        unit = "ms"
+    print(json.dumps({
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+        "vs_baseline": round(spmv_gbps / A100_HBM_GBPS, 4),
+        "extra": extra,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -41,7 +41,9 @@ class TestMatrix:
     def test_diagonal_and_init(self):
         A = gallery.poisson("5pt", 5, 5).init()
         assert np.allclose(np.asarray(A.diagonal()), 4.0)
-        assert A.ell_cols is not None  # stencil rows are tight -> ELL chosen
+        # stencil matrix -> banded DIA layout chosen (TPU fast path)
+        assert A.dia_offsets is not None
+        assert len(A.dia_offsets) == 5
 
     def test_external_diag(self):
         # A with diagonal stored outside (DIAG property)
@@ -72,11 +74,17 @@ class TestSpmv:
         y = ops.spmv(A, x)
         assert np.allclose(np.asarray(y), dense_of(A) @ np.asarray(x))
 
-    def test_segsum_vs_ell(self):
+    def test_segsum_vs_ell_vs_dia(self):
         A = gallery.poisson("7pt", 5, 5, 5)
-        a_ell = A.init(ell="always")
+        a_dia = A.init()                    # auto -> DIA for stencils
         a_seg = A.init(ell="never")
+        assert a_dia.dia_offsets is not None and len(a_dia.dia_offsets) == 7
         x = jnp.asarray(np.random.default_rng(1).standard_normal(A.num_rows))
+        np.testing.assert_allclose(np.asarray(ops.spmv(a_dia, x)),
+                                   np.asarray(ops.spmv(a_seg, x)), rtol=1e-13)
+        # ell="always" forces the ELL path (DIA only under "auto")
+        a_ell = A.init(ell="always")
+        assert a_ell.ell_cols is not None and a_ell.dia_offsets is None
         np.testing.assert_allclose(np.asarray(ops.spmv(a_ell, x)),
                                    np.asarray(ops.spmv(a_seg, x)), rtol=1e-13)
 
