@@ -2,6 +2,7 @@
 "AMG" solver (registerClasses analog for L4)."""
 from . import hierarchy  # noqa: F401
 from . import aggregation  # noqa: F401
+from . import classical  # noqa: F401
 from . import solver  # noqa: F401
 
 from .hierarchy import AMG, AMGLevel  # noqa: F401

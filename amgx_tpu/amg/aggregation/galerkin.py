@@ -16,28 +16,11 @@ from ...matrix import CsrMatrix
 
 
 def coarse_a_from_aggregates(A: CsrMatrix, agg, nc: int) -> CsrMatrix:
-    """A_c[I,J] = sum_{agg[i]==I, agg[j]==J} A[i,j]."""
+    """A_c[I,J] = sum_{agg[i]==I, agg[j]==J} A[i,j]: relabel the COO
+    entries by aggregate id and let from_coo coalesce duplicates."""
     rows, cols, vals = A.coo()
-    cr = agg[rows].astype(jnp.int64)
-    cc = agg[cols].astype(jnp.int64)
-    key = cr * nc + cc
-    order = jnp.argsort(key, stable=True)
-    key_s = key[order]
-    vals_s = vals[order]
-    newseg = jnp.concatenate([jnp.ones((1,), bool), key_s[1:] != key_s[:-1]])
-    seg = jnp.cumsum(newseg) - 1
-    nuniq = int(seg[-1]) + 1
-    first = jnp.nonzero(newseg, size=nuniq)[0]
-    v = jax.ops.segment_sum(vals_s, seg, num_segments=nuniq,
-                            indices_are_sorted=True)
-    kk = key_s[first]
-    out_rows = (kk // nc).astype(jnp.int32)
-    out_cols = (kk % nc).astype(jnp.int32)
-    counts = jnp.bincount(out_rows, length=nc)
-    row_offsets = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts).astype(jnp.int32)])
-    Ac = CsrMatrix.from_scipy_like(row_offsets, out_cols, v, nc, nc,
-                                   (A.block_dimx, A.block_dimy))
+    Ac = CsrMatrix.from_coo(agg[rows], agg[cols], vals, nc, nc,
+                            block_dims=(A.block_dimx, A.block_dimy))
     if A.has_external_diag:
         # fold external diagonal contributions into the coarse entries:
         # diag blocks land on (agg[i], agg[i])

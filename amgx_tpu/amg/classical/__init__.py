@@ -1,0 +1,83 @@
+"""Classical Ruge-Stuben AMG level.
+
+Analog of src/classical/classical_amg_level.cu (987 LoC): strength of
+connection -> CF-splitting (selector) -> interpolation P -> R = P^T ->
+Galerkin RAP (createCoarseVertices :213, createCoarseMatrices :254-341).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ... import registry
+from ...matrix import CsrMatrix
+from ...ops.spgemm import galerkin_rap
+from ...ops.spmv import spmv
+from ...ops.transpose import transpose
+from ..hierarchy import AMGLevel
+from . import strength as _strength  # noqa: F401
+from . import selectors as _selectors  # noqa: F401
+from . import interpolators as _interpolators  # noqa: F401
+
+
+@registry.amg_levels.register("CLASSICAL")
+class ClassicalAMGLevel(AMGLevel):
+    algorithm = "CLASSICAL"
+
+    def create_coarse_vertices(self):
+        """Strength + CF-split (markCoarseFinePoints analog,
+        classical_amg_level.cu:345)."""
+        if self.A.is_block:
+            from ...errors import BadParametersError
+            raise BadParametersError(
+                "CLASSICAL AMG supports scalar matrices only (the reference "
+                "has the same restriction); use algorithm=AGGREGATION for "
+                "block matrices")
+        cfg, scope = self.cfg, self.scope
+        st = registry.strength.create(str(cfg.get("strength", scope)),
+                                      cfg, scope)
+        self.strong = st.strong_mask(self.A)
+        sel_name = str(cfg.get("selector", scope))
+        # aggressive coarsening on the first `aggressive_levels` levels
+        aggressive = self.level_index < int(cfg.get("aggressive_levels",
+                                                    scope))
+        if aggressive:
+            agg_sel = str(cfg.get("aggressive_selector", scope))
+            if agg_sel == "DEFAULT":
+                agg_sel = "AGGRESSIVE_" + sel_name if not \
+                    sel_name.startswith("AGGRESSIVE") else sel_name
+            sel_name = agg_sel
+        if not registry.classical_selectors.has(sel_name):
+            sel_name = "PMIS"
+        sel = registry.classical_selectors.create(sel_name, cfg, scope)
+        self.cf_map = sel.mark_coarse_fine_points(self.A, self.strong)
+        self.coarse_size = int(jnp.sum(self.cf_map == 1))
+        self._aggressive = aggressive
+
+    def create_coarse_matrix(self) -> CsrMatrix:
+        """P (interpolator), R = P^T, RAP
+        (computeProlongationOperator :406, computeRestrictionOperator
+        :441, csr_galerkin_product)."""
+        cfg, scope = self.cfg, self.scope
+        interp_name = str(cfg.get("interpolator", scope))
+        if self._aggressive:
+            interp_name = str(cfg.get("aggressive_interpolator", scope))
+        if not registry.interpolators.has(interp_name):
+            interp_name = "D1"
+        interp = registry.interpolators.create(interp_name, cfg, scope)
+        self.P = interp.generate(self.A, self.cf_map, self.strong).init(
+            ell="never")
+        self.R = transpose(self.P).init(ell="never")
+        return galerkin_rap(self.R, self.A, self.P)
+
+    def level_data(self):
+        d = super().level_data()
+        d["P"] = self.P
+        d["R"] = self.R
+        return d
+
+    def restrict(self, data, r):
+        return spmv(data["R"], r)
+
+    def prolongate(self, data, xc):
+        return spmv(data["P"], xc)

@@ -1,0 +1,249 @@
+"""Classical interpolation operators.
+
+Analogs of src/classical/interpolators/ (distance1.cu 900 LoC,
+distance2.cu 2274 LoC, multipass.cu). Round-1 surface:
+
+- D1: Ruge-Stuben *direct* interpolation with positive-coupling lumping.
+  For a fine point i with strong coarse neighbors C_i:
+
+      w_ij = -alpha_i * a_ij / ~a_ii        for j in C_i (a_ij < 0)
+      alpha_i = sum_{k != i, a_ik<0} a_ik / sum_{j in C_i, a_ij<0} a_ij
+      ~a_ii   = a_ii + sum_{k != i, a_ik>0, k not in C_i} a_ik
+
+  Coarse points interpolate by injection (P row = e_c). All assembled
+  with COO masks + segment sums (no per-row loops).
+- Truncation (interp_truncation_factor / interp_max_elements) trims P
+  and rescales rows to preserve the row sum (truncate analog).
+- MULTIPASS falls back to D1 after aggressive coarsening (full
+  multipass interpolation is a later-round item, tracked in SURVEY §7).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import registry
+from ...matrix import CsrMatrix
+
+
+def _coarse_index(cf_map):
+    """coarse id per vertex (valid where cf_map==COARSE); nc."""
+    is_c = cf_map == 1
+    cidx = jnp.cumsum(is_c.astype(jnp.int32)) - 1
+    nc = int(cidx[-1]) + 1 if cf_map.shape[0] else 0
+    return jnp.where(is_c, cidx, -1), nc
+
+
+class Interpolator:
+    def __init__(self, cfg, scope):
+        self.cfg = cfg
+        self.scope = scope
+        self.trunc_factor = float(cfg.get("interp_truncation_factor", scope))
+        self.max_elements = int(cfg.get("interp_max_elements", scope))
+
+    def generate(self, A: CsrMatrix, cf_map, strong) -> CsrMatrix:
+        raise NotImplementedError
+
+
+@registry.interpolators.register("D2")
+class Distance2Interpolator(Interpolator):
+    """Extended+i distance-two interpolation (distance2.cu analog; the
+    formula of De Sterck/Falgout/Nolting/Yang, "Distance-two
+    interpolation for parallel algebraic multigrid", 2008):
+
+        w_ij = -(1/D_i) [ a_ij 1{j in C^_i}
+                          + sum_{k in F_i^s} a_ik abar_kj / d_ik ]
+        d_ik = sum_{l in C^_i + {i}} abar_kl
+        D_i  = a_ii + sum_{n weak, n not in C^_i} a_in
+                    + sum_{k in F_i^s} a_ik abar_ki / d_ik
+
+    with C^_i = C_i + union of strong-C neighbors of i's strong-F
+    neighbors, and abar the negative-coupling part of A. Everything is
+    COO expands + segment sums: the two-hop triple expansion reuses the
+    SpGEMM machinery, membership tests are sorted-key searches. This is
+    what makes PMIS-coarsened V-cycles scalable (the D1 rate degrades
+    with depth)."""
+
+    def generate(self, A: CsrMatrix, cf_map, strong) -> CsrMatrix:
+        from ...ops.spgemm import _expand, csr_multiply
+        n = A.num_rows
+        rows, cols, vals = A.coo()
+        rows64 = rows.astype(jnp.int64)
+        cols64 = cols.astype(jnp.int64)
+        diag = A.diagonal()
+        sgn = jnp.sign(jnp.where(diag == 0, 1.0, diag))
+        offd = rows != cols
+        neg = offd & (vals * sgn[rows] < 0)      # abar pattern
+        is_C = cf_map == 1
+        cidx, nc = _coarse_index(cf_map)
+        strongC = strong & is_C[cols]
+        strongF = strong & ~is_C[cols] & offd
+
+        def filtered(mask):
+            """CSR keeping only masked entries (host-side compress)."""
+            m = np.asarray(mask)
+            r = np.asarray(rows)[m]
+            c = np.asarray(cols)[m]
+            v = np.asarray(vals)[m]
+            counts = np.bincount(r, minlength=n)
+            ro = np.zeros(n + 1, np.int32)
+            np.cumsum(counts, out=ro[1:])
+            return CsrMatrix.from_scipy_like(ro, c.astype(np.int32),
+                                             jnp.asarray(v), n, n)
+
+        Fmat = filtered(strongF)                  # i -> k (strong F)
+        Abar = filtered(neg)                      # k -> m (neg couplings)
+
+        # C-hat membership set: strong C neighbors + two-hop through F
+        Sc01 = filtered(strongC)
+        Sc01 = CsrMatrix.from_scipy_like(
+            Sc01.row_offsets, Sc01.col_indices,
+            jnp.ones_like(Sc01.values), n, n)
+        Sf01 = CsrMatrix.from_scipy_like(
+            Fmat.row_offsets, Fmat.col_indices,
+            jnp.ones_like(Fmat.values), n, n)
+        H = csr_multiply(Sf01, Sc01)
+        hr, hc, hv = H.coo()
+        scr, scc, _ = Sc01.coo()
+        chat_keys = np.unique(np.concatenate([
+            np.asarray(scr, np.int64) * n + np.asarray(scc),
+            np.asarray(hr, np.int64)[np.asarray(hv) > 0] * n
+            + np.asarray(hc)[np.asarray(hv) > 0]]))
+        chat_keys_j = jnp.asarray(chat_keys)
+
+        def member(ri, cj):
+            key = ri.astype(jnp.int64) * n + cj.astype(jnp.int64)
+            pos = jnp.clip(jnp.searchsorted(chat_keys_j, key), 0,
+                           max(len(chat_keys) - 1, 0))
+            if len(chat_keys) == 0:
+                return jnp.zeros(key.shape, bool)
+            return chat_keys_j[pos] == key
+
+        # two-hop triples (i -k-> m)
+        t_rows, t_m, src_f, src_b = _expand(Fmat, Abar)
+        t_i = t_rows
+        t_k = Fmat.col_indices[src_f]
+        t_aik = Fmat.values[src_f]
+        t_abar = Abar.values[src_b]
+        keep = member(t_i, t_m) | (t_m == t_i)
+        denom = jax.ops.segment_sum(jnp.where(keep, t_abar, 0.0), src_f,
+                                    num_segments=Fmat.nnz)
+        bad = denom == 0                          # k distributes nowhere
+        dsafe = jnp.where(bad, 1.0, denom)
+        contrib = t_aik * t_abar / dsafe[src_f]
+        contrib = jnp.where(bad[src_f], 0.0, contrib)
+
+        # interpolatory entries: triples landing on C points in C-hat
+        m_is_entry = keep & is_C[t_m] & (t_m != t_i)
+        e_rows = t_i[m_is_entry]
+        e_cols = t_m[m_is_entry]
+        e_vals = contrib[m_is_entry]
+        # direct part: a_ij for neighbors j in C-hat
+        dmask = offd & is_C[cols] & member(rows, cols)
+        # diagonal D_i: weak lumping + the "+i" feedback terms
+        fb = jax.ops.segment_sum(
+            jnp.where(keep & (t_m == t_i), contrib, 0.0), t_i,
+            num_segments=n)
+        lump_mask = offd & ~member(rows, cols) & ~strongF
+        lump = jax.ops.segment_sum(jnp.where(lump_mask, vals, 0.0), rows,
+                                   num_segments=n, indices_are_sorted=True)
+        # strong-F neighbors whose denominator collapsed: lump them too
+        f_row_ids = Fmat.coo()[0]
+        bad_f = jax.ops.segment_sum(jnp.where(bad, Fmat.values, 0.0),
+                                    f_row_ids, num_segments=n)
+        D = diag + lump + fb + bad_f
+
+        all_rows = jnp.concatenate([rows[dmask], e_rows])
+        all_cols = jnp.concatenate([cols[dmask], e_cols])
+        all_vals = jnp.concatenate([vals[dmask], e_vals])
+        f_row = (cf_map == 0)[all_rows]
+        w = -all_vals / jnp.where(D[all_rows] == 0, 1.0, D[all_rows])
+        c_rows = jnp.where(cf_map == 1)[0].astype(jnp.int32)
+        p_rows = jnp.concatenate([all_rows[f_row], c_rows])
+        p_cols = jnp.concatenate([cidx[all_cols[f_row]], cidx[c_rows]])
+        p_vals = jnp.concatenate([w[f_row],
+                                  jnp.ones((nc,), vals.dtype)])
+        P = CsrMatrix.from_coo(p_rows, p_cols, p_vals, n, nc)
+        return _truncate(P, self.trunc_factor, self.max_elements)
+
+
+@registry.interpolators.register("D1")
+@registry.interpolators.register("MULTIPASS")
+class Distance1Interpolator(Interpolator):
+    def generate(self, A: CsrMatrix, cf_map, strong) -> CsrMatrix:
+        n = A.num_rows
+        rows, cols, vals = A.coo()
+        diag = A.diagonal()
+        cidx, nc = _coarse_index(cf_map)
+        is_f_row = (cf_map == 0)[rows]
+        neg = vals < 0
+        offd = rows != cols
+        in_Ci = strong & (cidx[cols] >= 0) & neg & offd
+
+        sum_neg = jax.ops.segment_sum(jnp.where(offd & neg, vals, 0.0),
+                                      rows, num_segments=n,
+                                      indices_are_sorted=True)
+        sum_Ci = jax.ops.segment_sum(jnp.where(in_Ci, vals, 0.0),
+                                     rows, num_segments=n,
+                                     indices_are_sorted=True)
+        # positive off-diagonals not interpolated from: lump into diagonal
+        pos_lump = jax.ops.segment_sum(
+            jnp.where(offd & ~neg, vals, 0.0), rows, num_segments=n,
+            indices_are_sorted=True)
+        dmod = diag + pos_lump
+        alpha = sum_neg / jnp.where(sum_Ci == 0, 1.0, sum_Ci)
+        alpha = jnp.where(sum_Ci == 0, 0.0, alpha)
+        w = -alpha[rows] * vals / jnp.where(dmod[rows] == 0, 1.0, dmod[rows])
+
+        # P entries: F rows interpolate from C_i; C rows inject
+        mask = in_Ci & is_f_row
+        p_rows = jnp.concatenate([rows[mask],
+                                  jnp.where(cf_map == 1)[0].astype(jnp.int32)])
+        p_cols = jnp.concatenate([cidx[cols[mask]],
+                                  cidx[jnp.where(cf_map == 1)[0]]])
+        p_vals = jnp.concatenate([w[mask],
+                                  jnp.ones((nc,), vals.dtype)])
+        P = CsrMatrix.from_coo(p_rows, p_cols, p_vals, n, nc)
+        return _truncate(P, self.trunc_factor, self.max_elements)
+
+
+def _truncate(P: CsrMatrix, factor: float, max_elements: int) -> CsrMatrix:
+    """Drop small interpolation entries / cap per-row count, rescaling to
+    preserve row sums (src/truncate.cu semantics for P)."""
+    if factor > 1.0 and max_elements <= 0:
+        return P
+    rows, cols, vals = P.coo()
+    n = P.num_rows
+    absv = jnp.abs(vals)
+    keep = jnp.ones_like(vals, bool)
+    if factor <= 1.0:
+        rmax = jax.ops.segment_max(absv, rows, num_segments=n,
+                                   indices_are_sorted=True)
+        keep &= absv >= factor * rmax[rows]
+    if max_elements > 0:
+        # keep only the max_elements largest |entries| per row: rank by
+        # (row, -|v|) and drop ranks beyond the cap (host-side; the
+        # entry count is per-level-small and this runs once at setup)
+        rnp = np.asarray(rows)
+        ordn = np.lexsort((-np.asarray(absv), rnp))
+        _, first = np.unique(rnp[ordn], return_index=True)
+        grp = np.zeros(len(ordn), np.int64)
+        grp[first] = 1
+        gid = np.cumsum(grp) - 1
+        within = np.arange(len(ordn)) - first[gid]
+        keep_np = np.array(keep)        # copy: jax buffers are read-only
+        keep_np[ordn] &= within < max_elements
+        keep = jnp.asarray(keep_np)
+    # rescale kept entries to preserve row sums
+    rowsum = jax.ops.segment_sum(vals, rows, num_segments=n,
+                                 indices_are_sorted=True)
+    keptsum = jax.ops.segment_sum(jnp.where(keep, vals, 0.0), rows,
+                                  num_segments=n, indices_are_sorted=True)
+    scale = rowsum / jnp.where(keptsum == 0, 1.0, keptsum)
+    scale = jnp.where(keptsum == 0, 1.0, scale)
+    kn = np.asarray(keep)
+    rows_k = np.asarray(rows)[kn]
+    cols_k = np.asarray(cols)[kn]
+    vals_k = np.asarray(vals * scale[rows])[kn]
+    return CsrMatrix.from_coo(rows_k, cols_k, vals_k, n, P.num_cols)

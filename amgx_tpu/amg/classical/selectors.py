@@ -1,0 +1,127 @@
+"""CF-splitting selectors: PMIS / HMIS and aggressive variants.
+
+Analogs of src/classical/selectors/ (pmis.cu 657 LoC, hmis.cu,
+aggressive_*.cu, selector.cu). PMIS (parallel modified independent set)
+is a natural TPU fit — it is already a data-parallel fixed point:
+
+  weight w_i = strong-degree(i) + hash(i)        (deterministic "random")
+  repeat:  undecided i with w_i greater than every undecided strong
+           neighbor's weight becomes COARSE; undecided neighbors of new
+           COARSE points become FINE.
+
+expressed as segment-max sweeps over the symmetrized strength graph.
+HMIS runs PMIS on the distance-two strength graph restricted to a
+first-pass independent set; here (round 1) HMIS shares the PMIS fixed
+point on S, and the AGGRESSIVE_* variants run the same fixed point on
+S@S (two-hop strength), giving the reference's aggressive-coarsening
+grid-size behavior.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import registry
+from ...matrix import CsrMatrix
+
+FINE, COARSE, UNDECIDED = 0, 1, -1
+
+
+def _hash01(n):
+    i = jnp.arange(n, dtype=jnp.uint32)
+    h = i * jnp.uint32(2654435761)
+    h = (h ^ (h >> 16)) * jnp.uint32(0x45D9F3B)
+    h = h ^ (h >> 16)
+    return (h & jnp.uint32(0xFFFFF)).astype(jnp.float64) / float(1 << 20)
+
+
+def _symmetrize(rows, cols, mask, n):
+    """Edges of S | S^T as (rows2, cols2) with duplicates kept (harmless
+    for max/any reductions)."""
+    r = jnp.concatenate([rows[mask], cols[mask]])
+    c = jnp.concatenate([cols[mask], rows[mask]])
+    order = jnp.argsort(r, stable=True)
+    return r[order], c[order]
+
+
+def pmis_split(A: CsrMatrix, strong, max_iters: int = 30):
+    """Returns cf_map (n,) in {FINE, COARSE}."""
+    n = A.num_rows
+    rows, cols, _ = A.coo()
+    sr, sc = _symmetrize(rows, cols, strong, n)
+    deg = jnp.zeros((n,), jnp.float64).at[sr].add(1.0) * 0.5
+    w = deg + _hash01(n)
+    state = jnp.full((n,), UNDECIDED, jnp.int32)
+    # isolated points (no strong connections): they cannot interpolate —
+    # make them COARSE (kept exactly, matches Dirichlet-row handling)
+    has_nbr = jnp.zeros((n,), bool).at[sr].set(True)
+    state = jnp.where(~has_nbr, COARSE, state)
+
+    for _ in range(max_iters):
+        und = state == UNDECIDED
+        if not bool(jnp.any(und)):
+            break
+        active_edge = und[sr] & und[sc]
+        nbr_max = jax.ops.segment_max(
+            jnp.where(active_edge, w[sc], -jnp.inf), sr, num_segments=n,
+            indices_are_sorted=True)
+        new_c = und & (w > nbr_max)
+        state = jnp.where(new_c, COARSE, state)
+        # undecided points strongly connected to any C point become FINE
+        c_nbr = jnp.zeros((n,), bool).at[sr].max(state[sc] == COARSE)
+        state = jnp.where((state == UNDECIDED) & c_nbr, FINE, state)
+    state = jnp.where(state == UNDECIDED, FINE, state)
+    return state.astype(jnp.int32)
+
+
+def _two_hop_strength(A: CsrMatrix, strong):
+    """Boolean S@S (distance-2 strength) as a COO edge list, built with
+    the sort-based expand machinery (aggressive coarsening graph)."""
+    from ...ops.spgemm import csr_multiply
+    rows, cols, vals = A.coo()
+    sv = jnp.where(strong, 1.0, 0.0)
+    S = CsrMatrix(row_offsets=A.row_offsets, col_indices=A.col_indices,
+                  values=sv, num_rows=A.num_rows, num_cols=A.num_cols)
+    S2 = csr_multiply(S, S)
+    return S2
+
+
+class ClassicalSelector:
+    def __init__(self, cfg, scope):
+        self.cfg = cfg
+        self.scope = scope
+
+    def mark_coarse_fine_points(self, A: CsrMatrix, strong):
+        raise NotImplementedError
+
+
+@registry.classical_selectors.register("PMIS")
+@registry.classical_selectors.register("HMIS")
+class PMISSelector(ClassicalSelector):
+    def mark_coarse_fine_points(self, A, strong):
+        return pmis_split(A, strong)
+
+
+@registry.classical_selectors.register("AGGRESSIVE_PMIS")
+@registry.classical_selectors.register("AGGRESSIVE_HMIS")
+class AggressivePMISSelector(ClassicalSelector):
+    """PMIS on the two-hop strength graph -> much smaller coarse grids
+    (aggressive_pmis.cu behavior)."""
+
+    def mark_coarse_fine_points(self, A, strong):
+        S2 = _two_hop_strength(A, strong)
+        r2, c2, v2 = S2.coo()
+        strong2 = (v2 > 0) & (r2 != c2)
+        return pmis_split(S2, strong2)
+
+
+@registry.classical_selectors.register("CR")
+@registry.classical_selectors.register("DUMMY_CLASSICAL")
+class DummyClassicalSelector(ClassicalSelector):
+    """Every other point coarse (dummy selector analog; also stands in
+    for CR until compatible relaxation lands)."""
+
+    def mark_coarse_fine_points(self, A, strong):
+        n = A.num_rows
+        return (jnp.arange(n, dtype=jnp.int32) % 2 == 0).astype(jnp.int32)

@@ -23,8 +23,14 @@ def _smooth(level, data, b, x, sweeps: int):
 
 def _coarse_solve(amg, data, bc, xc):
     """Coarsest-level solve (launchCoarseSolver analog,
-    include/amg_level.h:229-242)."""
-    return amg.coarse_solver.apply(data["coarse"], bc)
+    include/amg_level.h:229-242). Relaxation-type coarse solvers run
+    `coarsest_sweeps` sweeps (reference parameter); direct/Krylov coarse
+    solvers use their own apply."""
+    cs = amg.coarse_solver
+    if cs.is_smoother and cs.name not in ("DENSE_LU_SOLVER", "NOSOLVER",
+                                          "DUMMY"):
+        return cs.smooth(data["coarse"], bc, xc, amg.coarsest_sweeps)
+    return cs.apply(data["coarse"], bc)
 
 
 def _cycle(amg, shape: str, data, lvl: int, b, x):
@@ -84,13 +90,16 @@ def _kcycle(amg, data, lvl: int, b, x, flex: bool):
     z = M(rc)
     p = z
     rz = blas.dot(rc, z)
-    for _ in range(max(amg.cycle_iters, 1)):
+    k_iters = max(amg.cycle_iters, 1)
+    for it in range(k_iters):
         Ap = Ac_mv(p)
         denom = blas.dot(p, Ap)
         alpha = rz / jnp.where(denom == 0, 1.0, denom) * (denom != 0)
         xc = xc + alpha * p
         rc_old = rc
         rc = rc - alpha * Ap
+        if it + 1 == k_iters:
+            break   # last update: skip the unused trailing M()/beta/p
         z = M(rc)
         if flex:
             # flexible (Polak-Ribiere) beta tolerates a varying M

@@ -100,6 +100,7 @@ class AMG:
             n = Af.num_rows
             stop = (lvl + 1 >= self.max_levels
                     or n <= max(self.min_coarse_rows, 1)
+                    or n < self.min_fine_rows
                     or n <= self.dense_lu_num_rows and lvl > 0)
             if stop:
                 break

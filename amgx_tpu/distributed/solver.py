@@ -67,12 +67,10 @@ class DistributedSolver:
     # -- setup -----------------------------------------------------------
     def setup(self, A: CsrMatrix):
         t0 = time.perf_counter()
+        import dataclasses
         part = partition_matrix(A, self.n_ranks)
-        self.shard_A = shard_matrix_from_partition(part)
-        self.shard_A = ShardMatrix(**{
-            **{f.name: getattr(self.shard_A, f.name)
-               for f in self.shard_A.__dataclass_fields__.values()},
-            "axis_name": self.axis})
+        self.shard_A = dataclasses.replace(
+            shard_matrix_from_partition(part), axis_name=self.axis)
         self.part = part
         # wire the solver chain: A views + per-shard Jacobi data
         s = self.solver
