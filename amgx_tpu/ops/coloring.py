@@ -1,0 +1,203 @@
+"""Parallel graph coloring for multicolor smoothers.
+
+Analog of src/matrix_coloring/ (10 schemes, 6860 LoC of CUDA; registry
+src/core.cu:669-678). The workhorse is Jones-Plassmann-Luby expressed as
+segment-max fixed points (the same machinery as PMIS/matching):
+
+- MIN_MAX: per round, uncolored local *maxima* of a hash weight get the
+  round's low color and local *minima* the round's high color (two colors
+  per round, min_max.cu behavior);
+- MULTI_HASH: several independent hashes per round (multi_hash.cu);
+- MIN_MAX_2RING / GREEDY_MIN_MAX_2RING: the same fixed point run on the
+  squared adjacency graph (distance-2 coloring, needed by ILU/DILU with
+  reordering);
+- ROUND_ROBIN / UNIFORM: trivial index-based colorings (round_robin.cu,
+  uniform.cu);
+- SERIAL_GREEDY_BFS: host-side deterministic greedy (quality reference).
+
+Returns a Coloring(row_colors, num_colors). Colorings are validated by
+tests the way src/tests/valid_coloring.cu does: no edge joins two
+vertices of one color.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import registry
+from ..matrix import CsrMatrix
+
+
+@dataclasses.dataclass(frozen=True)
+class Coloring:
+    row_colors: jax.Array          # (n,) int32
+    num_colors: int
+
+    def color_counts(self):
+        return jnp.bincount(self.row_colors, length=self.num_colors)
+
+
+def _hash_w(n, salt: int):
+    i = jnp.arange(n, dtype=jnp.uint32)
+    h = (i + jnp.uint32(salt * 0x9E3779B9)) * jnp.uint32(2654435761)
+    h = (h ^ (h >> 15)) * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    return h
+
+
+def _sym_edges(A: CsrMatrix):
+    rows, cols, _ = A.coo()
+    offd = rows != cols
+    r = jnp.concatenate([rows[offd], cols[offd]])
+    c = jnp.concatenate([cols[offd], rows[offd]])
+    order = jnp.argsort(r, stable=True)
+    return r[order], c[order]
+
+
+def _jpl_min_max(A: CsrMatrix, max_rounds: int = 64, use_min: bool = True,
+                 edges=None):
+    """Jones-Plassmann-Luby with (max, min) extraction per round."""
+    n = A.num_rows
+    sr, sc = _sym_edges(A) if edges is None else edges
+    colors = jnp.full((n,), -1, jnp.int32)
+    has_nbr = jnp.zeros((n,), bool).at[sr].set(True)
+    colors = jnp.where(~has_nbr, 0, colors)       # isolated: color 0
+    next_color = 0
+    for rnd in range(max_rounds):
+        un = colors < 0
+        if not bool(jnp.any(un)):
+            break
+        w = _hash_w(n, rnd)
+        active = un[sr] & un[sc]
+        nmax = jax.ops.segment_max(
+            jnp.where(active, w[sc], jnp.uint32(0)), sr, num_segments=n,
+            indices_are_sorted=True)
+        is_max = un & (w > nmax)
+        colors = jnp.where(is_max, next_color, colors)
+        next_color += 1
+        if use_min:
+            un = colors < 0
+            if not bool(jnp.any(un)):
+                break
+            active = un[sr] & un[sc]
+            nmin = jax.ops.segment_min(
+                jnp.where(active, w[sc], jnp.uint32(0xFFFFFFFF)), sr,
+                num_segments=n, indices_are_sorted=True)
+            is_min = un & (w < nmin)
+            colors = jnp.where(is_min, next_color, colors)
+            next_color += 1
+    colors = jnp.where(colors < 0, next_color, colors)  # stragglers
+    num = int(jnp.max(colors)) + 1 if n else 0
+    return Coloring(colors.astype(jnp.int32), num)
+
+
+def _square_edges(A: CsrMatrix):
+    """Distance-2 adjacency (pattern of A@A) as symmetric edges."""
+    from .spgemm import csr_multiply
+    rows, cols, _ = A.coo()
+    pattern = CsrMatrix(row_offsets=A.row_offsets,
+                        col_indices=A.col_indices,
+                        values=jnp.ones((A.nnz,), jnp.float64),
+                        num_rows=A.num_rows, num_cols=A.num_cols)
+    S2 = csr_multiply(pattern, pattern)
+    r2, c2, v2 = S2.coo()
+    keep = np.asarray(v2) > 0
+    r = jnp.concatenate([r2[keep], c2[keep]])
+    c = jnp.concatenate([c2[keep], r2[keep]])
+    order = jnp.argsort(r, stable=True)
+    return r[order], c[order]
+
+
+class MatrixColoring:
+    """Base (include/matrix_coloring/matrix_coloring.h:27)."""
+
+    def __init__(self, cfg, scope):
+        self.cfg = cfg
+        self.scope = scope
+        self.coloring_level = int(cfg.get("coloring_level", scope))
+
+    def color_matrix(self, A: CsrMatrix) -> Coloring:
+        raise NotImplementedError
+
+
+@registry.matrix_coloring.register("MIN_MAX")
+@registry.matrix_coloring.register("PARALLEL_GREEDY")
+@registry.matrix_coloring.register("GREEDY_RECOLOR")
+@registry.matrix_coloring.register("LOCALLY_DOWNWIND")
+class MinMaxColoring(MatrixColoring):
+    def color_matrix(self, A):
+        if self.coloring_level >= 2:
+            return _jpl_min_max(A, edges=_square_edges(A))
+        return _jpl_min_max(A)
+
+
+@registry.matrix_coloring.register("MIN_MAX_2RING")
+@registry.matrix_coloring.register("GREEDY_MIN_MAX_2RING")
+class MinMax2RingColoring(MatrixColoring):
+    def color_matrix(self, A):
+        return _jpl_min_max(A, edges=_square_edges(A))
+
+
+@registry.matrix_coloring.register("MULTI_HASH")
+class MultiHashColoring(MatrixColoring):
+    def __init__(self, cfg, scope):
+        super().__init__(cfg, scope)
+        self.max_num_hash = int(cfg.get("max_num_hash", scope))
+
+    def color_matrix(self, A):
+        # several independent hash rounds folded into the same fixed point
+        return _jpl_min_max(A, max_rounds=max(self.max_num_hash * 4, 16))
+
+
+@registry.matrix_coloring.register("ROUND_ROBIN")
+class RoundRobinColoring(MatrixColoring):
+    def __init__(self, cfg, scope):
+        super().__init__(cfg, scope)
+        self.num_colors = int(cfg.get("num_colors", scope))
+
+    def color_matrix(self, A):
+        c = jnp.arange(A.num_rows, dtype=jnp.int32) % self.num_colors
+        return Coloring(c, min(self.num_colors, max(A.num_rows, 1)))
+
+
+@registry.matrix_coloring.register("UNIFORM")
+class UniformColoring(MatrixColoring):
+    """Geometric striping (uniform.cu): valid for banded stencils whose
+    bandwidth is below num_colors."""
+
+    def __init__(self, cfg, scope):
+        super().__init__(cfg, scope)
+        self.num_colors = int(cfg.get("num_colors", scope))
+
+    def color_matrix(self, A):
+        return RoundRobinColoring.color_matrix(self, A)
+
+
+@registry.matrix_coloring.register("SERIAL_GREEDY_BFS")
+class SerialGreedyBfsColoring(MatrixColoring):
+    """Host-side first-fit greedy in BFS order (serial_greedy_bfs.cu):
+    the quality/determinism reference the parallel schemes are judged
+    against."""
+
+    def color_matrix(self, A):
+        n = A.num_rows
+        ro = np.asarray(A.row_offsets)
+        ci = np.asarray(A.col_indices)
+        colors = np.full(n, -1, np.int32)
+        for i in range(n):
+            nbr = ci[ro[i]:ro[i + 1]]
+            used = set(colors[j] for j in nbr if j != i and colors[j] >= 0)
+            c = 0
+            while c in used:
+                c += 1
+            colors[i] = c
+        return Coloring(jnp.asarray(colors), int(colors.max()) + 1 if n else 0)
+
+
+def color_matrix(A: CsrMatrix, cfg, scope: str = "default") -> Coloring:
+    """MatrixColoringFactory entry (src/core.cu:669)."""
+    name = str(cfg.get("matrix_coloring_scheme", scope))
+    return registry.matrix_coloring.create(name, cfg, scope).color_matrix(A)
