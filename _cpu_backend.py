@@ -1,0 +1,44 @@
+"""Force the CPU backend with N virtual devices.
+
+Shared by tests/conftest.py and __graft_entry__.dryrun_multichip so the
+XLA_FLAGS / JAX_PLATFORMS / jax.config dance exists exactly once. This
+module lives OUTSIDE the amgx_tpu package on purpose: importing it must
+not execute any package __init__ (which imports jax submodules), so the
+"importable before jax initializes" guarantee is structural.
+
+Environment gotcha this encodes: the axon TPU plugin ignores the
+JAX_PLATFORMS env var, but the `jax_platforms` config flag does stick —
+both must be set, and they must be set before the backend initializes
+(after that every override silently no-ops, so force_cpu verifies the
+resulting platform and fails loudly).
+"""
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_cpu(n_devices: int) -> None:
+    """Force the CPU backend with `n_devices` virtual devices; raise if a
+    jax backend already initialized on a different platform or with fewer
+    devices."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if _COUNT_FLAG in flags:
+        # replace a pre-existing count (it may be smaller than n_devices;
+        # silently keeping it would shrink the mesh under test)
+        flags = re.sub(rf"{_COUNT_FLAG}=\d+", f"{_COUNT_FLAG}={n_devices}",
+                       flags)
+    else:
+        flags = (flags + f" {_COUNT_FLAG}={n_devices}").strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    devs = jax.devices()
+    if devs[0].platform != "cpu" or len(devs) < n_devices:
+        raise RuntimeError(
+            f"force_cpu({n_devices}): jax backend was already initialized "
+            f"({len(devs)} x {devs[0].platform}); call force_cpu before any "
+            f"jax operation")

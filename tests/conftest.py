@@ -6,18 +6,14 @@ reference leaves open (its unit binary is single-process; multi-rank
 coverage only via MPI example programs, SURVEY.md §4).
 """
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from _cpu_backend import force_cpu  # noqa: E402
+
+force_cpu(8)
 
 import jax  # noqa: E402
-
-# the axon TPU plugin ignores the JAX_PLATFORMS env var; the config flag
-# does stick — force the CPU backend (with 8 virtual devices) for tests
-jax.config.update("jax_platforms", "cpu")
 
 # persistent compilation cache makes repeated test runs cheap (eager setup
 # ops compile one XLA executable per shape bucket)
