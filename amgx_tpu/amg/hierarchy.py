@@ -123,10 +123,15 @@ class AMG:
         sm_name, sm_scope = self.cfg.get_solver("smoother", self.scope)
         for level in self.levels:
             level.smoother = make_solver(sm_name, self.cfg, sm_scope)
+            level.smoother._owns_scaling = False
+            if getattr(level.smoother, "needs_cf_map", False) and \
+                    getattr(level, "cf_map", None) is not None:
+                level.smoother.set_cf_map(level.cf_map)
             level.smoother.setup(level.A)
 
         cs_name, cs_scope = self.cfg.get_solver("coarse_solver", self.scope)
         self.coarse_solver = make_solver(cs_name, self.cfg, cs_scope)
+        self.coarse_solver._owns_scaling = False
         self.coarse_solver.setup(self.coarsest_A)
         self.num_levels = len(self.levels) + 1
         self.setup_time = time.perf_counter() - t0

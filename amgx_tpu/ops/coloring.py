@@ -42,7 +42,8 @@ class Coloring:
 
 def _hash_w(n, salt: int):
     i = jnp.arange(n, dtype=jnp.uint32)
-    h = (i + jnp.uint32(salt * 0x9E3779B9)) * jnp.uint32(2654435761)
+    h = (i + jnp.uint32((salt * 0x9E3779B9) & 0xFFFFFFFF)) * \
+        jnp.uint32(2654435761)
     h = (h ^ (h >> 15)) * jnp.uint32(0x85EBCA6B)
     h = h ^ (h >> 13)
     return h
@@ -104,7 +105,7 @@ def _square_edges(A: CsrMatrix):
                         num_rows=A.num_rows, num_cols=A.num_cols)
     S2 = csr_multiply(pattern, pattern)
     r2, c2, v2 = S2.coo()
-    keep = np.asarray(v2) > 0
+    keep = (np.asarray(v2) > 0) & (np.asarray(r2) != np.asarray(c2))
     r = jnp.concatenate([r2[keep], c2[keep]])
     c = jnp.concatenate([c2[keep], r2[keep]])
     order = jnp.argsort(r, stable=True)

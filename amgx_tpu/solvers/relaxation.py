@@ -16,13 +16,18 @@ from ..ops.spmv import spmv
 from .base import Solver
 
 
+def safe_recip(d):
+    """Elementwise 1/d with 0 -> 0 (zero-in-diagonal robustness)."""
+    safe = jnp.where(d == 0, 1.0, d)
+    return jnp.where(d == 0, 0.0, 1.0 / safe)
+
+
 def _invert_diag(A):
     """D^{-1}: scalar reciprocal or batched block inverse."""
     d = A.diagonal()
     if A.is_block:
         return jnp.linalg.inv(d)
-    safe = jnp.where(d == 0, 1.0, d)
-    return jnp.where(d == 0, 0.0, 1.0 / safe)
+    return safe_recip(d)
 
 
 def _apply_dinv(dinv, v, block: bool):
@@ -30,6 +35,18 @@ def _apply_dinv(dinv, v, block: bool):
         vb = v.reshape(dinv.shape[0], -1)
         return jnp.einsum("nxy,ny->nx", dinv, vb).reshape(-1)
     return dinv * v
+
+
+def l1_strengthened_diag(A):
+    """Scalar diagonal strengthened by the off-diagonal row L1 norm in
+    the diagonal's sign (jacobi_l1_solver.cu); zero diagonals stay zero
+    (sign 0) so safe_recip keeps them inert."""
+    rows, cols, vals = A.coo()
+    offdiag = jnp.where(rows != cols, jnp.abs(vals), 0.0)
+    l1 = jax.ops.segment_sum(offdiag, rows, num_segments=A.num_rows,
+                             indices_are_sorted=True)
+    d = A.diagonal()
+    return d + jnp.sign(d) * l1
 
 
 @registry.solvers.register("BLOCK_JACOBI")
@@ -90,14 +107,7 @@ class JacobiL1Solver(Solver):
             d = A.diagonal() + jnp.eye(A.block_dimx)[None] * l1[:, :, None]
             self._dinv = jnp.linalg.inv(d)
         else:
-            offdiag = jnp.where(rows != cols, jnp.abs(vals), 0.0)
-            l1 = jax.ops.segment_sum(offdiag, rows,
-                                     num_segments=A.num_rows,
-                                     indices_are_sorted=True)
-            d = A.diagonal()
-            dl1 = d + jnp.sign(d) * l1  # strengthen in the diagonal's sign
-            safe = jnp.where(dl1 == 0, 1.0, dl1)
-            self._dinv = jnp.where(dl1 == 0, 0.0, 1.0 / safe)
+            self._dinv = safe_recip(l1_strengthened_diag(A))
 
     def solve_data(self):
         d = super().solve_data()
