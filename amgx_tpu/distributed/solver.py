@@ -53,6 +53,11 @@ class DistributedSolver:
         self.n_ranks = mesh.devices.size
         name, sscope = cfg.get_solver("solver", scope)
         self.solver = make_solver(name, cfg, sscope)
+        if self.solver.scaling not in ("NONE", ""):
+            raise BadParametersError(
+                "distributed solve: scaling is not yet supported (the "
+                "distributed path bypasses Solver.setup; scale the system "
+                "before partitioning)")
         # validate the preconditioner chain is distribution-aware
         s = self.solver
         while s is not None:

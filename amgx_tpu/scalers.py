@@ -1,0 +1,138 @@
+"""Equation scalers applied around a solve.
+
+Analogs of src/scalers/ (binormalization.cu:1 518 LoC,
+nbinormalization.cu:1 647 LoC, diagonal_symmetric.cu:1 267 LoC; factory
+registration src/core.cu:687-689). A scaler turns A x = b into
+(L A R) x' = L b with x = R x', where L/R are diagonal:
+
+- DIAGONAL_SYMMETRIC: L = R = diag(|a_ii|)^{-1/2} (unit diagonal after
+  scaling);
+- BINORMALIZATION: symmetric binormalization (O. Livne, G. Golub,
+  "Scaling by Binormalization", Numer. Algorithms 35, 2004 — public):
+  fixed point on B = A .* A equalizing the scaled row 2-norms, like the
+  reference's setup path (binormalization.cu:326);
+- NBINORMALIZATION: the nonsymmetric norm variant: alternate row /
+  column 2-norm equilibration (independent L and R), matching the
+  reference's beta/gamma matvec formulation (nbinormalization.cu:411+).
+
+Integration (Solver::setup/solve, src/solvers/solver.cu:465-476,
+:668-673, :856-861): the solver tree is set up on the scaled matrix;
+b is left-scaled in, x is right-scaled out; monitored residuals are in
+the scaled system (same caveat as the reference, solver.cu:449).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import registry
+from .errors import BadParametersError
+from .matrix import CsrMatrix
+
+
+def _seg_sum(v, seg, n):
+    return jax.ops.segment_sum(v, seg, num_segments=n,
+                               indices_are_sorted=True)
+
+
+class Scaler:
+    """Base: setup(A) computes diagonal left/right scale vectors."""
+
+    def __init__(self, cfg, scope: str = "default"):
+        self.cfg = cfg
+        self.scope = scope
+        self.left = None        # (n,)
+        self.right = None       # (m,)
+
+    def setup(self, A: CsrMatrix):
+        raise NotImplementedError
+
+    # -- application ------------------------------------------------------
+    def scale_matrix(self, A: CsrMatrix) -> CsrMatrix:
+        """Return L A R (values-only change; structure shared)."""
+        if A.is_block:
+            raise BadParametersError(
+                f"{type(self).__name__}: scalar matrices only")
+        rows, cols, vals = A.coo()
+        new_vals = vals * self.left[rows] * self.right[cols]
+        diag = None
+        if A.has_external_diag:
+            n = A.num_rows
+            diag = A.diag * self.left * self.right[:n]
+        return A.with_values(new_vals, diag)
+
+    def scale_rhs(self, b):
+        return b * self.left
+
+    def to_scaled_x(self, x):
+        return x / self.right
+
+    def from_scaled_x(self, x):
+        return x * self.right
+
+
+@registry.scalers.register("DIAGONAL_SYMMETRIC")
+class DiagonalSymmetricScaler(Scaler):
+    """L = R = |diag(A)|^{-1/2} (diagonal_symmetric.cu)."""
+
+    def setup(self, A: CsrMatrix):
+        d = jnp.abs(A.diagonal())
+        s = jnp.where(d > 0, 1.0 / jnp.sqrt(jnp.where(d > 0, d, 1.0)), 1.0)
+        self.left = self.right = s
+        return self
+
+
+@registry.scalers.register("BINORMALIZATION")
+class BinormalizationScaler(Scaler):
+    """Symmetric binormalization on B = A.*A: fixed point
+    x_i <- sqrt(x_i * avg / (B x)_i) driving x_i (Bx)_i to a constant;
+    scale vectors are sqrt(x)."""
+
+    ITERS = 30
+
+    def setup(self, A: CsrMatrix):
+        from .ops.spgemm import _fold_diag
+        rows, cols, vals = _fold_diag(A).coo()
+        n = A.num_rows
+        B = vals * vals
+        x = jnp.ones((n,), vals.dtype)
+        for _ in range(self.ITERS):
+            beta = _seg_sum(B * x[cols], rows, n)        # B x
+            avg = jnp.mean(beta * x)
+            safe = jnp.where(beta > 0, beta, 1.0)
+            x = jnp.where(beta > 0, jnp.sqrt(x * avg / safe), x)
+        s = jnp.sqrt(jnp.where(x > 0, x, 1.0))
+        self.left = self.right = jnp.where(x > 0, s, 1.0)
+        return self
+
+
+@registry.scalers.register("NBINORMALIZATION")
+class NBinormalizationScaler(Scaler):
+    """Nonsymmetric norm binormalization: alternate row/column 2-norm
+    equilibration (nbinormalization.cu beta/gamma iteration)."""
+
+    ITERS = 50
+
+    def setup(self, A: CsrMatrix):
+        from .ops.spgemm import _fold_diag
+        rows, cols, vals = _fold_diag(A).coo()
+        n, m = A.num_rows, A.num_cols
+        B = vals * vals
+        x = jnp.ones((n,), vals.dtype)      # left^2
+        y = jnp.ones((m,), vals.dtype)      # right^2
+        for _ in range(self.ITERS):
+            beta = _seg_sum(B * y[cols], rows, n)        # scaled row norms^2
+            x = jnp.where(beta > 0, 1.0 / beta, 1.0)
+            gamma = jnp.zeros((m,), vals.dtype).at[cols].add(B * x[rows])
+            y = jnp.where(gamma > 0, 1.0 / gamma, 1.0)
+        # balance so neither side carries all the magnitude
+        scale = _seg_sum(B * y[cols], rows, n) * x
+        mean = jnp.mean(jnp.where(scale > 0, scale, 1.0))
+        self.left = jnp.sqrt(x) / jnp.sqrt(jnp.sqrt(mean))
+        self.right = jnp.sqrt(y) / jnp.sqrt(jnp.sqrt(mean))
+        return self
+
+
+def make_scaler(name: str, cfg, scope: str = "default"):
+    """ScalerFactory::allocate analog (src/core.cu:687-689)."""
+    return registry.scalers.create(name, cfg, scope)

@@ -132,6 +132,15 @@ class Solver:
         self.print_solve_stats = bool(cfg.get("print_solve_stats", scope))
         self.obtain_timings = bool(cfg.get("obtain_timings", scope))
         self.rel_div_tolerance = float(cfg.get("rel_div_tolerance", scope))
+        self.scaling = str(cfg.get("scaling", scope)).upper()
+        self.scaler = None
+        # Only the tree ROOT applies equation scaling: children receive
+        # the already-scaled matrix, and apply()/smooth() exchange
+        # vectors in the parent's (scaled) coordinates. Creation sites of
+        # child solvers clear this flag. (The reference routes nested
+        # solves through Solver::solve which re-scales per level —
+        # consistent but redundant; here the scaled system is built once.)
+        self._owns_scaling = True
         conv_name = str(cfg.get("convergence", scope))
         self.convergence: Convergence = registry.convergence.create(
             conv_name, cfg, scope)
@@ -140,6 +149,7 @@ class Solver:
             pname, pscope = cfg.get_solver("preconditioner", scope)
             if pname.upper() != "NOSOLVER":
                 self.preconditioner = make_solver(pname, cfg, pscope)
+                self.preconditioner._owns_scaling = False
         self._jit_cache: Dict[Any, Any] = {}
         self.setup_time = 0.0
 
@@ -156,6 +166,16 @@ class Solver:
         t0 = time.perf_counter()
         if not A.initialized:
             A = A.init()
+        if self._owns_scaling and self.scaling not in ("NONE", ""):
+            # scale the equations before the tree is built; the whole
+            # solver (incl. nested preconditioners) then works on L A R
+            # (Solver::setup scaler path, src/solvers/solver.cu:465-476)
+            from ..scalers import make_scaler
+            self.scaler = make_scaler(self.scaling, self.cfg, self.scope)
+            self.scaler.setup(A)
+            A = self.scaler.scale_matrix(A)
+            if not A.initialized:
+                A = A.init()
         self.A = A
         # preconditioner first: solvers whose setup probes the
         # preconditioned operator (e.g. Chebyshev eigen-estimation) need it
@@ -292,6 +312,11 @@ class Solver:
             x0 = jnp.zeros_like(b)
         else:
             x0 = jnp.asarray(x0)
+        if self.scaler is not None:
+            # solve (LAR) x' = L b, return x = R x' (monitored residuals
+            # are in the scaled system — reference caveat solver.cu:449)
+            b = self.scaler.scale_rhs(b)
+            x0 = self.scaler.to_scaled_x(x0)
         key = (b.shape, str(b.dtype))
         if key not in self._jit_cache:
             self._jit_cache[key] = jax.jit(self._build_solve_fn())
@@ -299,6 +324,8 @@ class Solver:
         x, iters, converged, res_norm, norm0, hist = self._jit_cache[key](
             self.solve_data(), b, x0)
         x.block_until_ready()
+        if self.scaler is not None:
+            x = self.scaler.from_scaled_x(x)
         solve_time = time.perf_counter() - t0
         iters_i = int(iters)
         res = SolveResult(
