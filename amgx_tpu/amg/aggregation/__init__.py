@@ -36,8 +36,14 @@ class AggregationAMGLevel(AMGLevel):
         return d
 
     def restrict(self, data, r):
+        if "R" in data:       # distributed: explicit sharded R = P^T
+            from ...ops.spmv import spmv
+            return spmv(data["R"], r)
         return restrict_vector(data["aggregates"], self.coarse_size, r,
                                self.A.block_dimx)
 
     def prolongate(self, data, xc):
+        if "P" in data:       # distributed: explicit sharded P
+            from ...ops.spmv import spmv
+            return spmv(data["P"], xc)
         return prolongate_corr(data["aggregates"], xc, self.A.block_dimx)

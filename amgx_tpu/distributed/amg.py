@@ -1,0 +1,165 @@
+"""Distributed AMG: shard a built hierarchy for SPMD cycles over a mesh.
+
+The reference distributes AMG by making every rank build its partition of
+every level (distributed Galerkin RAP with halo-row exchange,
+src/classical/classical_amg_level.cu:297-315) and consolidating small
+coarse levels onto fewer ranks (include/distributed/glue.h:200), with the
+coarsest solve replicated via all_gather
+(src/solvers/dense_lu_solver.cu:783-930 `exact_coarse_solve`).
+
+TPU-native redesign: setup is a once-per-structure host-orchestrated
+phase on the single controller — the hierarchy (levels, transfer
+operators, smoother data) is built globally, then *every level is
+partitioned into row-block shards with halo maps*:
+
+- each level's A becomes a square ShardMatrix (halo exchange per SpMV);
+- P (fine x coarse) and R (coarse x fine) become rectangular
+  ShardMatrices, so restriction/prolongation perform the same
+  halo-exchange + local SpMV — the communication pattern of the
+  reference's distributed transfer operators;
+- smoother device data (Jacobi/L1 dinv, DILU Einv, colorings, CF masks)
+  is partitioned row-wise; the masked-SpMV sweeps then execute
+  identically per shard, so iteration counts match the single-device
+  hierarchy exactly;
+- the coarsest level is REPLICATED: the rhs is all_gathered, every shard
+  applies the same dense LU redundantly and keeps its slice — precisely
+  the reference's exact_coarse_solve.
+
+The multigrid cycle itself (amg/cycles.py) is unchanged: inside
+shard_map its SpMVs dispatch to ShardMatrix, its reductions finish with
+psum, and the whole V-cycle traces into one SPMD XLA program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..errors import BadParametersError
+from ..matrix import CsrMatrix
+from ..ops.transpose import transpose
+from .dist_matrix import ShardMatrix, shard_matrix_from_partition
+from .partition import partition_matrix
+
+# smoother solve-data keys that partition row-wise (leading dim = rows)
+_ROWWISE_KEYS = {"dinv", "Einv", "colors", "is_coarse", "gs_diag"}
+_UNSUPPORTED_KEYS = {"ell_cols", "ell_vals", "ilu_L", "ilu_U", "u_diag",
+                     "perm", "iperm", "colors_p"}
+
+
+def _partition_rowwise(arr, n_ranks: int, n_local: int):
+    """Stack a (n, ...) per-row array into (n_ranks, n_local, ...) with
+    zero padding on the last shard."""
+    a = np.asarray(arr)
+    pad = n_ranks * n_local - a.shape[0]
+    if pad:
+        a = np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+    return jnp.asarray(a.reshape((n_ranks, n_local) + a.shape[1:]))
+
+
+def _shard(A: CsrMatrix, n_ranks: int, axis: str) -> ShardMatrix:
+    import dataclasses
+    sm = shard_matrix_from_partition(partition_matrix(A, n_ranks))
+    return dataclasses.replace(sm, axis_name=axis)
+
+
+def _replicate(tree, n_ranks: int):
+    """Tile every leaf with a leading mesh axis (replicated data)."""
+    return jax.tree.map(
+        lambda a: jnp.broadcast_to(a[None], (n_ranks,) + a.shape), tree)
+
+
+def _transfer_ops(level):
+    """Global P/R of a level. Classical levels carry them; aggregation
+    levels materialize P[i, agg[i]] = 1 and R = P^T (the CSR view of the
+    aggregate map, aggregation_amg_level.cu:238)."""
+    if hasattr(level, "P"):
+        return level.P, level.R
+    agg = np.asarray(level.aggregates)
+    n, nc = agg.shape[0], level.coarse_size
+    P = CsrMatrix.from_scipy_like(
+        np.arange(n + 1, dtype=np.int32), agg.astype(np.int32),
+        np.ones(n, level.A.dtype), n, nc)
+    return P, transpose(P)
+
+
+def _shard_smoother_data(sm, A_sh: ShardMatrix, n_ranks: int):
+    """Partition a smoother's solve-data pytree row-wise."""
+    data = sm.solve_data()
+    out = {"A": A_sh}
+    n_local = A_sh.n_local
+    for k, v in data.items():
+        if k == "A":
+            continue
+        if k == "precond" or k in _UNSUPPORTED_KEYS or \
+                k not in _ROWWISE_KEYS:
+            raise BadParametersError(
+                f"distributed AMG: smoother {sm.name} is not "
+                f"distribution-aware (data key {k!r}); use BLOCK_JACOBI, "
+                f"JACOBI_L1, MULTICOLOR_GS, MULTICOLOR_DILU or CF_JACOBI")
+        out[k] = _partition_rowwise(v, n_ranks, n_local)
+    return out
+
+
+class DistributedCoarseSolver:
+    """exact_coarse_solve analog (dense_lu_solver.cu:783-930): all_gather
+    the coarse rhs, apply the replicated inner solver redundantly on
+    every shard, keep the local slice."""
+
+    is_smoother = False
+
+    def __init__(self, inner, axis: str, n_ranks: int, nc_global: int,
+                 nc_local: int, coarsest_sweeps: int):
+        self.inner = inner
+        self.name = "DIST_" + inner.name
+        self.axis = axis
+        self.n_ranks = n_ranks
+        self.nc_global = nc_global
+        self.nc_local = nc_local
+        self.coarsest_sweeps = coarsest_sweeps
+
+    def apply(self, data, rhs):
+        bc = jax.lax.all_gather(rhs, self.axis, tiled=True)[: self.nc_global]
+        inner = self.inner
+        if inner.is_smoother and inner.name not in ("DENSE_LU_SOLVER",
+                                                    "NOSOLVER", "DUMMY"):
+            xg = inner.smooth(data, bc, jnp.zeros_like(bc),
+                              self.coarsest_sweeps)
+        else:
+            xg = inner.apply(data, bc)
+        pad = self.n_ranks * self.nc_local - self.nc_global
+        xp = jnp.pad(xg, (0, pad))
+        r = jax.lax.axis_index(self.axis)
+        return jax.lax.dynamic_slice(xp, (r * self.nc_local,),
+                                     (self.nc_local,))
+
+
+def shard_amg(amg, n_ranks: int, axis: str):
+    """Convert a set-up (global) AMG hierarchy for SPMD solving: returns
+    the stacked solve-data pytree and rewires the hierarchy's coarse
+    solver + transfer dispatch for mesh execution."""
+    if amg.cycle_name in ("CG", "CGF"):
+        raise BadParametersError(
+            "distributed AMG: K-cycles (CG/CGF) not yet supported; "
+            "use cycle=V, W or F")
+    levels_data = []
+    for lvl in amg.levels:
+        A_sh = _shard(lvl.A, n_ranks, axis)
+        P, R = _transfer_ops(lvl)
+        ld = {
+            "A": A_sh,
+            "P": _shard(P, n_ranks, axis),
+            "R": _shard(R, n_ranks, axis),
+        }
+        if lvl.smoother is not None:
+            ld["smoother"] = _shard_smoother_data(lvl.smoother, A_sh,
+                                                  n_ranks)
+        levels_data.append(ld)
+    # replicated coarsest level
+    nc = amg.coarsest_A.num_rows
+    nc_local = -(-nc // n_ranks)
+    coarse_data = _replicate(amg.coarse_solver.solve_data(), n_ranks)
+    amg.coarse_solver = DistributedCoarseSolver(
+        amg.coarse_solver, axis, n_ranks, nc, nc_local,
+        amg.coarsest_sweeps)
+    return {"levels": levels_data, "coarse": coarse_data}

@@ -12,6 +12,13 @@ comms_mpi_hostbuffer_stream.cu:321-676):
   axis — two permutes (toward prev, toward next) ride ICI;
 - general mode: `lax.all_gather(tiled)` + static gather by global id.
 
+Rectangular shards (the P/R transfer operators of a distributed AMG
+hierarchy) partition rows by the row-side decomposition and columns by
+the column-side one; `spmv` consumes the column-side local vector and
+produces the row-side local vector, so restriction/prolongation are the
+same halo-exchange + local SpMV as the operator itself
+(classical_amg_level.cu restrict/prolongate analog).
+
 Latency hiding (interior SpMV overlapped with the exchange,
 src/multiply.cu:95-110) is left to XLA's async collectives: the exchange
 and the owned-column part of the SpMV have no data dependence, so the
@@ -32,8 +39,8 @@ from ..matrix import CsrMatrix
     jax.tree_util.register_dataclass,
     data_fields=["csr", "diag", "halo_src", "send_prev", "send_next",
                  "recv_prev", "recv_next"],
-    meta_fields=["n_global", "n_local", "n_halo", "n_ranks", "axis_name",
-                 "neighbor_only"],
+    meta_fields=["n_global", "n_local", "n_local_cols", "n_halo", "n_ranks",
+                 "axis_name", "neighbor_only"],
 )
 @dataclasses.dataclass(frozen=True)
 class ShardMatrix:
@@ -49,6 +56,7 @@ class ShardMatrix:
     recv_next: jax.Array | None
     n_global: int
     n_local: int
+    n_local_cols: int
     n_halo: int
     n_ranks: int
     axis_name: str = "p"
@@ -61,7 +69,7 @@ class ShardMatrix:
 
     @property
     def num_cols(self):
-        return self.n_local
+        return self.n_local_cols
 
     @property
     def block_dimx(self):
@@ -81,14 +89,14 @@ class ShardMatrix:
 
     def exchange_halo(self, x):
         """Fill the halo buffer from remote shards (exchange_halo analog).
-        `x` is the shard-local owned vector (n_local,)."""
+        `x` is the shard-local owned column-side vector (n_local_cols,)."""
         if self.n_ranks == 1:
             return jnp.zeros((self.n_halo,), x.dtype)
         ax = self.axis_name
         if self.neighbor_only:
             xp = jnp.concatenate([x, jnp.zeros((1,), x.dtype)])  # pad slot
-            buf_next = xp[self.send_next]       # rows for rank+1
-            buf_prev = xp[self.send_prev]       # rows for rank-1
+            buf_next = xp[self.send_next]       # cols for rank+1
+            buf_prev = xp[self.send_prev]       # cols for rank-1
             n = self.n_ranks
             fwd = [(i, i + 1) for i in range(n - 1)]
             bwd = [(i + 1, i) for i in range(n - 1)]
@@ -119,16 +127,18 @@ class ShardMatrix:
         return jax.tree.map(lambda a: a[0], self)
 
 
-def shard_matrix_from_partition(p) -> ShardMatrix:
+def shard_matrix_from_partition(p, axis_name: str = "p") -> ShardMatrix:
     """Build the stacked ShardMatrix pytree from a DistPartition."""
     csr = CsrMatrix(
         row_offsets=p.row_offsets, col_indices=p.col_indices,
         values=p.values, row_ids=p.row_ids,
-        num_rows=p.n_local, num_cols=p.n_local + p.n_halo,
+        num_rows=p.n_local, num_cols=p.n_local_cols + p.n_halo,
         initialized=True)
     return ShardMatrix(
         csr=csr, diag=p.diag, halo_src=p.halo_src,
         send_prev=p.send_prev, send_next=p.send_next,
         recv_prev=p.recv_prev, recv_next=p.recv_next,
-        n_global=p.n_global, n_local=p.n_local, n_halo=p.n_halo,
-        n_ranks=p.n_ranks, neighbor_only=p.neighbor_only)
+        n_global=p.n_global, n_local=p.n_local,
+        n_local_cols=p.n_local_cols, n_halo=p.n_halo,
+        n_ranks=p.n_ranks, axis_name=axis_name,
+        neighbor_only=p.neighbor_only)

@@ -16,6 +16,11 @@ interior->boundary->halo — collapses in the SPMD mesh formulation:
   built for a `ppermute` ring exchange (the B2L ring analog); otherwise
   the exchange falls back to all_gather + static gather.
 
+Rectangular operators (the P/R transfer matrices of a distributed AMG
+hierarchy, classical_amg_level.cu:297-315) partition rows by the
+row-side decomposition and columns by the column-side one; the halo
+exchange then reads the *column-side* distributed vector.
+
 Partitioning happens once at upload time on host (numpy), mirroring the
 reference's uploadMatrix/renumber path (SURVEY §3.5); everything
 downstream is device SPMD.
@@ -37,20 +42,22 @@ class DistPartition:
     """Host-side partition product: stacked (n_ranks, ...) device arrays
     ready to be shard_mapped over the mesh axis."""
 
-    # stacked local CSR (cols < n_local owned; >= n_local -> halo slot)
+    # stacked local CSR (cols < n_local_cols owned; >= -> halo slot)
     row_offsets: jnp.ndarray        # (R, n_local+1) int32
     col_indices: jnp.ndarray        # (R, max_nnz) int32
     values: jnp.ndarray             # (R, max_nnz)
     row_ids: jnp.ndarray            # (R, max_nnz) int32 (pre-initialized)
     diag: jnp.ndarray               # (R, n_local) local diagonal (pad 1.0)
-    halo_src: jnp.ndarray           # (R, n_halo) global row id (pad 0)
+    halo_src: jnp.ndarray           # (R, n_halo) global col id (pad 0)
     # ring maps (None unless neighbor-only): send rows / recv halo slots
-    send_prev: Optional[jnp.ndarray]   # (R, max_send) local row (pad n_local)
+    send_prev: Optional[jnp.ndarray]   # (R, max_send) local col (pad n_lc)
     send_next: Optional[jnp.ndarray]
     recv_prev: Optional[jnp.ndarray]   # (R, max_send) halo slot (pad n_halo)
     recv_next: Optional[jnp.ndarray]
-    n_global: int
-    n_local: int
+    n_global: int                   # global rows
+    n_global_cols: int              # global cols
+    n_local: int                    # local rows per shard
+    n_local_cols: int               # local (owned) cols per shard
     n_halo: int
     n_ranks: int
     neighbor_only: bool
@@ -58,14 +65,19 @@ class DistPartition:
 
 def partition_matrix(A: CsrMatrix, n_ranks: int) -> DistPartition:
     """Split a global CsrMatrix into equal row blocks with halo maps
-    (loadDistributedMatrix / create_B2L / renumber_to_local analog)."""
+    (loadDistributedMatrix / create_B2L / renumber_to_local analog).
+    Columns are partitioned by their own dimension, so rectangular
+    transfer operators shard consistently with the vectors they act on."""
     if A.is_block:
         raise BadParametersError(
             "distributed block matrices not yet supported; flatten blocks")
     if A.has_external_diag:
         raise BadParametersError("fold external diagonal before partitioning")
     n = A.num_rows
+    m = A.num_cols
     n_local = -(-n // n_ranks)
+    n_local_cols = -(-m // n_ranks)
+    square = (n == m)
     row_offsets = np.asarray(A.row_offsets)
     col_indices = np.asarray(A.col_indices)
     values = np.asarray(A.values)
@@ -76,11 +88,13 @@ def partition_matrix(A: CsrMatrix, n_ranks: int) -> DistPartition:
     for r in range(n_ranks):
         lo = min(r * n_local, n)
         hi = min(lo + n_local, n)
+        clo = min(r * n_local_cols, m)
+        chi = min(clo + n_local_cols, m)
         s, e = int(row_offsets[lo]), int(row_offsets[hi])
         cols_g = col_indices[s:e]
-        owned = (cols_g >= lo) & (cols_g < hi)
+        owned = (cols_g >= clo) & (cols_g < chi)
         halo_global = np.unique(cols_g[~owned])
-        ranks.append((lo, hi, s, e, cols_g, owned, halo_global))
+        ranks.append((lo, hi, clo, s, e, cols_g, owned, halo_global))
         max_nnz = max(max_nnz, e - s)
         max_halo = max(max_halo, halo_global.size)
 
@@ -91,27 +105,27 @@ def partition_matrix(A: CsrMatrix, n_ranks: int) -> DistPartition:
     rid = np.full((R, max_nnz), n_local - 1, np.int32)
     dg = np.ones((R, n_local), values.dtype)
     halo_src = np.zeros((R, max_halo), np.int64)
-    for r, (lo, hi, s, e, cols_g, owned, hg) in enumerate(ranks):
+    for r, (lo, hi, clo, s, e, cols_g, owned, hg) in enumerate(ranks):
         nr = hi - lo
         nnz_r = e - s
         ro[r, : nr + 1] = row_offsets[lo:hi + 1] - s
         ro[r, nr + 1:] = ro[r, nr]
         slot = np.searchsorted(hg, cols_g)
-        ci[r, :nnz_r] = np.where(owned, cols_g - lo, n_local + slot)
+        ci[r, :nnz_r] = np.where(owned, cols_g - clo, n_local_cols + slot)
         va[r, :nnz_r] = values[s:e]
         rid[r, :nnz_r] = np.repeat(np.arange(nr),
                                    np.diff(row_offsets[lo:hi + 1]))
         halo_src[r, : hg.size] = hg
-        # local diagonal
-        local_rows = rid[r, :nnz_r]
-        is_diag = (cols_g == local_rows + lo)
-        dg[r, local_rows[is_diag]] = values[s:e][is_diag]
+        if square:
+            local_rows = rid[r, :nnz_r]
+            is_diag = (cols_g == local_rows + lo)
+            dg[r, local_rows[is_diag]] = values[s:e][is_diag]
 
-    # ring eligibility: all halo rows on ranks r-1 / r+1
+    # ring eligibility: all halo cols owned by ranks r-1 / r+1
     neighbor_only = n_ranks > 1
     for r, (*_, hg) in enumerate(ranks):
-        if hg.size and not np.all((hg // n_local >= r - 1)
-                                  & (hg // n_local <= r + 1)):
+        if hg.size and not np.all((hg // n_local_cols >= r - 1)
+                                  & (hg // n_local_cols <= r + 1)):
             neighbor_only = False
             break
 
@@ -122,22 +136,22 @@ def partition_matrix(A: CsrMatrix, n_ranks: int) -> DistPartition:
         sn = [np.zeros(0, np.int64)] * R
         rp = [np.zeros(0, np.int64)] * R
         rn_ = [np.zeros(0, np.int64)] * R
-        for r, (lo, hi, *_, hg) in enumerate(ranks):
-            src_rank = np.clip(hg // n_local, 0, R - 1)
+        for r, (*_, hg) in enumerate(ranks):
+            src_rank = np.clip(hg // n_local_cols, 0, R - 1)
             from_prev = hg[src_rank == r - 1]
             from_next = hg[src_rank == r + 1]
-            # my halo slots for those rows (hg sorted -> searchsorted)
+            # my halo slots for those cols (hg sorted -> searchsorted)
             rp[r] = np.searchsorted(hg, from_prev)
             rn_[r] = np.searchsorted(hg, from_next)
-            # the neighbor must send those rows (local to the neighbor)
+            # the neighbor must send those cols (local to the neighbor)
             if r - 1 >= 0:
-                sn[r - 1] = from_prev - (r - 1) * n_local
+                sn[r - 1] = from_prev - (r - 1) * n_local_cols
             if r + 1 < R:
-                sp[r + 1] = from_next - (r + 1) * n_local
+                sp[r + 1] = from_next - (r + 1) * n_local_cols
         for r in range(R):
             max_send = max(max_send, sp[r].size, sn[r].size)
-        send_prev = np.full((R, max_send), n_local, np.int32)
-        send_next = np.full((R, max_send), n_local, np.int32)
+        send_prev = np.full((R, max_send), n_local_cols, np.int32)
+        send_next = np.full((R, max_send), n_local_cols, np.int32)
         recv_prev = np.full((R, max_send), max_halo, np.int32)
         recv_next = np.full((R, max_send), max_halo, np.int32)
         for r in range(R):
@@ -156,7 +170,8 @@ def partition_matrix(A: CsrMatrix, n_ranks: int) -> DistPartition:
         diag=jnp.asarray(dg), halo_src=jnp.asarray(halo_src),
         send_prev=send_prev, send_next=send_next,
         recv_prev=recv_prev, recv_next=recv_next,
-        n_global=n, n_local=n_local, n_halo=max_halo, n_ranks=n_ranks,
+        n_global=n, n_global_cols=m, n_local=n_local,
+        n_local_cols=n_local_cols, n_halo=max_halo, n_ranks=n_ranks,
         neighbor_only=neighbor_only)
 
 

@@ -34,7 +34,7 @@ from .partition import (partition_matrix, partition_vector,
                         unpartition_vector)
 
 _SUPPORTED_PRECONDS = {"NOSOLVER", "DUMMY", "BLOCK_JACOBI", "JACOBI",
-                       "JACOBI_L1"}
+                       "JACOBI_L1", "AMG"}
 
 
 def default_mesh(n_devices: Optional[int] = None, axis: str = "p") -> Mesh:
@@ -73,13 +73,20 @@ class DistributedSolver:
     def setup(self, A: CsrMatrix):
         t0 = time.perf_counter()
         import dataclasses
+        if not A.initialized:
+            A = A.init()
         part = partition_matrix(A, self.n_ranks)
         self.shard_A = dataclasses.replace(
             shard_matrix_from_partition(part), axis_name=self.axis)
         self.part = part
-        # wire the solver chain: A views + per-shard Jacobi data
+        # wire the solver chain: A views + per-shard Jacobi data. AMG
+        # members build their hierarchy on the GLOBAL matrix (setup is a
+        # once-per-structure controller phase), then every level is
+        # sharded for SPMD cycles (distributed/amg.py).
         s = self.solver
         while s is not None:
+            if s.name == "AMG":
+                s.amg.setup(A)
             s.A = self.shard_A           # duck-typed operator view
             s = s.preconditioner
         self._data = self._build_data()
@@ -96,6 +103,9 @@ class DistributedSolver:
                 d["dinv"] = _dinv(self.part.diag)
             elif s.name == "JACOBI_L1":
                 d["dinv"] = _dinv_l1(self.part)
+            elif s.name == "AMG":
+                from .amg import shard_amg
+                d["amg"] = shard_amg(s.amg, self.n_ranks, self.axis)
             if s.preconditioner is not None:
                 d["precond"] = chain_data(s.preconditioner)
             return d

@@ -145,6 +145,61 @@ class TestDistSolve:
 
     def test_unsupported_precond_rejected(self, mesh):
         cfg = Config.from_string(
-            "solver=PCG, preconditioner(amg)=AMG")
+            "solver=PCG, preconditioner(ilu)=MULTICOLOR_ILU")
         with pytest.raises(amgx.errors.AMGXError):
             DistributedSolver(cfg, mesh)
+
+
+# ---------------------------------------------------------------------------
+# distributed AMG (round 2): sharded hierarchy cycles + replicated coarse
+# ---------------------------------------------------------------------------
+
+_AMG_BASE = (
+    "solver=FGMRES, max_iters=60, monitor_residual=1, tolerance=1e-8,"
+    " gmres_n_restart=30, preconditioner(amg)=AMG, amg:max_iters=1,"
+    " amg:cycle=V, amg:max_levels=6")
+
+
+def _single_device_iters(cfg_str, A, b):
+    cfg = Config.from_string(cfg_str)
+    slv = amgx.create_solver(cfg)
+    slv.setup(A)
+    return slv.solve(b)
+
+
+@pytest.mark.parametrize("algo,extra", [
+    ("AGGREGATION", ", amg:selector=SIZE_2, amg:smoother=BLOCK_JACOBI,"
+     " amg:relaxation_factor=0.9"),
+    ("AGGREGATION", ", amg:selector=SIZE_2, amg:smoother=MULTICOLOR_DILU,"
+     " amg:relaxation_factor=0.9"),
+    ("CLASSICAL", ", amg:smoother=BLOCK_JACOBI, amg:relaxation_factor=0.9"),
+])
+def test_distributed_amg_matches_single_device(mesh, algo, extra):
+    """Distributed FGMRES+AMG must converge with iteration counts equal
+    to the single-device run (the hierarchy and smoother math are
+    identical; only the execution is sharded)."""
+    A = gallery.poisson("7pt", 6, 6, 4 * NDEV).init()
+    b = jnp.ones(A.num_rows)
+    cfg_str = _AMG_BASE + f", amg:algorithm={algo}" + extra
+    ref = _single_device_iters(cfg_str, A, b)
+    assert ref.converged
+
+    ds = DistributedSolver(Config.from_string(cfg_str), mesh)
+    ds.setup(A)
+    res = ds.solve(np.asarray(b))
+    assert res.converged
+    assert res.iterations == ref.iterations, (res.iterations,
+                                              ref.iterations)
+    r = np.asarray(ops.residual(A, jnp.asarray(np.asarray(res.x)), b))
+    assert np.linalg.norm(r) < 1e-6 * np.linalg.norm(np.asarray(b))
+
+
+def test_distributed_amg_kcycle_rejected(mesh):
+    from amgx_tpu.errors import BadParametersError
+    A = gallery.poisson("7pt", 4, 4, 2 * NDEV).init()
+    cfg = Config.from_string(
+        _AMG_BASE.replace("amg:cycle=V", "amg:cycle=CG")
+        + ", amg:algorithm=AGGREGATION, amg:selector=SIZE_2")
+    ds = DistributedSolver(cfg, mesh)
+    with pytest.raises(BadParametersError):
+        ds.setup(A)
