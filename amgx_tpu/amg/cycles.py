@@ -21,16 +21,21 @@ def _smooth(level, data, b, x, sweeps: int):
     return level.smoother.smooth(data["smoother"], b, x, sweeps)
 
 
-def _coarse_solve(amg, data, bc, xc):
-    """Coarsest-level solve (launchCoarseSolver analog,
+def apply_coarse_solver(cs, data, bc, xc, coarsest_sweeps: int):
+    """Coarsest-level dispatch (launchCoarseSolver analog,
     include/amg_level.h:229-242). Relaxation-type coarse solvers run
     `coarsest_sweeps` sweeps (reference parameter); direct/Krylov coarse
-    solvers use their own apply."""
-    cs = amg.coarse_solver
+    solvers use their own apply. Shared with the distributed coarse
+    solver so both paths stay in lockstep."""
     if cs.is_smoother and cs.name not in ("DENSE_LU_SOLVER", "NOSOLVER",
                                           "DUMMY"):
-        return cs.smooth(data["coarse"], bc, xc, amg.coarsest_sweeps)
-    return cs.apply(data["coarse"], bc)
+        return cs.smooth(data, bc, xc, coarsest_sweeps)
+    return cs.apply(data, bc)
+
+
+def _coarse_solve(amg, data, bc, xc):
+    return apply_coarse_solver(amg.coarse_solver, data["coarse"], bc, xc,
+                               amg.coarsest_sweeps)
 
 
 def _cycle(amg, shape: str, data, lvl: int, b, x):

@@ -267,6 +267,8 @@ def _register_default_parameters():
     R("eig_eigenvector", int, "number of eigenvectors wanted", 0)
     R("eig_eigenvector_solver", str, "eigenvector extraction solver", "default")
     R("eig_wanted_count", int, "number of wanted eigenvalues", 1)
+    R("eig_subspace_size", int, "subspace size for block/Krylov methods", -1)
+    R("eig_convergence_check_freq", int, "convergence check frequency", 1)
     # TPU-specific additions (new surface; no reference analog)
     R("spmv_impl", str, "SpMV implementation <AUTO|CSR_SEGSUM|ELL|PALLAS>", "AUTO")
     R("tpu_dtype", str, "override compute dtype <float32|float64|bfloat16>", "")

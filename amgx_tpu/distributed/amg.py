@@ -41,10 +41,10 @@ from ..ops.transpose import transpose
 from .dist_matrix import ShardMatrix, shard_matrix_from_partition
 from .partition import partition_matrix
 
-# smoother solve-data keys that partition row-wise (leading dim = rows)
+# smoother solve-data keys that partition row-wise (leading dim = rows);
+# any other key (nested preconditioners, ILU factors, permutations) marks
+# the smoother as not distribution-aware
 _ROWWISE_KEYS = {"dinv", "Einv", "colors", "is_coarse", "gs_diag"}
-_UNSUPPORTED_KEYS = {"ell_cols", "ell_vals", "ilu_L", "ilu_U", "u_diag",
-                     "perm", "iperm", "colors_p"}
 
 
 def _partition_rowwise(arr, n_ranks: int, n_local: int):
@@ -58,9 +58,7 @@ def _partition_rowwise(arr, n_ranks: int, n_local: int):
 
 
 def _shard(A: CsrMatrix, n_ranks: int, axis: str) -> ShardMatrix:
-    import dataclasses
-    sm = shard_matrix_from_partition(partition_matrix(A, n_ranks))
-    return dataclasses.replace(sm, axis_name=axis)
+    return shard_matrix_from_partition(partition_matrix(A, n_ranks), axis)
 
 
 def _replicate(tree, n_ranks: int):
@@ -91,8 +89,7 @@ def _shard_smoother_data(sm, A_sh: ShardMatrix, n_ranks: int):
     for k, v in data.items():
         if k == "A":
             continue
-        if k == "precond" or k in _UNSUPPORTED_KEYS or \
-                k not in _ROWWISE_KEYS:
+        if k not in _ROWWISE_KEYS:
             raise BadParametersError(
                 f"distributed AMG: smoother {sm.name} is not "
                 f"distribution-aware (data key {k!r}); use BLOCK_JACOBI, "
@@ -119,14 +116,10 @@ class DistributedCoarseSolver:
         self.coarsest_sweeps = coarsest_sweeps
 
     def apply(self, data, rhs):
+        from ..amg.cycles import apply_coarse_solver
         bc = jax.lax.all_gather(rhs, self.axis, tiled=True)[: self.nc_global]
-        inner = self.inner
-        if inner.is_smoother and inner.name not in ("DENSE_LU_SOLVER",
-                                                    "NOSOLVER", "DUMMY"):
-            xg = inner.smooth(data, bc, jnp.zeros_like(bc),
-                              self.coarsest_sweeps)
-        else:
-            xg = inner.apply(data, bc)
+        xg = apply_coarse_solver(self.inner, data, bc, jnp.zeros_like(bc),
+                                 self.coarsest_sweeps)
         pad = self.n_ranks * self.nc_local - self.nc_global
         xp = jnp.pad(xg, (0, pad))
         r = jax.lax.axis_index(self.axis)
@@ -142,6 +135,10 @@ def shard_amg(amg, n_ranks: int, axis: str):
         raise BadParametersError(
             "distributed AMG: K-cycles (CG/CGF) not yet supported; "
             "use cycle=V, W or F")
+    if isinstance(amg.coarse_solver, DistributedCoarseSolver):
+        raise BadParametersError(
+            "shard_amg: hierarchy is already sharded; re-run setup() "
+            "before sharding again")
     levels_data = []
     for lvl in amg.levels:
         A_sh = _shard(lvl.A, n_ranks, axis)

@@ -129,6 +129,10 @@ class ShardMatrix:
 
 def shard_matrix_from_partition(p, axis_name: str = "p") -> ShardMatrix:
     """Build the stacked ShardMatrix pytree from a DistPartition."""
+    if p.n_ranks * p.n_local_cols < p.n_global_cols:
+        raise ValueError(
+            f"partition covers {p.n_ranks * p.n_local_cols} of "
+            f"{p.n_global_cols} global columns")
     csr = CsrMatrix(
         row_offsets=p.row_offsets, col_indices=p.col_indices,
         values=p.values, row_ids=p.row_ids,

@@ -72,12 +72,10 @@ class DistributedSolver:
     # -- setup -----------------------------------------------------------
     def setup(self, A: CsrMatrix):
         t0 = time.perf_counter()
-        import dataclasses
         if not A.initialized:
             A = A.init()
         part = partition_matrix(A, self.n_ranks)
-        self.shard_A = dataclasses.replace(
-            shard_matrix_from_partition(part), axis_name=self.axis)
+        self.shard_A = shard_matrix_from_partition(part, self.axis)
         self.part = part
         # wire the solver chain: A views + per-shard Jacobi data. AMG
         # members build their hierarchy on the GLOBAL matrix (setup is a

@@ -1,0 +1,189 @@
+"""Eigensolver subsystem tests.
+
+Mirrors the reference's eigensolver coverage (eigen_examples/, power
+method on Poisson): every registered eigensolver must find the requested
+eigenpairs of a 5-pt Poisson (or small nonsymmetric) matrix to tolerance
+against a dense numpy reference.
+"""
+import numpy as np
+import pytest
+
+import amgx_tpu as amgx
+from amgx_tpu.config import Config
+from amgx_tpu.eigen import create_eigensolver
+from amgx_tpu.gallery import poisson5pt
+from amgx_tpu.matrix import CsrMatrix
+
+amgx.initialize()
+
+
+def _dense_eigs(A):
+    return np.linalg.eigvalsh(np.asarray(A.to_dense()))
+
+
+@pytest.fixture(scope="module")
+def poisson():
+    # rectangular grid -> distinct eigenvalues (a square grid's spectrum
+    # has multiplicity-2 pairs that single-vector Krylov cannot resolve)
+    A = poisson5pt(10, 7)            # n = 70
+    lam = _dense_eigs(A)
+    return A, lam
+
+
+def _solve(A, cfg_str):
+    es = create_eigensolver(Config.from_string(cfg_str))
+    es.setup(A)
+    return es.solve()
+
+
+def test_power_iteration_largest(poisson):
+    A, lam = poisson
+    res = _solve(A, "eig_solver=POWER_ITERATION, eig_max_iters=2000, "
+                    "eig_tolerance=1e-8, eig_eigenvector=1")
+    assert res.converged
+    np.testing.assert_allclose(res.eigenvalues[0], lam[-1], rtol=1e-6)
+    # eigenvector residual
+    v = res.eigenvectors[:, 0]
+    Ad = np.asarray(A.to_dense())
+    assert np.linalg.norm(Ad @ v - res.eigenvalues[0] * v) < 1e-5
+
+
+def test_power_iteration_shifted(poisson):
+    A, lam = poisson
+    # shift past the dominant end: power iteration on A - s I converges
+    # to the SMALLEST eigenvalue when s > (lam_max+lam_min)/2
+    res = _solve(A, "eig_solver=POWER_ITERATION, eig_shift=8.0, "
+                    "eig_max_iters=4000, eig_tolerance=1e-8")
+    assert res.converged
+    np.testing.assert_allclose(res.eigenvalues[0], lam[0], atol=1e-5)
+
+
+def test_inverse_iteration_smallest(poisson):
+    A, lam = poisson
+    res = _solve(A, "eig_solver=INVERSE_ITERATION, eig_max_iters=50, "
+                    "eig_tolerance=1e-9, solver=CG, max_iters=200, "
+                    "tolerance=1e-12, monitor_residual=1")
+    assert res.converged
+    np.testing.assert_allclose(res.eigenvalues[0], lam[0], rtol=1e-6)
+
+
+def test_lanczos_extreme_pairs(poisson):
+    A, lam = poisson
+    res = _solve(A, "eig_solver=LANCZOS, eig_wanted_count=3, "
+                    "eig_which=largest, eig_max_iters=40, "
+                    "eig_subspace_size=40, eig_tolerance=1e-8, "
+                    "eig_eigenvector=1")
+    assert res.converged
+    np.testing.assert_allclose(np.sort(res.eigenvalues), lam[-3:],
+                               rtol=1e-6)
+    # Ritz vectors are real eigenvectors
+    Ad = np.asarray(A.to_dense())
+    for i in range(3):
+        v, l = res.eigenvectors[:, i], res.eigenvalues[i]
+        assert np.linalg.norm(Ad @ v - l * v) < 1e-5
+
+
+def test_lanczos_smallest(poisson):
+    A, lam = poisson
+    res = _solve(A, "eig_solver=LANCZOS, eig_wanted_count=2, "
+                    "eig_which=smallest, eig_max_iters=60, "
+                    "eig_subspace_size=50, eig_tolerance=1e-7")
+    assert res.converged
+    np.testing.assert_allclose(np.sort(res.eigenvalues), lam[:2],
+                               rtol=1e-5)
+
+
+def test_arnoldi_nonsymmetric():
+    # convection-diffusion-like: Poisson + asymmetric first-order term
+    A = poisson5pt(8, 8)
+    ro = np.asarray(A.row_offsets)
+    ci = np.asarray(A.col_indices)
+    vals = np.asarray(A.values).copy()
+    row_ids = np.repeat(np.arange(A.num_rows), np.diff(ro))
+    vals[ci > row_ids] += 0.3       # upwind bias
+    B = CsrMatrix.from_scipy_like(ro, ci, vals, A.num_rows, A.num_cols)
+    lam_ref = np.linalg.eigvals(np.asarray(B.to_dense()))
+    lam_max = lam_ref[np.argmax(lam_ref.real)]
+    res = _solve(B, "eig_solver=ARNOLDI, eig_wanted_count=1, "
+                    "eig_subspace_size=40, eig_tolerance=1e-7")
+    assert res.converged
+    np.testing.assert_allclose(res.eigenvalues[0], lam_max.real, rtol=1e-6)
+
+
+def test_lobpcg_smallest_preconditioned(poisson):
+    A, lam = poisson
+    res = _solve(A, "eig_solver=LOBPCG, eig_which=smallest, "
+                    "eig_wanted_count=3, eig_max_iters=200, "
+                    "eig_tolerance=1e-7, eig_eigenvector=1, "
+                    "preconditioner=BLOCK_JACOBI, max_iters=3")
+    assert res.converged
+    np.testing.assert_allclose(np.sort(res.eigenvalues), lam[:3],
+                               rtol=1e-5)
+
+
+def test_subspace_iteration_largest(poisson):
+    A, lam = poisson
+    res = _solve(A, "eig_solver=SUBSPACE_ITERATION, eig_wanted_count=2, "
+                    "eig_max_iters=500, eig_tolerance=1e-7, "
+                    "eig_subspace_size=6")
+    assert res.converged
+    np.testing.assert_allclose(np.sort(res.eigenvalues), lam[-2:],
+                               rtol=1e-5)
+
+
+def test_jacobi_davidson_largest(poisson):
+    A, lam = poisson
+    res = _solve(A, "eig_solver=JACOBI_DAVIDSON, eig_max_iters=200, "
+                    "eig_tolerance=1e-7, eig_subspace_size=12")
+    assert res.converged
+    np.testing.assert_allclose(res.eigenvalues[0], lam[-1], rtol=1e-6)
+
+
+def test_jacobi_davidson_smallest(poisson):
+    A, lam = poisson
+    res = _solve(A, "eig_solver=JACOBI_DAVIDSON, eig_which=smallest, "
+                    "eig_max_iters=300, eig_tolerance=1e-7, "
+                    "eig_subspace_size=12")
+    assert res.converged
+    np.testing.assert_allclose(res.eigenvalues[0], lam[0], atol=1e-5)
+
+
+def test_pagerank_stationary_distribution():
+    # small directed graph: ring with a chord and one dangling node
+    n = 6
+    edges = [(0, 1), (1, 2), (2, 3), (3, 4), (4, 0), (1, 3), (0, 2)]
+    # node 5 dangles (no out-edges); add incoming edge 4->5
+    edges.append((4, 5))
+    rows = np.array([e[0] for e in edges])
+    cols = np.array([e[1] for e in edges])
+    vals = np.ones(len(edges))
+    A = CsrMatrix.from_coo(rows, cols, vals, n, n)
+    d = 0.85
+    # dense reference Google matrix
+    P = np.zeros((n, n))
+    for r, c in edges:
+        P[r, c] = 1.0
+    deg = P.sum(1)
+    dang = deg == 0
+    Pn = np.divide(P, np.maximum(deg[:, None], 1), out=np.zeros_like(P),
+                   where=deg[:, None] > 0)
+    G = d * Pn + np.outer(d * dang + (1 - d), np.ones(n) / n)
+    pi = np.ones(n) / n
+    for _ in range(500):
+        pi = G.T @ pi
+        pi /= pi.sum()
+    res = _solve(A, "eig_solver=PAGERANK, eig_damping_factor=0.85, "
+                    "eig_max_iters=500, eig_tolerance=1e-10")
+    assert res.converged
+    np.testing.assert_allclose(res.eigenvalues[0], 1.0, atol=1e-6)
+    v = res.eigenvectors[:, 0]
+    v = v / v.sum()
+    np.testing.assert_allclose(v, pi, atol=1e-8)
+
+
+def test_eigensolver_factory_names():
+    from amgx_tpu import registry
+    for name in ("POWER_ITERATION", "SINGLE_ITERATION", "PAGERANK",
+                 "INVERSE_ITERATION", "SUBSPACE_ITERATION", "LANCZOS",
+                 "ARNOLDI", "LOBPCG", "JACOBI_DAVIDSON"):
+        assert registry.eigensolvers.has(name), name
