@@ -1,20 +1,31 @@
-"""CF-splitting selectors: PMIS / HMIS and aggressive variants.
+"""CF-splitting selectors: PMIS / RS / HMIS / CR and aggressive variants.
 
-Analogs of src/classical/selectors/ (pmis.cu 657 LoC, hmis.cu,
-aggressive_*.cu, selector.cu). PMIS (parallel modified independent set)
-is a natural TPU fit — it is already a data-parallel fixed point:
+Analogs of src/classical/selectors/ (pmis.cu 657 LoC, rs.cu, hmis.cu,
+cr.cu 663 LoC, aggressive_*.cu, selector.cu).
 
-  weight w_i = strong-degree(i) + hash(i)        (deterministic "random")
-  repeat:  undecided i with w_i greater than every undecided strong
-           neighbor's weight becomes COARSE; undecided neighbors of new
-           COARSE points become FINE.
+- PMIS (parallel modified independent set) is a natural TPU fit — it is
+  already a data-parallel fixed point:
 
-expressed as segment-max sweeps over the symmetrized strength graph.
-HMIS runs PMIS on the distance-two strength graph restricted to a
-first-pass independent set; here (round 1) HMIS shares the PMIS fixed
-point on S, and the AGGRESSIVE_* variants run the same fixed point on
-S@S (two-hop strength), giving the reference's aggressive-coarsening
-grid-size behavior.
+    weight w_i = strong-degree(i) + hash(i)      (deterministic "random")
+    repeat: undecided i with w_i greater than every undecided strong
+            neighbor's weight becomes COARSE; undecided neighbors of new
+            COARSE points become FINE.
+
+  expressed as segment-max sweeps over the symmetrized strength graph.
+- RS is the classical serial first pass. The reference itself refuses to
+  run it on the GPU ("it's a sequential algorithm", rs.cu:269-277) and
+  runs it on the HOST; here it is a native C++ bucket-queue component
+  (amgx_tpu/native/src/rs.cpp) with a Python fallback.
+- HMIS = host RS pass, then PMIS initialized from that result — exactly
+  the reference composition (hmis.cu:55-82). On one device the PMIS pass
+  is a no-op fixup (every point is already assigned); under domain
+  decomposition it resolves boundary inconsistencies.
+- CR (compatible relaxation): smooth the homogeneous system on the
+  current F-set; slow-to-decay points are coarse-grid candidates, and an
+  independent subset joins C each round (cr.cu structure: presmooth
+  fine-error + update cf_map from smoother colors).
+- AGGRESSIVE_* run the PMIS fixed point on the two-hop strength graph
+  S@S, giving the reference's aggressive-coarsening grid sizes.
 """
 from __future__ import annotations
 
@@ -45,18 +56,24 @@ def _symmetrize(rows, cols, mask, n):
     return r[order], c[order]
 
 
-def pmis_split(A: CsrMatrix, strong, max_iters: int = 30):
-    """Returns cf_map (n,) in {FINE, COARSE}."""
+def pmis_split(A: CsrMatrix, strong, max_iters: int = 30, init=None):
+    """Returns cf_map (n,) in {FINE, COARSE}. `init` (optional) seeds the
+    fixed point with already-decided assignments (cf_map_init=1 analog,
+    pmis.cu:508): entries in {FINE, COARSE} are kept, UNDECIDED entries
+    are resolved by the PMIS sweeps."""
     n = A.num_rows
     rows, cols, _ = A.coo()
     sr, sc = _symmetrize(rows, cols, strong, n)
     deg = jnp.zeros((n,), jnp.float64).at[sr].add(1.0) * 0.5
     w = deg + _hash01(n)
-    state = jnp.full((n,), UNDECIDED, jnp.int32)
+    if init is None:
+        state = jnp.full((n,), UNDECIDED, jnp.int32)
+    else:
+        state = jnp.asarray(init, jnp.int32)
     # isolated points (no strong connections): they cannot interpolate —
     # make them COARSE (kept exactly, matches Dirichlet-row handling)
     has_nbr = jnp.zeros((n,), bool).at[sr].set(True)
-    state = jnp.where(~has_nbr, COARSE, state)
+    state = jnp.where((state == UNDECIDED) & ~has_nbr, COARSE, state)
 
     for _ in range(max_iters):
         und = state == UNDECIDED
@@ -73,6 +90,102 @@ def pmis_split(A: CsrMatrix, strong, max_iters: int = 30):
         state = jnp.where((state == UNDECIDED) & c_nbr, FINE, state)
     state = jnp.where(state == UNDECIDED, FINE, state)
     return state.astype(jnp.int32)
+
+
+def rs_split_python(n, row_offsets, col_indices, strong):
+    """Pure-Python RS first pass (fallback when the native lib is
+    unavailable). Bit-identical port of native/src/rs.cpp — same bucket
+    queue with the same LIFO tie-breaking, so the CF splitting (and
+    every hierarchy built on it) is identical with or without the native
+    library."""
+    ro = np.asarray(row_offsets)
+    ci = np.asarray(col_indices)
+    st = np.asarray(strong, bool)
+    row_ids = np.repeat(np.arange(n), np.diff(ro))
+    mask = st & (ci < n) & (ci != row_ids)
+    # S (per-row) and S^T (per-col) adjacency, numpy-built
+    s_r, s_c = row_ids[mask], ci[mask]
+    order = np.argsort(s_c, kind="stable")
+    st_c, st_r = s_c[order], s_r[order]
+    st_off = np.zeros(n + 1, np.int64)
+    np.add.at(st_off, st_c + 1, 1)
+    np.cumsum(st_off, out=st_off)
+    s_off = np.zeros(n + 1, np.int64)
+    np.add.at(s_off, s_r + 1, 1)
+    np.cumsum(s_off, out=s_off)
+
+    # bucket queue: head per weight + doubly-linked node lists (rs.cpp)
+    head = np.full(n + 2, -1, np.int64)
+    prev = np.full(n, -1, np.int64)
+    nxt = np.full(n, -1, np.int64)
+    weight = np.zeros(n, np.int64)
+    maxw = 0
+
+    def push(i, w):
+        nonlocal maxw
+        weight[i] = w
+        prev[i] = -1
+        nxt[i] = head[w]
+        if head[w] >= 0:
+            prev[head[w]] = i
+        head[w] = i
+        if w > maxw:
+            maxw = w
+
+    def remove(i):
+        w = weight[i]
+        if prev[i] >= 0:
+            nxt[prev[i]] = nxt[i]
+        else:
+            head[w] = nxt[i]
+        if nxt[i] >= 0:
+            prev[nxt[i]] = prev[i]
+        prev[i] = nxt[i] = -1
+
+    lam = np.diff(st_off).astype(np.int64)
+    state = np.full(n, UNDECIDED, np.int32)
+    in_q = lam > 0
+    state[~in_q] = FINE
+    # push in ascending node order, exactly like the C++ loop
+    for i in range(n):
+        if in_q[i]:
+            push(i, lam[i])
+    while True:
+        while maxw >= 0 and head[maxw] < 0:
+            maxw -= 1
+        if maxw < 0:
+            break
+        i = head[maxw]
+        remove(i)
+        if state[i] != UNDECIDED:
+            continue
+        state[i] = COARSE
+        for t in range(st_off[i], st_off[i + 1]):
+            j = st_r[t]
+            if state[j] != UNDECIDED:
+                continue
+            state[j] = FINE
+            remove(j)
+            for u in range(s_off[j], s_off[j + 1]):
+                k = s_c[u]
+                if state[k] == UNDECIDED:
+                    remove(k)
+                    push(k, weight[k] + 1)
+    return np.where(state == COARSE, 1, 0).astype(np.int32)
+
+
+def rs_split(A: CsrMatrix, strong):
+    """RS first-pass coarsening: native C++ bucket queue, Python
+    fallback."""
+    from ...native import rs_coarsen_native
+    n = A.num_rows
+    ro = np.asarray(A.row_offsets)
+    ci = np.asarray(A.col_indices)
+    st = np.asarray(strong, np.uint8)
+    cf = rs_coarsen_native(n, ro, ci, st)
+    if cf is None:
+        cf = rs_split_python(n, ro, ci, st)
+    return jnp.asarray(cf, jnp.int32)
 
 
 def _two_hop_strength(A: CsrMatrix, strong):
@@ -97,10 +210,28 @@ class ClassicalSelector:
 
 
 @registry.classical_selectors.register("PMIS")
-@registry.classical_selectors.register("HMIS")
 class PMISSelector(ClassicalSelector):
     def mark_coarse_fine_points(self, A, strong):
         return pmis_split(A, strong)
+
+
+@registry.classical_selectors.register("RS")
+class RSSelector(ClassicalSelector):
+    """Serial Ruge-Stueben first pass (rs.cu host path)."""
+
+    def mark_coarse_fine_points(self, A, strong):
+        return rs_split(A, strong)
+
+
+@registry.classical_selectors.register("HMIS")
+class HMISSelector(ClassicalSelector):
+    """Host RS pass, then PMIS seeded with the RS result
+    (hmis.cu:55-82). Single-device the PMIS pass keeps the RS
+    assignment; it exists to resolve partition-boundary points."""
+
+    def mark_coarse_fine_points(self, A, strong):
+        cf = rs_split(A, strong)
+        return pmis_split(A, strong, init=cf)
 
 
 @registry.classical_selectors.register("AGGRESSIVE_PMIS")
@@ -117,10 +248,91 @@ class AggressivePMISSelector(ClassicalSelector):
 
 
 @registry.classical_selectors.register("CR")
+class CRSelector(ClassicalSelector):
+    """Compatible-relaxation selector (cr.cu). Starting from an empty
+    (or tiny) C-set, repeatedly:
+
+      1. relax the homogeneous system A e = 0 on the F-points (weighted
+         Jacobi sweeps with e zeroed at C — the reference presmooths with
+         MULTICOLOR_GS, cr.cu:366-435; Jacobi keeps it one XLA program);
+      2. the normalized surviving error mu_i = |e_i| / max|e| measures
+         how badly relaxation alone handles point i;
+      3. slow points (mu_i >= theta) above the global convergence target
+         join C as an independent set weighted by mu (the reference uses
+         smoother colors for independence, cr.cu:123-144).
+
+    Stops when the CR convergence factor is below 0.7 or the candidate
+    set is empty.
+    """
+
+    NU = 4              # relaxation sweeps per round
+    THETA = 0.5         # candidate threshold on normalized error
+    MAX_ROUNDS = 10
+    TARGET_RATE = 0.7
+
+    def mark_coarse_fine_points(self, A, strong):
+        n = A.num_rows
+        rows, cols, _ = A.coo()
+        sr, sc = _symmetrize(rows, cols, strong, n)
+        diag = A.diagonal()
+        dinv = jnp.where(diag != 0, 1.0 / jnp.where(diag == 0, 1.0, diag),
+                         0.0)
+        from ...ops.spmv import spmv
+        state = jnp.full((n,), UNDECIDED, jnp.int32)
+        has_nbr = jnp.zeros((n,), bool).at[sr].set(True)
+        state = jnp.where(~has_nbr, COARSE, state)  # isolated rows
+        rng = np.random.default_rng(5)
+        e0 = jnp.asarray(rng.standard_normal(n), A.dtype)
+
+        for _ in range(self.MAX_ROUNDS):
+            is_c = state == COARSE
+            e = jnp.where(is_c, 0.0, e0)
+            e = e / jnp.maximum(jnp.linalg.norm(e), 1e-30)
+            norm_prev = jnp.linalg.norm(e)
+            for _ in range(self.NU):
+                norm_prev = jnp.linalg.norm(e)
+                e = e - 0.666 * dinv * spmv(A, e)
+                e = jnp.where(is_c, 0.0, e)
+            # asymptotic measure: ratio of the LAST sweep (early sweeps
+            # only show the fast high-frequency decay)
+            rate = jnp.linalg.norm(e) / jnp.maximum(norm_prev, 1e-30)
+            if float(rate) < self.TARGET_RATE:
+                break
+            mu = jnp.abs(e) / jnp.maximum(jnp.max(jnp.abs(e)), 1e-30)
+            cand = (state == UNDECIDED) & (mu >= self.THETA)
+            if not bool(jnp.any(cand)):
+                break
+            # independent set among candidates, weighted by mu
+            w = mu + _hash01(n) * 1e-6
+            active = cand[sr] & cand[sc]
+            nbr_max = jax.ops.segment_max(
+                jnp.where(active, w[sc], -jnp.inf), sr, num_segments=n,
+                indices_are_sorted=True)
+            new_c = cand & (w > nbr_max)
+            state = jnp.where(new_c, COARSE, state)
+        # coverage completion: every F point needs at least one strong C
+        # neighbor or classical interpolation has nothing to work with —
+        # promote independent sets of uncovered points until covered
+        deg = jnp.zeros((n,), jnp.float64).at[sr].add(1.0)
+        wfix = deg + _hash01(n)
+        for _ in range(30):
+            is_c = state == COARSE
+            covered = jnp.zeros((n,), bool).at[sr].max(is_c[sc])
+            unc = ~is_c & has_nbr & ~covered
+            if not bool(jnp.any(unc)):
+                break
+            active = unc[sr] & unc[sc]
+            nbr_max = jax.ops.segment_max(
+                jnp.where(active, wfix[sc], -jnp.inf), sr, num_segments=n,
+                indices_are_sorted=True)
+            state = jnp.where(unc & (wfix > nbr_max), COARSE, state)
+        # everything not selected is FINE
+        return jnp.where(state == COARSE, COARSE, FINE).astype(jnp.int32)
+
+
 @registry.classical_selectors.register("DUMMY_CLASSICAL")
 class DummyClassicalSelector(ClassicalSelector):
-    """Every other point coarse (dummy selector analog; also stands in
-    for CR until compatible relaxation lands)."""
+    """Every other point coarse (dummy_selector.cu analog)."""
 
     def mark_coarse_fine_points(self, A, strong):
         n = A.num_rows

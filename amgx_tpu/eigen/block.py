@@ -55,6 +55,14 @@ class SubspaceIterationEigenSolver(EigenSolver):
     (subspace_iteration_eigensolver.cu)."""
 
     def solver_setup(self):
+        from ..errors import BadParametersError
+        if self.which == "smallest":
+            # power steps amplify the dominant subspace; Rayleigh-Ritz
+            # residuals would converge on dominant-subspace pairs that
+            # are nowhere near the smallest eigenvalues
+            raise BadParametersError(
+                "SUBSPACE_ITERATION computes the dominant (largest) "
+                "eigenpairs; use LANCZOS or LOBPCG for eig_which=smallest")
         k = self.wanted_count
         m = self.subspace_size
         self.block = min(max(m, k + 2) if m > 0 else max(2 * k, k + 2),

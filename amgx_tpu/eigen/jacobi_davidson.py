@@ -31,6 +31,11 @@ _PIN = 1e30
 class JacobiDavidsonEigenSolver(EigenSolver):
 
     def solver_setup(self):
+        from ..errors import BadParametersError
+        if self.wanted_count > 1:
+            raise BadParametersError(
+                "JACOBI_DAVIDSON computes one eigenpair; use LANCZOS or "
+                "LOBPCG for eig_wanted_count > 1")
         m = self.subspace_size
         self.m_max = min(m if m > 0 else 12, self.A.num_rows)
 

@@ -22,8 +22,7 @@ from .. import registry
 from ..errors import BadParametersError
 from ..ops import blas
 from .base import EigenSolver
-from .operators import (MatrixOperator, PageRankOperator, ShiftedOperator,
-                        SolveOperator)
+from .operators import PageRankOperator, SolveOperator
 
 
 @registry.eigensolvers.register("SINGLE_ITERATION")
@@ -68,10 +67,7 @@ class SingleIterationEigenSolver(EigenSolver):
             solver.setup(A)
             self._inner_solver = solver
             return SolveOperator(solver)
-        op = MatrixOperator(self.A)
-        if self.shift != 0.0:
-            op = ShiftedOperator(op, self.shift)
-        return op
+        return super().make_operator()
 
     def unshift(self, lam):
         if self.which == "smallest":

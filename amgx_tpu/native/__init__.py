@@ -1,0 +1,97 @@
+"""Native (C++) runtime components.
+
+The reference keeps inherently serial setup algorithms on the host in
+C++ (e.g. Ruge-Stueben coarsening, src/classical/selectors/rs.cu:269
+refuses the GPU path outright). This package holds the analogous native
+pieces: small C++ translation units compiled once into a shared library
+with the system toolchain and bound via ctypes — no Python stand-ins for
+the serial hot paths.
+
+`lib()` compiles on first use (cached in _build/, invalidated by source
+mtime) and returns the loaded ctypes library, or None when no compiler
+is available — callers fall back to their pure-Python equivalent.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src")
+_BUILD = os.path.join(_DIR, "_build")
+_LIB_PATH = os.path.join(_BUILD, "libamgx_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_attempted_sig = None     # source signature of the last build attempt
+
+
+def _src_signature():
+    return tuple(sorted(
+        (f, os.path.getmtime(os.path.join(_SRC, f)))
+        for f in os.listdir(_SRC) if f.endswith(".cpp")))
+
+
+def _lib_current(sig) -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return False
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    return all(mtime <= lib_mtime for _, mtime in sig)
+
+
+def _build() -> bool:
+    os.makedirs(_BUILD, exist_ok=True)
+    srcs = sorted(
+        os.path.join(_SRC, f) for f in os.listdir(_SRC)
+        if f.endswith(".cpp"))
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+           "-o", _LIB_PATH] + srcs
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+
+
+def lib():
+    """The loaded native library, or None if unavailable. A failed build
+    is cached per source signature — no repeated compiler spawns."""
+    global _lib, _attempted_sig
+    with _lock:
+        sig = _src_signature()
+        if _attempted_sig == sig:
+            return _lib
+        _attempted_sig = sig
+        _lib = None
+        if not _lib_current(sig) and not _build():
+            return None
+        try:
+            _lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _lib = None
+    return _lib
+
+
+def rs_coarsen_native(n, row_offsets, col_indices, strong):
+    """Native RS first-pass coarsening; returns cf_map (n,) int32 or
+    None when the native library is unavailable."""
+    import numpy as np
+    L = lib()
+    if L is None:
+        return None
+    fn = L.amgx_rs_coarsen
+    fn.restype = ctypes.c_int
+    ro = np.ascontiguousarray(row_offsets, np.int32)
+    ci = np.ascontiguousarray(col_indices, np.int32)
+    st = np.ascontiguousarray(strong, np.uint8)
+    cf = np.empty(n, np.int32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    rc = fn(ctypes.c_int32(n),
+            ro.ctypes.data_as(i32p), ci.ctypes.data_as(i32p),
+            st.ctypes.data_as(u8p), cf.ctypes.data_as(i32p))
+    if rc != 0:
+        return None
+    return cf

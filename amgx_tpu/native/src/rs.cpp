@@ -1,0 +1,121 @@
+// Serial Ruge-Stueben first-pass coarsening (bucket priority queue).
+//
+// TPU-native-framework native component: the reference itself declares RS
+// "a sequential algorithm" and refuses to run it on the GPU
+// (src/classical/selectors/rs.cu:269-277 raises); its HMIS selector copies
+// the matrix to the HOST and runs this exact serial pass there
+// (src/classical/selectors/hmis.cu:55-82). This C++ implementation is the
+// analog of that host path: it runs once per setup on the controller CPU.
+//
+// Algorithm (classical RS first pass):
+//   lambda_i = |S^T_i|  (number of points strongly depending on i)
+//   repeat: pick unassigned i with max lambda -> COARSE;
+//           unassigned j in S^T_i -> FINE;
+//           for each new FINE j: lambda_k += 1 for unassigned k in S_j.
+//   points left with lambda == 0 -> FINE.
+//
+// Buckets are doubly-linked lists indexed by lambda, giving O(n + nnz).
+
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+struct BucketQueue {
+    // node lists per weight; weights can grow to at most n
+    std::vector<int32_t> head;   // head[w] = first node with weight w
+    std::vector<int32_t> prev, next, weight;
+    int32_t maxw;
+
+    explicit BucketQueue(int32_t n)
+        : head(n + 2, -1), prev(n, -1), next(n, -1), weight(n, 0),
+          maxw(0) {}
+
+    void push(int32_t i, int32_t w) {
+        weight[i] = w;
+        prev[i] = -1;
+        next[i] = head[w];
+        if (head[w] >= 0) prev[head[w]] = i;
+        head[w] = i;
+        if (w > maxw) maxw = w;
+    }
+
+    void remove(int32_t i) {
+        int32_t w = weight[i];
+        if (prev[i] >= 0) next[prev[i]] = next[i];
+        else head[w] = next[i];
+        if (next[i] >= 0) prev[next[i]] = prev[i];
+        prev[i] = next[i] = -1;
+    }
+
+    void bump(int32_t i) {  // weight[i] += 1
+        remove(i);
+        push(i, weight[i] + 1);
+    }
+
+    int32_t pop_max() {  // -1 when empty
+        while (maxw >= 0 && head[maxw] < 0) --maxw;
+        if (maxw < 0) return -1;
+        int32_t i = head[maxw];
+        remove(i);
+        return i;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+// cf_map out: 0 = FINE, 1 = COARSE. strong: per-nnz boolean mask.
+// Returns 0 on success.
+int amgx_rs_coarsen(int32_t n, const int32_t* row_offsets,
+                    const int32_t* col_indices, const uint8_t* strong,
+                    int32_t* cf_map) {
+    const int32_t UNASSIGNED = -1, FINE = 0, COARSE = 1;
+    // S^T in CSR form (strong edges only, cols within [0, n))
+    std::vector<int32_t> st_off(n + 1, 0);
+    for (int32_t i = 0; i < n; ++i)
+        for (int32_t j = row_offsets[i]; j < row_offsets[i + 1]; ++j)
+            if (strong[j] && col_indices[j] < n && col_indices[j] != i)
+                ++st_off[col_indices[j] + 1];
+    for (int32_t i = 0; i < n; ++i) st_off[i + 1] += st_off[i];
+    std::vector<int32_t> st_col(st_off[n]);
+    {
+        std::vector<int32_t> cur(st_off.begin(), st_off.end() - 1);
+        for (int32_t i = 0; i < n; ++i)
+            for (int32_t j = row_offsets[i]; j < row_offsets[i + 1]; ++j)
+                if (strong[j] && col_indices[j] < n && col_indices[j] != i)
+                    st_col[cur[col_indices[j]]++] = i;
+    }
+
+    BucketQueue q(n);
+    std::vector<int32_t> state(n, UNASSIGNED);
+    for (int32_t i = 0; i < n; ++i) {
+        int32_t lam = st_off[i + 1] - st_off[i];
+        if (lam == 0) state[i] = FINE;   // nothing depends on it
+        else q.push(i, lam);
+    }
+
+    for (;;) {
+        int32_t i = q.pop_max();
+        if (i < 0) break;
+        if (state[i] != UNASSIGNED) continue;
+        state[i] = COARSE;
+        for (int32_t t = st_off[i]; t < st_off[i + 1]; ++t) {
+            int32_t j = st_col[t];
+            if (state[j] != UNASSIGNED) continue;
+            state[j] = FINE;
+            q.remove(j);
+            for (int32_t u = row_offsets[j]; u < row_offsets[j + 1]; ++u) {
+                int32_t k = col_indices[u];
+                if (strong[u] && k < n && state[k] == UNASSIGNED)
+                    q.bump(k);
+            }
+        }
+    }
+    for (int32_t i = 0; i < n; ++i)
+        cf_map[i] = (state[i] == COARSE) ? 1 : 0;
+    return 0;
+}
+
+}  // extern "C"

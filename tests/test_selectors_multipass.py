@@ -1,0 +1,185 @@
+"""RS / HMIS / CR selectors + MULTIPASS interpolation tests
+(analogs of the reference's selector coverage and the aggressive
+coarsening + multipass configs)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import amgx_tpu as amgx
+from amgx_tpu import gallery, registry
+from amgx_tpu.config import Config
+from amgx_tpu.solvers import make_solver
+from amgx_tpu.amg.classical.selectors import (rs_split, rs_split_python,
+                                              pmis_split)
+
+amgx.initialize()
+
+
+@pytest.fixture(scope="module")
+def A16():
+    return gallery.poisson("5pt", 16, 16).init()
+
+
+@pytest.fixture(scope="module")
+def strength16(A16):
+    cfg = Config.from_string("strength_threshold=0.25")
+    return registry.strength.create("AHAT", cfg, "default").strong_mask(A16)
+
+
+def _check_valid_split(A, strong, cf):
+    """Every F point must have at least one strong C neighbor (the RS
+    first-pass invariant; interpolation needs it)."""
+    rows, cols, _ = A.coo()
+    rows, cols = np.asarray(rows), np.asarray(cols)
+    s = np.asarray(strong)
+    cfn = np.asarray(cf)
+    has_c = np.zeros(A.num_rows, bool)
+    m = s & (cfn[cols] == 1)
+    has_c[rows[m]] = True
+    fine = cfn == 0
+    assert np.all(has_c[fine]), "F point without strong C neighbor"
+
+
+class TestRS:
+    def test_rs_valid_split(self, A16, strength16):
+        cf = rs_split(A16, strength16)
+        _check_valid_split(A16, strength16, cf)
+        ratio = float(jnp.mean((cf == 1).astype(jnp.float64)))
+        assert 0.15 < ratio < 0.75
+
+    def test_native_matches_python(self, A16, strength16):
+        from amgx_tpu.native import rs_coarsen_native
+        n = A16.num_rows
+        ro = np.asarray(A16.row_offsets)
+        ci = np.asarray(A16.col_indices)
+        st = np.asarray(strength16, np.uint8)
+        nat = rs_coarsen_native(n, ro, ci, st)
+        if nat is None:
+            pytest.skip("no C++ toolchain; python fallback is the contract")
+        py = rs_split_python(n, ro, ci, st)
+        np.testing.assert_array_equal(nat, py)
+
+    def test_native_matches_python_random(self):
+        """Tie-breaking must agree on irregular graphs too, or the same
+        config builds different hierarchies with/without a compiler."""
+        from amgx_tpu.native import rs_coarsen_native
+        rng = np.random.default_rng(9)
+        n = 60
+        D = (rng.random((n, n)) < 0.08)
+        D = D | D.T
+        np.fill_diagonal(D, True)
+        rows, cols = np.nonzero(D)
+        ro = np.zeros(n + 1, np.int32)
+        np.add.at(ro, rows + 1, 1)
+        np.cumsum(ro, out=ro)
+        strong = ((rows != cols) & (rng.random(len(rows)) < 0.8)
+                  ).astype(np.uint8)
+        nat = rs_coarsen_native(n, ro, cols.astype(np.int32), strong)
+        if nat is None:
+            pytest.skip("no C++ toolchain; python fallback is the contract")
+        py = rs_split_python(n, ro, cols.astype(np.int32), strong)
+        np.testing.assert_array_equal(nat, py)
+
+    def test_hmis_is_rs_single_device(self, A16, strength16):
+        """Single-device HMIS keeps the RS assignment (the PMIS pass only
+        fixes partition boundaries, hmis.cu:55-82)."""
+        sel = registry.classical_selectors.create(
+            "HMIS", Config.from_string(""), "default")
+        cf_h = sel.mark_coarse_fine_points(A16, strength16)
+        cf_rs = rs_split(A16, strength16)
+        np.testing.assert_array_equal(np.asarray(cf_h), np.asarray(cf_rs))
+
+    def test_hmis_amg_converges(self):
+        A = gallery.poisson("5pt", 24, 24).init()
+        cfg = Config.from_string(
+            "solver=AMG, algorithm=CLASSICAL, selector=HMIS, "
+            "interpolator=D2, max_iters=60, tolerance=1e-8, "
+            "monitor_residual=1, convergence=RELATIVE_INI_CORE")
+        slv = make_solver("AMG", cfg, "default").setup(A)
+        res = slv.solve(np.ones(A.num_rows))
+        assert res.converged and res.iterations <= 50
+
+    def test_hmis_differs_from_pmis(self, A16, strength16):
+        """HMIS (serial RS) and PMIS make different grids — guard against
+        re-aliasing."""
+        cf_h = np.asarray(rs_split(A16, strength16))
+        cf_p = np.asarray(pmis_split(A16, strength16))
+        assert not np.array_equal(cf_h, cf_p)
+
+
+class TestCR:
+    def test_cr_valid_selector(self, A16, strength16):
+        sel = registry.classical_selectors.create(
+            "CR", Config.from_string(""), "default")
+        cf = np.asarray(sel.mark_coarse_fine_points(A16, strength16))
+        assert set(np.unique(cf)) <= {0, 1}
+        ratio = cf.mean()
+        assert 0.0 < ratio < 0.9          # picked something, not all
+
+    def test_cr_amg_converges(self):
+        A = gallery.poisson("5pt", 16, 16).init()
+        cfg = Config.from_string(
+            "solver=AMG, algorithm=CLASSICAL, selector=CR, "
+            "interpolator=D1, max_iters=60, tolerance=1e-8, "
+            "monitor_residual=1, convergence=RELATIVE_INI_CORE")
+        slv = make_solver("AMG", cfg, "default").setup(A)
+        res = slv.solve(np.ones(A.num_rows))
+        assert res.converged
+
+
+class TestMultipass:
+    def test_pass_one_equals_d1_on_direct_points(self, A16, strength16):
+        """Where every F point has a strong C neighbor (pass 1
+        everywhere), MULTIPASS reduces to D1 exactly."""
+        cf = pmis_split(A16, strength16)
+        cfg = Config.from_string("")
+        d1 = registry.interpolators.create("D1", cfg, "default")
+        mp = registry.interpolators.create("MULTIPASS", cfg, "default")
+        P1 = d1.generate(A16, cf, strength16)
+        P2 = mp.generate(A16, cf, strength16)
+        np.testing.assert_allclose(np.asarray(P1.to_dense()),
+                                   np.asarray(P2.to_dense()), atol=1e-12)
+
+    def test_multipass_covers_aggressive_f_points(self):
+        """After aggressive (two-hop) coarsening some F points have no
+        strong C neighbor; multipass must still give them interpolation
+        weights (D1 leaves their rows empty)."""
+        A = gallery.poisson("5pt", 20, 20).init()
+        cfg = Config.from_string("strength_threshold=0.25")
+        strong = registry.strength.create("AHAT", cfg, "default"
+                                          ).strong_mask(A)
+        sel = registry.classical_selectors.create("AGGRESSIVE_PMIS", cfg,
+                                                  "default")
+        cf = sel.mark_coarse_fine_points(A, strong)
+        d1 = registry.interpolators.create("D1", cfg, "default")
+        mp = registry.interpolators.create("MULTIPASS", cfg, "default")
+        P1 = np.asarray(d1.generate(A, cf, strong).to_dense())
+        P2 = np.asarray(mp.generate(A, cf, strong).to_dense())
+        fine = np.asarray(cf) == 0
+        empty_d1 = fine & (np.abs(P1).sum(1) == 0)
+        assert empty_d1.any(), "expected distance>1 F points"
+        assert np.all(np.abs(P2).sum(1)[empty_d1] > 0)
+        # near-constant preservation: interior F rows sum to ~1 (rows
+        # whose substitution chain touches the boundary legitimately sum
+        # below 1, mirroring D1's boundary behavior)
+        rowsums = P2.sum(1)
+        interior = np.abs(np.asarray(A.to_dense()).sum(1)) < 1e-12
+        chk = fine & interior
+        assert chk.any()
+        assert np.all(rowsums[chk] <= 1.0 + 1e-10)
+        assert np.all(rowsums[chk] >= 0.5)
+        assert (np.abs(rowsums[chk] - 1.0) < 1e-10).mean() > 0.9
+
+    def test_aggressive_multipass_amg_converges(self):
+        A = gallery.poisson("27pt", 10, 10, 10).init()
+        cfg = Config.from_string(
+            "solver=AMG, algorithm=CLASSICAL, selector=PMIS, "
+            "aggressive_levels=1, aggressive_interpolator=MULTIPASS, "
+            "interpolator=D2, max_iters=60, tolerance=1e-8, "
+            "monitor_residual=1, convergence=RELATIVE_INI_CORE")
+        slv = make_solver("AMG", cfg, "default").setup(A)
+        res = slv.solve(np.ones(A.num_rows))
+        assert res.converged and res.iterations <= 40
+        # aggressive coarsening really shrank level 1
+        lvl1 = slv.amg.levels[0].coarse_size
+        assert lvl1 < 0.25 * A.num_rows
