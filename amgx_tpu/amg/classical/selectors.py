@@ -114,8 +114,10 @@ def rs_split_python(n, row_offsets, col_indices, strong):
     np.add.at(s_off, s_r + 1, 1)
     np.cumsum(s_off, out=s_off)
 
-    # bucket queue: head per weight + doubly-linked node lists (rs.cpp)
-    head = np.full(n + 2, -1, np.int64)
+    # bucket queue: head per weight + doubly-linked node lists (rs.cpp);
+    # weights are bounded by 2*|S^T_i| (initial in-degree + one bump per
+    # in-edge), hence the 2n+2 sizing
+    head = np.full(2 * n + 2, -1, np.int64)
     prev = np.full(n, -1, np.int64)
     nxt = np.full(n, -1, np.int64)
     weight = np.zeros(n, np.int64)
@@ -143,9 +145,12 @@ def rs_split_python(n, row_offsets, col_indices, strong):
         prev[i] = nxt[i] = -1
 
     lam = np.diff(st_off).astype(np.int64)
+    out_deg = np.diff(s_off)
     state = np.full(n, UNDECIDED, np.int32)
     in_q = lam > 0
-    state[~in_q] = FINE
+    # lam==0: FINE, except fully strong-isolated points (no in- or
+    # out-edges) which cannot interpolate -> COARSE (pmis convention)
+    state[~in_q] = np.where(out_deg[~in_q] == 0, COARSE, FINE)
     # push in ascending node order, exactly like the C++ loop
     for i in range(n):
         if in_q[i]:
@@ -177,13 +182,14 @@ def rs_split_python(n, row_offsets, col_indices, strong):
 def rs_split(A: CsrMatrix, strong):
     """RS first-pass coarsening: native C++ bucket queue, Python
     fallback."""
-    from ...native import rs_coarsen_native
+    from ...native import rs_coarsen_native, warn_python_fallback
     n = A.num_rows
     ro = np.asarray(A.row_offsets)
     ci = np.asarray(A.col_indices)
     st = np.asarray(strong, np.uint8)
     cf = rs_coarsen_native(n, ro, ci, st)
     if cf is None:
+        warn_python_fallback("RS coarsening", n)
         cf = rs_split_python(n, ro, ci, st)
     return jnp.asarray(cf, jnp.int32)
 

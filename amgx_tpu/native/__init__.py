@@ -7,71 +7,100 @@ pieces: small C++ translation units compiled once into a shared library
 with the system toolchain and bound via ctypes — no Python stand-ins for
 the serial hot paths.
 
-`lib()` compiles on first use (cached in _build/, invalidated by source
-mtime) and returns the loaded ctypes library, or None when no compiler
-is available — callers fall back to their pure-Python equivalent.
+`lib()` compiles on first use and returns the loaded ctypes library, or
+None when no compiler is available — callers fall back to their
+pure-Python equivalents. Build artifacts live in _build/ (gitignored),
+keyed by a content hash of the sources so stale binaries are never
+loaded; the .so is written atomically so concurrent processes cannot
+load a half-written file.
 """
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
+import warnings
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "src")
 _BUILD = os.path.join(_DIR, "_build")
-_LIB_PATH = os.path.join(_BUILD, "libamgx_native.so")
 
 _lock = threading.Lock()
 _lib = None
-_attempted_sig = None     # source signature of the last build attempt
+_attempted_hash = None    # content hash of the last build attempt
 
 
-def _src_signature():
-    return tuple(sorted(
-        (f, os.path.getmtime(os.path.join(_SRC, f)))
-        for f in os.listdir(_SRC) if f.endswith(".cpp")))
-
-
-def _lib_current(sig) -> bool:
-    if not os.path.exists(_LIB_PATH):
-        return False
-    lib_mtime = os.path.getmtime(_LIB_PATH)
-    return all(mtime <= lib_mtime for _, mtime in sig)
-
-
-def _build() -> bool:
-    os.makedirs(_BUILD, exist_ok=True)
-    srcs = sorted(
+def _src_files():
+    return sorted(
         os.path.join(_SRC, f) for f in os.listdir(_SRC)
         if f.endswith(".cpp"))
+
+
+def _src_hash() -> str:
+    h = hashlib.sha256()
+    for path in _src_files():
+        h.update(os.path.basename(path).encode())
+        with open(path, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _lib_path(src_hash: str) -> str:
+    return os.path.join(_BUILD, f"libamgx_native-{src_hash}.so")
+
+
+def _build(target: str) -> bool:
+    os.makedirs(_BUILD, exist_ok=True)
+    tmp = target + f".tmp{os.getpid()}"
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-           "-o", _LIB_PATH] + srcs
+           "-o", tmp] + _src_files()
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, target)          # atomic publish
         return True
-    except (subprocess.SubprocessError, FileNotFoundError):
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 
 def lib():
     """The loaded native library, or None if unavailable. A failed build
-    is cached per source signature — no repeated compiler spawns."""
-    global _lib, _attempted_sig
+    is cached per source hash — no repeated compiler spawns."""
+    global _lib, _attempted_hash
     with _lock:
-        sig = _src_signature()
-        if _attempted_sig == sig:
+        h = _src_hash()
+        if _attempted_hash == h:
             return _lib
-        _attempted_sig = sig
+        _attempted_hash = h
         _lib = None
-        if not _lib_current(sig) and not _build():
+        target = _lib_path(h)
+        if not os.path.exists(target) and not _build(target):
             return None
         try:
-            _lib = ctypes.CDLL(_LIB_PATH)
+            _lib = ctypes.CDLL(target)
         except OSError:
             _lib = None
     return _lib
+
+
+_warned_fallback = False
+
+
+def warn_python_fallback(component: str, n: int):
+    """One-shot warning when a serial native component falls back to
+    Python on a large problem."""
+    global _warned_fallback
+    if not _warned_fallback and n > 100_000:
+        _warned_fallback = True
+        warnings.warn(
+            f"native library unavailable (no C++ toolchain?); {component} "
+            f"is running its pure-Python fallback on n={n} rows — setup "
+            "will be slow", RuntimeWarning)
 
 
 def rs_coarsen_native(n, row_offsets, col_indices, strong):

@@ -16,20 +16,23 @@
 //
 // Buckets are doubly-linked lists indexed by lambda, giving O(n + nnz).
 
+#include <cstddef>
 #include <cstdint>
 #include <vector>
 
 namespace {
 
 struct BucketQueue {
-    // node lists per weight; weights can grow to at most n
+    // node lists per weight; a node's weight is bounded by
+    // 2*|S^T_i| <= 2(n-1): the initial in-degree plus at most one bump
+    // per in-edge (each neighbor turns FINE once)
     std::vector<int32_t> head;   // head[w] = first node with weight w
     std::vector<int32_t> prev, next, weight;
     int32_t maxw;
 
     explicit BucketQueue(int32_t n)
-        : head(n + 2, -1), prev(n, -1), next(n, -1), weight(n, 0),
-          maxw(0) {}
+        : head(2 * static_cast<size_t>(n) + 2, -1), prev(n, -1),
+          next(n, -1), weight(n, 0), maxw(0) {}
 
     void push(int32_t i, int32_t w) {
         weight[i] = w;
@@ -88,12 +91,26 @@ int amgx_rs_coarsen(int32_t n, const int32_t* row_offsets,
                     st_col[cur[col_indices[j]]++] = i;
     }
 
+    // strong out-degree (does i depend on anyone?) for the isolated test
+    std::vector<int32_t> out_deg(n, 0);
+    for (int32_t i = 0; i < n; ++i)
+        for (int32_t j = row_offsets[i]; j < row_offsets[i + 1]; ++j)
+            if (strong[j] && col_indices[j] < n && col_indices[j] != i)
+                ++out_deg[i];
+
     BucketQueue q(n);
     std::vector<int32_t> state(n, UNASSIGNED);
     for (int32_t i = 0; i < n; ++i) {
         int32_t lam = st_off[i + 1] - st_off[i];
-        if (lam == 0) state[i] = FINE;   // nothing depends on it
-        else q.push(i, lam);
+        if (lam == 0) {
+            // nothing depends on it: FINE — unless it is fully strong-
+            // isolated (no in- OR out-edges), which cannot interpolate
+            // and must be COARSE (framework convention, matching
+            // pmis_split's isolated-point handling)
+            state[i] = (out_deg[i] == 0) ? COARSE : FINE;
+        } else {
+            q.push(i, lam);
+        }
     }
 
     for (;;) {

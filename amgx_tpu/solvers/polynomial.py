@@ -1,0 +1,223 @@
+"""Polynomial smoothers: POLYNOMIAL, KPZ_POLYNOMIAL, CHEBYSHEV_POLY.
+
+TPU-native analogs of src/solvers/polynomial_solver.cu (351 LoC),
+kpz_polynomial_solver.cu (227), chebyshev_poly.cu (371). Polynomial
+smoothers are ideal TPU smoothers: no coloring, no triangular solves —
+each application is `order` SpMVs plus AXPYs, which XLA fuses into a
+short straight-line program.
+
+- POLYNOMIAL: Chebyshev relaxation on the interval [rho/30, 1.1*rho]
+  (the bundled-CUSP convention the reference delegates to,
+  polynomial_solver.cu:146-155: ritz_spectral_radius_symmetric +
+  chebyshev_polynomial_coefficients); rho estimated at setup with a
+  short device Lanczos, degree = kpz_order.
+- KPZ_POLYNOMIAL: the KPZ three-term recurrence exactly as in
+  kpz_polynomial_solver.cu:140-193 (smax = ||A||_inf via the transpose
+  row sums, smin = smax/kpz_mu, delta/beta/chi coefficients).
+- CHEBYSHEV_POLY: the "magic damping" tau sequence of chebyshev_poly.cu
+  (tau_i = cos^2(beta) / (cos^2(beta(2i+1)) - sin^2(beta)) / lambda,
+  beta = pi/(4m+2), lambda = Gershgorin max row sum,
+  chebyshev_poly.cu:65-74,188-198), applied as x += tau_i (b - A x).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import registry
+from ..errors import BadParametersError
+from ..ops.spmv import spmv
+from .base import Solver
+
+
+def _abs_row_sums(A):
+    rows, cols, vals = A.coo()
+    s = jax.ops.segment_sum(jnp.abs(vals), rows, num_segments=A.num_rows,
+                            indices_are_sorted=True)
+    if A.has_external_diag:
+        s = s + jnp.abs(A.diag)
+    return s
+
+
+def _lanczos_rho(A, steps: int = 8) -> float:
+    """Spectral-radius estimate by a short Lanczos run
+    (cusp ritz_spectral_radius_symmetric analog). Host-orchestrated at
+    setup; each step is one device SpMV."""
+    n = A.num_rows
+    rng = np.random.default_rng(17)
+    v = jnp.asarray(rng.standard_normal(n), A.dtype)
+    v = v / jnp.linalg.norm(v)
+    steps = min(steps, n)
+    alphas, betas = [], []
+    v_prev = jnp.zeros_like(v)
+    beta = 0.0
+    for _ in range(steps):
+        w = spmv(A, v) - beta * v_prev
+        alpha = float(jnp.dot(v, w))
+        w = w - alpha * v
+        beta = float(jnp.linalg.norm(w))
+        alphas.append(alpha)
+        betas.append(beta)
+        if beta < 1e-12:
+            break
+        v_prev, v = v, w / beta
+    k = len(alphas)
+    T = np.diag(alphas)
+    for i in range(k - 1):
+        T[i, i + 1] = T[i + 1, i] = betas[i]
+    return float(np.max(np.abs(np.linalg.eigvalsh(T)))) * 1.01
+
+
+@registry.solvers.register("POLYNOMIAL")
+class PolynomialSolver(Solver):
+    """Chebyshev relaxation smoother (polynomial_solver.cu scalar path).
+    One application = `kpz_order` SpMVs via the stable three-term
+    Chebyshev semi-iteration on [rho/30, 1.1 rho]."""
+
+    is_smoother = True
+
+    def __init__(self, cfg, scope="default", name="POLYNOMIAL"):
+        super().__init__(cfg, scope, name)
+        order = int(cfg.get("kpz_order", scope))
+        self.order = order if order > 0 else 6   # ndeg0==0 -> 6 (:114)
+
+    def solver_setup(self):
+        if self.A.is_block:
+            raise BadParametersError(
+                "POLYNOMIAL smoother supports scalar matrices")
+        rho = _lanczos_rho(self.A)
+        self.lmax = 1.1 * rho
+        self.lmin = rho / 30.0
+
+    def solve_data(self):
+        d = super().solve_data()
+        d["lmin"] = jnp.asarray(self.lmin, self.A.dtype)
+        d["lmax"] = jnp.asarray(self.lmax, self.A.dtype)
+        return d
+
+    def computes_residual(self):
+        return False
+
+    def solve_iteration(self, data, b, st):
+        A = data["A"]
+        lmin, lmax = data["lmin"], data["lmax"]
+        theta = 0.5 * (lmax + lmin)
+        delta = 0.5 * (lmax - lmin)
+        x = st["x"]
+        r = b - spmv(A, x)
+        # Chebyshev semi-iteration (fixed `order` steps, unrolled)
+        sigma = theta / delta
+        rho_c = 1.0 / sigma
+        d = r / theta
+        for _ in range(self.order):
+            x = x + d
+            r = r - spmv(A, d)
+            rho_new = 1.0 / (2.0 * sigma - rho_c)
+            d = rho_new * rho_c * d + 2.0 * rho_new / delta * r
+            rho_c = rho_new
+        out = dict(st)
+        out["x"] = x
+        return out
+
+
+@registry.solvers.register("KPZ_POLYNOMIAL")
+class KPZPolynomialSolver(Solver):
+    """KPZ polynomial smoother (kpz_polynomial_solver.cu:140-193)."""
+
+    is_smoother = True
+
+    def __init__(self, cfg, scope="default", name="KPZ_POLYNOMIAL"):
+        super().__init__(cfg, scope, name)
+        self.mu = int(cfg.get("kpz_mu", scope))
+        self.order = max(int(cfg.get("kpz_order", scope)), 1)
+
+    def solver_setup(self):
+        if self.A.is_block:
+            raise BadParametersError(
+                "KPZ_POLYNOMIAL supports scalar matrices")
+        # l_inf = max column abs-sum (computed on A^T in the reference,
+        # kpz_polynomial_solver.cu:89-99)
+        rows, cols, vals = self.A.coo()
+        colsum = jax.ops.segment_sum(jnp.abs(vals), cols,
+                                     num_segments=self.A.num_cols)
+        if self.A.has_external_diag:
+            colsum = colsum + jnp.abs(self.A.diag)
+        self.l_inf = float(jnp.max(colsum))
+
+    def solve_data(self):
+        d = super().solve_data()
+        d["l_inf"] = jnp.asarray(self.l_inf, self.A.dtype)
+        return d
+
+    def computes_residual(self):
+        return False
+
+    def solve_iteration(self, data, b, st):
+        A = data["A"]
+        smax = data["l_inf"]
+        smin = smax / self.mu
+        smu0 = 1.0 / smax
+        smu1 = 1.0 / smin
+        skappa = jnp.sqrt(smax / smin)
+        delta = (skappa - 1.0) / (skappa + 1.0)
+        beta = (jnp.sqrt(smu0) + jnp.sqrt(smu1)) ** 2
+        chi = 4.0 * smu0 * smu1 / beta
+        x = st["x"]
+        r = b - spmv(A, x)
+        v0 = (smu0 + smu1) / 2.0 * r
+        v = beta / 2.0 * r - smu0 * smu1 * spmv(A, r)
+        for _ in range(2, self.order + 1):
+            sn = r - spmv(A, v)
+            sn = chi * sn + delta * delta * v - delta * delta * v0
+            v0 = v
+            v = v + sn
+        out = dict(st)
+        out["x"] = x + v
+        return out
+
+
+@registry.solvers.register("CHEBYSHEV_POLY")
+class ChebyshevPolySolver(Solver):
+    """'Magic damping' Chebyshev smoother (chebyshev_poly.cu). One
+    application = `chebyshev_polynomial_order` damped Richardson steps
+    x += tau_i (b - A x)."""
+
+    is_smoother = True
+
+    def __init__(self, cfg, scope="default", name="CHEBYSHEV_POLY"):
+        super().__init__(cfg, scope, name)
+        order = int(cfg.get("chebyshev_polynomial_order", scope))
+        self.order = min(10, max(order, 1))      # clamp (:102-103)
+
+    def solver_setup(self):
+        if self.A.is_block:
+            raise BadParametersError(
+                "CHEBYSHEV_POLY supports scalar matrices")
+        lam = float(jnp.max(_abs_row_sums(self.A)))   # Gershgorin bound
+        m = self.order
+        beta = np.pi / (4.0 * m + 2.0)
+        taus = [
+            (np.cos(beta) ** 2
+             / (np.cos(beta * (2 * i + 1)) ** 2 - np.sin(beta) ** 2))
+            / lam
+            for i in range(m)
+        ]
+        self._taus = jnp.asarray(np.array(taus), self.A.dtype)
+
+    def solve_data(self):
+        d = super().solve_data()
+        d["taus"] = self._taus
+        return d
+
+    def computes_residual(self):
+        return False
+
+    def solve_iteration(self, data, b, st):
+        A = data["A"]
+        x = st["x"]
+        for i in range(self.order):
+            x = x + data["taus"][i] * (b - spmv(A, x))
+        out = dict(st)
+        out["x"] = x
+        return out

@@ -99,6 +99,54 @@ class TestRS:
         res = slv.solve(np.ones(A.num_rows))
         assert res.converged and res.iterations <= 50
 
+    def test_rs_high_indegree_hub(self):
+        """Bucket weights can reach 2x the max in-degree (one bump per
+        in-edge); regression for the head[] overflow: a hub node whose
+        weight doubles after its dependents turn FINE."""
+        from amgx_tpu.matrix import CsrMatrix
+        # nodes 1-4 strongly depend on hub 0 and on node 5; plus a
+        # 1->2->3->4->1 cycle so the bumps land on the hub
+        edges = [(i, 0) for i in range(1, 5)] + \
+                [(i, 5) for i in range(1, 5)] + \
+                [(1, 2), (2, 3), (3, 4), (4, 1)]
+        n = 6
+        rows = np.array([e[0] for e in edges])
+        cols = np.array([e[1] for e in edges])
+        A = CsrMatrix.from_coo(rows, cols, -np.ones(len(edges)), n, n)
+        A = CsrMatrix.from_coo(
+            np.concatenate([rows, np.arange(n)]),
+            np.concatenate([cols, np.arange(n)]),
+            np.concatenate([-np.ones(len(edges)), 4.0 * np.ones(n)]),
+            n, n).init()
+        strong = np.asarray(A.coo()[0]) != np.asarray(A.coo()[1])
+        cf_py = rs_split_python(n, np.asarray(A.row_offsets),
+                                np.asarray(A.col_indices),
+                                strong.astype(np.uint8))
+        from amgx_tpu.native import rs_coarsen_native
+        cf_nat = rs_coarsen_native(n, np.asarray(A.row_offsets),
+                                   np.asarray(A.col_indices),
+                                   strong.astype(np.uint8))
+        if cf_nat is not None:
+            np.testing.assert_array_equal(cf_nat, cf_py)
+        assert set(np.unique(cf_py)) <= {0, 1}
+
+    def test_rs_isolated_point_coarse(self):
+        """Strong-isolated (Dirichlet) rows must be COARSE like
+        pmis_split makes them, or their P row is empty."""
+        from amgx_tpu.matrix import CsrMatrix
+        A = gallery.poisson("5pt", 8, 8)
+        n = A.num_rows
+        rows, cols, vals = [np.asarray(x) for x in A.coo()]
+        # cut row 10 and column 10 couplings: fully isolated point
+        keep = ~(((rows == 10) | (cols == 10)) & (rows != cols))
+        A2 = CsrMatrix.from_coo(rows[keep], cols[keep], vals[keep],
+                                n, n).init()
+        r2, c2, _ = A2.coo()
+        strong = np.asarray(r2 != c2)
+        cf = np.asarray(rs_split(A2, strong))
+        assert cf[10] == 1
+        _check_valid_split(A2, strong, cf)
+
     def test_hmis_differs_from_pmis(self, A16, strength16):
         """HMIS (serial RS) and PMIS make different grids — guard against
         re-aliasing."""
