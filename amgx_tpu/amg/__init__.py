@@ -3,6 +3,7 @@
 from . import hierarchy  # noqa: F401
 from . import aggregation  # noqa: F401
 from . import classical  # noqa: F401
+from . import energymin  # noqa: F401
 from . import solver  # noqa: F401
 
 from .hierarchy import AMG, AMGLevel  # noqa: F401

@@ -1,0 +1,171 @@
+"""Energy-minimization AMG level — the third algorithm type.
+
+TPU-native analog of src/energymin/ (energymin_amg_level.cu 431 LoC,
+interpolators/em.cu 1280 LoC, selectors/em_selector.cu). The reference's
+EM interpolator builds, for every coarse point, a local dense patch of A
+over the column's fine-point support, inverts it on-device, and
+assembles the inverses into the interpolation operator
+(em.cu: extract_dense_Aijs_col_major -> init_dense_invAijs ->
+init_Pvalues kernels).
+
+TPU redesign of the same scheme: every coarse point's patch is padded to
+one static size and the whole set is solved as ONE batched dense
+`jnp.linalg.solve` — (nc, k, k) patches ride the MXU, replacing the
+reference's per-column warp kernels. Column j's values are the local
+harmonic extension (energy minimizer with unit value at the coarse
+point):
+
+    p_F = - A[F_j, F_j]^{-1} A[F_j, c_j],   F_j = fine neighbors of c_j
+
+which minimizes p^T A p over the patch subject to p[c_j] = 1. Fine rows
+covered by several columns are row-rescaled to preserve constants (the
+role of the reference's Ma row-sum system, em.cu count_Ma_* kernels).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import registry
+from ...matrix import CsrMatrix
+from ...ops.spgemm import galerkin_rap
+from ...ops.spmv import spmv
+from ...ops.transpose import transpose
+from ..hierarchy import AMGLevel
+
+
+class EnergyminInterpolator:
+    def __init__(self, cfg, scope):
+        self.cfg = cfg
+        self.scope = scope
+
+    def generate(self, A: CsrMatrix, cf_map, strong) -> CsrMatrix:
+        raise NotImplementedError
+
+
+@registry.energymin_interpolators.register("EM")
+class EMInterpolator(EnergyminInterpolator):
+    """Batched local energy-minimization interpolation (em.cu analog)."""
+
+    def generate(self, A: CsrMatrix, cf_map, strong) -> CsrMatrix:
+        n = A.num_rows
+        rows, cols, vals = [np.asarray(x) for x in A.coo()]
+        valsj = A.coo()[2]
+        cf = np.asarray(cf_map)
+        is_C = cf == 1
+        cidx = np.cumsum(is_C) - 1                # coarse ids
+        c_rows = np.where(is_C)[0]                # fine index per column
+        nc = len(c_rows)
+        dt = np.asarray(A.values).dtype
+
+        # column supports: fine neighbors of each coarse point (its A
+        # row, restricted to F points) — greedy distance-1 sparsity,
+        # matching init_ProwInd_greedy_aggregation's neighborhood choice
+        ro = np.asarray(A.row_offsets)
+        supports = []
+        kmax = 1
+        for fc in c_rows:
+            nb = cols[ro[fc]: ro[fc + 1]]
+            fnb = nb[(~is_C[nb]) & (nb != fc)]
+            supports.append(fnb)
+            kmax = max(kmax, len(fnb))
+
+        # padded patch index array (nc, kmax); pad slot points at the
+        # coarse point itself (masked out of the solve)
+        F = np.full((nc, kmax), -1, np.int64)
+        for j, fnb in enumerate(supports):
+            F[j, : len(fnb)] = fnb
+        mask = F >= 0
+        Fsafe = np.where(mask, F, c_rows[:, None])
+
+        # A-entry lookup by (row, col) key over the sorted COO keys
+        keys = rows.astype(np.int64) * n + cols
+        order = np.argsort(keys)
+        skeys = keys[order]
+
+        def lookup(r_idx, c_idx):
+            """A[r, c] (0 when absent) for broadcastable index arrays."""
+            k = r_idx.astype(np.int64) * n + c_idx.astype(np.int64)
+            pos = np.searchsorted(skeys, k)
+            pos = np.clip(pos, 0, len(skeys) - 1)
+            hit = skeys[pos] == k
+            v = np.asarray(valsj)[order][pos]
+            return np.where(hit, v, 0.0)
+
+        # batched patches: A_FF (nc, k, k) and rhs a_Fc (nc, k)
+        A_FF = lookup(Fsafe[:, :, None], Fsafe[:, None, :])
+        rhs = lookup(Fsafe, c_rows[:, None])
+        m2 = mask[:, :, None] & mask[:, None, :]
+        eye = np.eye(kmax, dtype=dt)[None]
+        # padded patch entries -> identity rows so the batched solve
+        # stays well-posed and the padded unknowns come out zero
+        A_FF = np.where(m2, A_FF, eye)
+        rhs = np.where(mask, rhs, 0.0)
+
+        # one batched dense solve on the MXU (the em.cu patch inverses)
+        pF = -jnp.linalg.solve(jnp.asarray(A_FF),
+                               jnp.asarray(rhs)[..., None])[..., 0]
+        pF = np.asarray(pF)
+
+        # assemble P: injection for C rows + patch values for F rows
+        pr = np.concatenate([c_rows, F[mask]])
+        pc = np.concatenate([cidx[c_rows],
+                             np.repeat(cidx[c_rows], mask.sum(1))])
+        pv = np.concatenate([np.ones(nc, dt), pF[mask]])
+        # row rescale: preserve constants where several columns overlap
+        rowsum = np.zeros(n, dt)
+        np.add.at(rowsum, pr, pv)
+        scale = np.where(np.abs(rowsum) > 1e-12, 1.0 / np.where(
+            rowsum == 0, 1.0, rowsum), 1.0)
+        pv = pv * scale[pr]
+        return CsrMatrix.from_coo(pr, pc, pv, n, nc)
+
+
+@registry.amg_levels.register("ENERGYMIN")
+class EnergyminAMGLevel(AMGLevel):
+    """Energymin_AMG_Level analog: classical-style CF splitting (the
+    `energymin_selector` parameter, CR by default) + EM interpolation +
+    Galerkin RAP."""
+
+    algorithm = "ENERGYMIN"
+
+    def create_coarse_vertices(self):
+        from ...errors import BadParametersError
+        if self.A.is_block:
+            raise BadParametersError(
+                "ENERGYMIN AMG supports scalar matrices only")
+        cfg, scope = self.cfg, self.scope
+        st = registry.strength.create(str(cfg.get("strength", scope)),
+                                      cfg, scope)
+        self.strong = st.strong_mask(self.A)
+        sel_name = str(cfg.get("energymin_selector", scope))
+        if not registry.classical_selectors.has(sel_name):
+            sel_name = "CR"
+        sel = registry.classical_selectors.create(sel_name, cfg, scope)
+        self.cf_map = sel.mark_coarse_fine_points(self.A, self.strong)
+        self.coarse_size = int(jnp.sum(self.cf_map == 1))
+
+    def create_coarse_matrix(self) -> CsrMatrix:
+        cfg, scope = self.cfg, self.scope
+        interp_name = str(cfg.get("energymin_interpolator", scope))
+        if not registry.energymin_interpolators.has(interp_name):
+            interp_name = "EM"
+        interp = registry.energymin_interpolators.create(interp_name, cfg,
+                                                         scope)
+        self.P = interp.generate(self.A, self.cf_map, self.strong).init(
+            ell="never")
+        self.R = transpose(self.P).init(ell="never")
+        return galerkin_rap(self.R, self.A, self.P)
+
+    def level_data(self):
+        d = super().level_data()
+        d["P"] = self.P
+        d["R"] = self.R
+        return d
+
+    def restrict(self, data, r):
+        return spmv(data["R"], r)
+
+    def prolongate(self, data, xc):
+        return spmv(data["P"], xc)

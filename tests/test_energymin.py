@@ -1,0 +1,70 @@
+"""Energymin AMG tests (energymin_algorithm.cu analog)."""
+import numpy as np
+import pytest
+
+import amgx_tpu as amgx
+from amgx_tpu import gallery, registry
+from amgx_tpu.config import Config
+from amgx_tpu.solvers import make_solver
+
+amgx.initialize()
+
+
+@pytest.fixture(scope="module")
+def A():
+    return gallery.poisson("5pt", 16, 16).init()
+
+
+def test_em_interpolation_properties(A):
+    cfg = Config.from_string("strength_threshold=0.25")
+    strong = registry.strength.create("AHAT", cfg, "default"
+                                      ).strong_mask(A)
+    from amgx_tpu.amg.classical.selectors import pmis_split
+    cf = pmis_split(A, strong)
+    em = registry.energymin_interpolators.create("EM", cfg, "default")
+    P = em.generate(A, cf, strong)
+    Pd = np.asarray(P.to_dense())
+    cfn = np.asarray(cf)
+    # C rows are injection
+    crows = np.where(cfn == 1)[0]
+    cidx = np.cumsum(cfn == 1) - 1
+    for r in crows[:10]:
+        row = Pd[r]
+        assert row[cidx[r]] == 1.0 and np.count_nonzero(row) == 1
+    # covered F rows preserve constants
+    frows = np.abs(Pd).sum(1) > 0
+    fine = cfn == 0
+    sums = Pd.sum(1)[fine & frows]
+    np.testing.assert_allclose(sums, 1.0, atol=1e-10)
+
+
+def test_energymin_amg_converges(A):
+    cfg = Config.from_string(
+        "solver=AMG, algorithm=ENERGYMIN, energymin_selector=CR, "
+        "max_iters=60, tolerance=1e-8, monitor_residual=1, "
+        "convergence=RELATIVE_INI_CORE")
+    slv = make_solver("AMG", cfg, "default").setup(A)
+    res = slv.solve(np.ones(A.num_rows))
+    assert res.converged
+
+
+def test_energymin_pmis_selector(A):
+    """energymin_selector accepts any classical selector (the reference
+    allocates from the classical SelectorFactory)."""
+    cfg = Config.from_string(
+        "solver=AMG, algorithm=ENERGYMIN, energymin_selector=PMIS, "
+        "max_iters=60, tolerance=1e-8, monitor_residual=1, "
+        "convergence=RELATIVE_INI_CORE")
+    slv = make_solver("AMG", cfg, "default").setup(A)
+    res = slv.solve(np.ones(A.num_rows))
+    assert res.converged
+
+
+def test_energymin_as_preconditioner(A):
+    slv = amgx.create_solver(Config.from_string(
+        "solver=PCG, preconditioner=AMG, algorithm=ENERGYMIN, "
+        "energymin_selector=PMIS, max_iters=100, tolerance=1e-8, "
+        "monitor_residual=1, convergence=RELATIVE_INI_CORE"))
+    slv.setup(A)
+    res = slv.solve(np.ones(A.num_rows))
+    assert res.converged

@@ -112,7 +112,6 @@ class TestRS:
         n = 6
         rows = np.array([e[0] for e in edges])
         cols = np.array([e[1] for e in edges])
-        A = CsrMatrix.from_coo(rows, cols, -np.ones(len(edges)), n, n)
         A = CsrMatrix.from_coo(
             np.concatenate([rows, np.arange(n)]),
             np.concatenate([cols, np.arange(n)]),
