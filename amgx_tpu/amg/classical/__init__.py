@@ -22,7 +22,16 @@ from . import interpolators as _interpolators  # noqa: F401
 
 @registry.amg_levels.register("CLASSICAL")
 class ClassicalAMGLevel(AMGLevel):
+    """Shared strength -> CF-split -> P -> R=P^T -> RAP level flow.
+    Subclasses (energymin) retarget the selector/interpolator registries
+    via the class attributes below."""
+
     algorithm = "CLASSICAL"
+    selector_param = "selector"
+    selector_fallback = "PMIS"
+    interpolator_registry = registry.interpolators
+    interpolator_param = "interpolator"
+    interpolator_fallback = "D1"
 
     def create_coarse_vertices(self):
         """Strength + CF-split (markCoarseFinePoints analog,
@@ -30,14 +39,14 @@ class ClassicalAMGLevel(AMGLevel):
         if self.A.is_block:
             from ...errors import BadParametersError
             raise BadParametersError(
-                "CLASSICAL AMG supports scalar matrices only (the reference "
-                "has the same restriction); use algorithm=AGGREGATION for "
-                "block matrices")
+                f"{self.algorithm} AMG supports scalar matrices only (the "
+                "reference has the same restriction); use "
+                "algorithm=AGGREGATION for block matrices")
         cfg, scope = self.cfg, self.scope
         st = registry.strength.create(str(cfg.get("strength", scope)),
                                       cfg, scope)
         self.strong = st.strong_mask(self.A)
-        sel_name = str(cfg.get("selector", scope))
+        sel_name = str(cfg.get(self.selector_param, scope))
         # aggressive coarsening on the first `aggressive_levels` levels
         aggressive = self.level_index < int(cfg.get("aggressive_levels",
                                                     scope))
@@ -48,7 +57,7 @@ class ClassicalAMGLevel(AMGLevel):
                     sel_name.startswith("AGGRESSIVE") else sel_name
             sel_name = agg_sel
         if not registry.classical_selectors.has(sel_name):
-            sel_name = "PMIS"
+            sel_name = self.selector_fallback
         sel = registry.classical_selectors.create(sel_name, cfg, scope)
         self.cf_map = sel.mark_coarse_fine_points(self.A, self.strong)
         self.coarse_size = int(jnp.sum(self.cf_map == 1))
@@ -59,12 +68,12 @@ class ClassicalAMGLevel(AMGLevel):
         (computeProlongationOperator :406, computeRestrictionOperator
         :441, csr_galerkin_product)."""
         cfg, scope = self.cfg, self.scope
-        interp_name = str(cfg.get("interpolator", scope))
+        interp_name = str(cfg.get(self.interpolator_param, scope))
         if self._aggressive:
             interp_name = str(cfg.get("aggressive_interpolator", scope))
-        if not registry.interpolators.has(interp_name):
-            interp_name = "D1"
-        interp = registry.interpolators.create(interp_name, cfg, scope)
+        if not self.interpolator_registry.has(interp_name):
+            interp_name = self.interpolator_fallback
+        interp = self.interpolator_registry.create(interp_name, cfg, scope)
         self.P = interp.generate(self.A, self.cf_map, self.strong).init(
             ell="never")
         self.R = transpose(self.P).init(ell="never")

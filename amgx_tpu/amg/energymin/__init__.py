@@ -23,16 +23,12 @@ role of the reference's Ma row-sum system, em.cu count_Ma_* kernels).
 """
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ... import registry
 from ...matrix import CsrMatrix
-from ...ops.spgemm import galerkin_rap
-from ...ops.spmv import spmv
-from ...ops.transpose import transpose
-from ..hierarchy import AMGLevel
+from ..classical import ClassicalAMGLevel
 
 
 class EnergyminInterpolator:
@@ -50,39 +46,51 @@ class EMInterpolator(EnergyminInterpolator):
 
     def generate(self, A: CsrMatrix, cf_map, strong) -> CsrMatrix:
         n = A.num_rows
-        rows, cols, vals = [np.asarray(x) for x in A.coo()]
-        valsj = A.coo()[2]
+        rows_j, cols_j, vals_j = A.coo()
+        rows = np.asarray(rows_j)
+        cols = np.asarray(cols_j)
+        vals = np.asarray(vals_j)
         cf = np.asarray(cf_map)
         is_C = cf == 1
         cidx = np.cumsum(is_C) - 1                # coarse ids
         c_rows = np.where(is_C)[0]                # fine index per column
         nc = len(c_rows)
-        dt = np.asarray(A.values).dtype
+        dt = vals.dtype
+        ro = np.asarray(A.row_offsets)
 
         # column supports: fine neighbors of each coarse point (its A
-        # row, restricted to F points) — greedy distance-1 sparsity,
-        # matching init_ProwInd_greedy_aggregation's neighborhood choice
-        ro = np.asarray(A.row_offsets)
-        supports = []
-        kmax = 1
-        for fc in c_rows:
-            nb = cols[ro[fc]: ro[fc + 1]]
-            fnb = nb[(~is_C[nb]) & (nb != fc)]
-            supports.append(fnb)
-            kmax = max(kmax, len(fnb))
-
-        # padded patch index array (nc, kmax); pad slot points at the
-        # coarse point itself (masked out of the solve)
+        # row, restricted to F points) — distance-1 sparsity, matching
+        # init_ProwInd_greedy_aggregation's neighborhood choice. Built
+        # vectorized: mask the COO once, group by row.
+        keep = is_C[rows] & ~is_C[cols] & (rows != cols)
+        s_rows = rows[keep]                       # coarse fine-indices
+        s_cols = cols[keep]                       # their fine neighbors
+        cnt = np.zeros(n, np.int64)
+        np.add.at(cnt, s_rows, 1)
+        kmax = max(int(cnt.max()) if len(s_rows) else 0, 1)
+        col_of = cidx[s_rows]                     # column id per entry
+        # position of each entry within its column (entries are in row-
+        # major COO order, so cumcount per s_rows run works)
+        order = np.argsort(col_of, kind="stable")
+        col_sorted = col_of[order]
+        first = np.zeros(len(order), np.int64)
+        if len(order):
+            new_grp = np.ones(len(order), bool)
+            new_grp[1:] = col_sorted[1:] != col_sorted[:-1]
+            grp_start = np.where(new_grp)[0]
+            gid = np.cumsum(new_grp) - 1
+            first = np.arange(len(order)) - grp_start[gid]
         F = np.full((nc, kmax), -1, np.int64)
-        for j, fnb in enumerate(supports):
-            F[j, : len(fnb)] = fnb
+        if len(order):
+            F[col_sorted, first] = s_cols[order]
         mask = F >= 0
-        Fsafe = np.where(mask, F, c_rows[:, None])
+        Fsafe = np.where(mask, F, c_rows[:, None] if nc else 0)
 
         # A-entry lookup by (row, col) key over the sorted COO keys
         keys = rows.astype(np.int64) * n + cols
-        order = np.argsort(keys)
-        skeys = keys[order]
+        korder = np.argsort(keys)
+        skeys = keys[korder]
+        svals = vals[korder]
 
         def lookup(r_idx, c_idx):
             """A[r, c] (0 when absent) for broadcastable index arrays."""
@@ -90,8 +98,7 @@ class EMInterpolator(EnergyminInterpolator):
             pos = np.searchsorted(skeys, k)
             pos = np.clip(pos, 0, len(skeys) - 1)
             hit = skeys[pos] == k
-            v = np.asarray(valsj)[order][pos]
-            return np.where(hit, v, 0.0)
+            return np.where(hit, svals[pos], 0.0)
 
         # batched patches: A_FF (nc, k, k) and rhs a_Fc (nc, k)
         A_FF = lookup(Fsafe[:, :, None], Fsafe[:, None, :])
@@ -107,6 +114,11 @@ class EMInterpolator(EnergyminInterpolator):
         pF = -jnp.linalg.solve(jnp.asarray(A_FF),
                                jnp.asarray(rhs)[..., None])[..., 0]
         pF = np.asarray(pF)
+        # singular patches (zero diagonals, saddle blocks) come out
+        # non-finite from the LU: drop those columns' fine entries so
+        # the coarse point degrades to injection instead of poisoning
+        # P and the Galerkin product with NaNs
+        pF = np.where(np.isfinite(pF), pF, 0.0)
 
         # assemble P: injection for C rows + patch values for F rows
         pr = np.concatenate([c_rows, F[mask]])
@@ -123,49 +135,14 @@ class EMInterpolator(EnergyminInterpolator):
 
 
 @registry.amg_levels.register("ENERGYMIN")
-class EnergyminAMGLevel(AMGLevel):
-    """Energymin_AMG_Level analog: classical-style CF splitting (the
-    `energymin_selector` parameter, CR by default) + EM interpolation +
-    Galerkin RAP."""
+class EnergyminAMGLevel(ClassicalAMGLevel):
+    """Energymin_AMG_Level analog: the classical level flow (strength ->
+    CF split -> P -> R=P^T -> RAP) with the energymin selector /
+    interpolator registries (energymin_amg_level.cu:62-90)."""
 
     algorithm = "ENERGYMIN"
-
-    def create_coarse_vertices(self):
-        from ...errors import BadParametersError
-        if self.A.is_block:
-            raise BadParametersError(
-                "ENERGYMIN AMG supports scalar matrices only")
-        cfg, scope = self.cfg, self.scope
-        st = registry.strength.create(str(cfg.get("strength", scope)),
-                                      cfg, scope)
-        self.strong = st.strong_mask(self.A)
-        sel_name = str(cfg.get("energymin_selector", scope))
-        if not registry.classical_selectors.has(sel_name):
-            sel_name = "CR"
-        sel = registry.classical_selectors.create(sel_name, cfg, scope)
-        self.cf_map = sel.mark_coarse_fine_points(self.A, self.strong)
-        self.coarse_size = int(jnp.sum(self.cf_map == 1))
-
-    def create_coarse_matrix(self) -> CsrMatrix:
-        cfg, scope = self.cfg, self.scope
-        interp_name = str(cfg.get("energymin_interpolator", scope))
-        if not registry.energymin_interpolators.has(interp_name):
-            interp_name = "EM"
-        interp = registry.energymin_interpolators.create(interp_name, cfg,
-                                                         scope)
-        self.P = interp.generate(self.A, self.cf_map, self.strong).init(
-            ell="never")
-        self.R = transpose(self.P).init(ell="never")
-        return galerkin_rap(self.R, self.A, self.P)
-
-    def level_data(self):
-        d = super().level_data()
-        d["P"] = self.P
-        d["R"] = self.R
-        return d
-
-    def restrict(self, data, r):
-        return spmv(data["R"], r)
-
-    def prolongate(self, data, xc):
-        return spmv(data["P"], xc)
+    selector_param = "energymin_selector"
+    selector_fallback = "CR"
+    interpolator_registry = registry.energymin_interpolators
+    interpolator_param = "energymin_interpolator"
+    interpolator_fallback = "EM"

@@ -136,7 +136,8 @@ class AMG:
         self.num_levels = len(self.levels) + 1
         self.setup_time = time.perf_counter() - t0
         if self.print_grid_stats:
-            print(self.grid_stats())
+            from ..output import amgx_printf
+            amgx_printf(self.grid_stats())
         return self
 
     # -- solve-phase data -------------------------------------------------

@@ -1,0 +1,633 @@
+"""C-API-compatible surface (amgx_c shim).
+
+TPU-native analog of the reference's public C API (include/amgx_c.h,
+src/amgx_c.cu 5358 LoC; eigensolver API include/amgx_eig_c.h,
+src/amgx_eig_c.cu). Every function keeps its AMGX_* name, its call
+order, its handle-based object model, and its RC return-code contract
+(exception -> RC translation, src/amgx_c_common.cu AMGX_CHECK_API_ERROR),
+so a user porting from `amgx_capi.c` maps each call 1:1.
+
+One deliberate Python adaptation: C output-pointer parameters become
+return values AFTER the RC, i.e.
+
+    AMGX_RC AMGX_config_create(AMGX_config_handle *cfg, const char *opt)
+       ->   rc, cfg = AMGX_config_create(options)
+
+Handles are opaque integers into a process-global registry, mirroring
+the reference's CWrap shared_ptr handle registry. All math runs through
+the same framework objects the Python API uses — this layer is pure
+surface.
+"""
+from __future__ import annotations
+
+import itertools
+import sys
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from . import initialize as _initialize_framework
+from .config import Config
+from .errors import AMGXError, RC, get_error_string
+from .matrix import CsrMatrix
+from .modes import parse_mode
+
+# ---------------------------------------------------------------------------
+# handle registry (CWrap analog, src/amgx_c_common.cu)
+# ---------------------------------------------------------------------------
+
+_handles: Dict[int, Any] = {}
+_next_id = itertools.count(1)
+
+def _new_handle(obj) -> int:
+    h = next(_next_id)
+    _handles[h] = obj
+    return h
+
+
+def _get(h, cls=None):
+    obj = _handles.get(h)
+    if obj is None or (cls is not None and not isinstance(obj, cls)):
+        raise AMGXError("invalid handle", RC.BAD_PARAMETERS)
+    return obj
+
+
+def _api(fn):
+    """Exception -> RC translation (AMGX_CHECK_API_ERROR analog)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        try:
+            out = fn(*args, **kwargs)
+        except AMGXError as e:
+            return e.rc if _single_rc(fn) else (e.rc,) + _none_tail(fn)
+        except FileNotFoundError:
+            return RC.IO_ERROR if _single_rc(fn) \
+                else (RC.IO_ERROR,) + _none_tail(fn)
+        except Exception:
+            return RC.UNKNOWN if _single_rc(fn) \
+                else (RC.UNKNOWN,) + _none_tail(fn)
+        return out
+
+    return wrapper
+
+
+def _single_rc(fn):
+    return getattr(fn, "_n_outputs", 0) == 0
+
+
+def _none_tail(fn):
+    return (None,) * getattr(fn, "_n_outputs", 0)
+
+
+def _outputs(n):
+    def deco(fn):
+        fn._n_outputs = n
+        return fn
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# library-level objects
+# ---------------------------------------------------------------------------
+
+
+class _CMatrix:
+    def __init__(self, resources, mode):
+        self.resources = resources
+        self.mode = mode
+        self.A: Optional[CsrMatrix] = None
+
+
+class _CVector:
+    def __init__(self, resources, mode):
+        self.resources = resources
+        self.mode = mode
+        self.v: Optional[np.ndarray] = None
+        self.block_dim = 1
+
+
+class _CSolver:
+    def __init__(self, resources, mode, cfg: Config):
+        self.resources = resources
+        self.mode = mode
+        self.cfg = cfg
+        self.solver = None
+        self.result = None
+
+    def build(self):
+        from .solvers.base import make_solver
+        name, scope = self.cfg.get_solver("solver", "default")
+        self.solver = make_solver(name, self.cfg, scope)
+
+
+class _CEigenSolver:
+    def __init__(self, resources, mode, cfg: Config):
+        self.resources = resources
+        self.mode = mode
+        self.cfg = cfg
+        from .eigen import create_eigensolver
+        self.solver = create_eigensolver(cfg)
+        self.result = None
+
+
+class _CResources:
+    def __init__(self, cfg: Optional[Config]):
+        self.cfg = cfg
+
+
+# ---------------------------------------------------------------------------
+# init / version / error API
+# ---------------------------------------------------------------------------
+
+
+@_api
+def AMGX_initialize():
+    """src/amgx_c.cu:2360."""
+    _initialize_framework()
+    return RC.OK
+
+
+@_api
+def AMGX_initialize_plugins():
+    return RC.OK           # plugin system removed upstream (CHANGELOG:14)
+
+
+@_api
+def AMGX_finalize():
+    _handles.clear()
+    return RC.OK
+
+
+@_api
+def AMGX_finalize_plugins():
+    return RC.OK
+
+
+def AMGX_get_api_version():
+    """rc, major, minor."""
+    from . import API_VERSION
+    return RC.OK, API_VERSION[0], API_VERSION[1]
+
+
+def AMGX_get_error_string(rc):
+    return get_error_string(rc)
+
+
+@_api
+def AMGX_register_print_callback(callback):
+    from .output import register_print_callback
+    register_print_callback(callback)
+    return RC.OK
+
+
+@_api
+def AMGX_install_signal_handler():
+    import faulthandler
+    faulthandler.enable()
+    return RC.OK
+
+
+@_api
+def AMGX_reset_signal_handler():
+    import faulthandler
+    faulthandler.disable()
+    return RC.OK
+
+
+def AMGX_pin_memory(*_args):     # no-op: XLA owns transfers
+    return RC.OK
+
+
+def AMGX_unpin_memory(*_args):
+    return RC.OK
+
+
+# ---------------------------------------------------------------------------
+# config API
+# ---------------------------------------------------------------------------
+
+
+@_api
+@_outputs(1)
+def AMGX_config_create(options: str):
+    return RC.OK, _new_handle(Config.from_string(options or ""))
+
+
+@_api
+@_outputs(1)
+def AMGX_config_create_from_file(path: str):
+    return RC.OK, _new_handle(Config.from_file(path))
+
+
+@_api
+@_outputs(1)
+def AMGX_config_create_from_file_and_string(path: str, options: str):
+    cfg = Config.from_file(path)
+    cfg.parse_parameter_string(options or "")
+    return RC.OK, _new_handle(cfg)
+
+
+@_api
+def AMGX_config_add_parameters(cfg_h, options: str):
+    _get(cfg_h, Config).parse_parameter_string(options)
+    return RC.OK
+
+
+@_api
+def AMGX_config_destroy(cfg_h):
+    _handles.pop(cfg_h, None)
+    return RC.OK
+
+
+# ---------------------------------------------------------------------------
+# resources API
+# ---------------------------------------------------------------------------
+
+
+@_api
+@_outputs(1)
+def AMGX_resources_create_simple(cfg_h=None):
+    cfg = _get(cfg_h, Config) if cfg_h is not None else None
+    return RC.OK, _new_handle(_CResources(cfg))
+
+
+@_api
+@_outputs(1)
+def AMGX_resources_create(cfg_h, _comm=None, _device_num=0, _devices=None):
+    cfg = _get(cfg_h, Config) if cfg_h is not None else None
+    return RC.OK, _new_handle(_CResources(cfg))
+
+
+@_api
+def AMGX_resources_destroy(rsrc_h):
+    _handles.pop(rsrc_h, None)
+    return RC.OK
+
+
+# ---------------------------------------------------------------------------
+# matrix API
+# ---------------------------------------------------------------------------
+
+
+@_api
+@_outputs(1)
+def AMGX_matrix_create(rsrc_h, mode: str):
+    rs = _get(rsrc_h, _CResources)
+    return RC.OK, _new_handle(_CMatrix(rs, parse_mode(mode)))
+
+
+@_api
+def AMGX_matrix_destroy(mtx_h):
+    _handles.pop(mtx_h, None)
+    return RC.OK
+
+
+@_api
+def AMGX_matrix_upload_all(mtx_h, n, nnz, block_dimx, block_dimy,
+                           row_ptrs, col_indices, data, diag_data=None):
+    """AMGX_matrix_upload_all (src/amgx_c.cu:3039)."""
+    m = _get(mtx_h, _CMatrix)
+    dt = m.mode.mat_dtype
+    ro = np.asarray(row_ptrs, dtype=np.int32)
+    ci = np.asarray(col_indices, dtype=np.int32)
+    vals = np.asarray(data, dtype=dt)
+    if block_dimx * block_dimy > 1:
+        vals = vals.reshape(nnz, block_dimx, block_dimy)
+    diag = None
+    if diag_data is not None:
+        diag = np.asarray(diag_data, dtype=dt)
+        if block_dimx * block_dimy > 1:
+            diag = diag.reshape(n, block_dimx, block_dimy)
+    m.A = CsrMatrix.from_scipy_like(
+        ro, ci, vals, n, n, block_dims=(block_dimx, block_dimy),
+        diag=diag).init()
+    return RC.OK
+
+
+@_api
+def AMGX_matrix_replace_coefficients(mtx_h, n, nnz, data, diag_data=None):
+    """Keep structure, replace values (src/amgx_c.cu; pairs with
+    AMGX_solver_resetup)."""
+    m = _get(mtx_h, _CMatrix)
+    if m.A is None:
+        raise AMGXError("matrix not uploaded", RC.BAD_PARAMETERS)
+    dt = m.mode.mat_dtype
+    vals = np.asarray(data, dtype=dt)
+    if m.A.is_block:
+        vals = vals.reshape(nnz, m.A.block_dimx, m.A.block_dimy)
+    diag = None
+    if diag_data is not None:
+        diag = np.asarray(diag_data, dtype=dt)
+        if m.A.is_block:
+            diag = diag.reshape(n, m.A.block_dimx, m.A.block_dimy)
+    m.A = m.A.with_values(vals, diag=diag
+                          if diag is not None else m.A.diag)
+    if not m.A.initialized:
+        m.A = m.A.init()
+    return RC.OK
+
+
+def AMGX_matrix_get_size(mtx_h):
+    """rc, n, block_dimx, block_dimy."""
+    try:
+        m = _get(mtx_h, _CMatrix)
+        if m.A is None:
+            return RC.BAD_PARAMETERS, None, None, None
+        return RC.OK, m.A.num_rows, m.A.block_dimx, m.A.block_dimy
+    except AMGXError as e:
+        return e.rc, None, None, None
+
+
+@_api
+@_outputs(1)
+def AMGX_matrix_get_nnz(mtx_h):
+    m = _get(mtx_h, _CMatrix)
+    return RC.OK, (m.A.nnz if m.A is not None else 0)
+
+
+# ---------------------------------------------------------------------------
+# vector API
+# ---------------------------------------------------------------------------
+
+
+@_api
+@_outputs(1)
+def AMGX_vector_create(rsrc_h, mode: str):
+    rs = _get(rsrc_h, _CResources)
+    return RC.OK, _new_handle(_CVector(rs, parse_mode(mode)))
+
+
+@_api
+def AMGX_vector_destroy(vec_h):
+    _handles.pop(vec_h, None)
+    return RC.OK
+
+
+@_api
+def AMGX_vector_upload(vec_h, n, block_dim, data):
+    v = _get(vec_h, _CVector)
+    v.v = np.asarray(data, dtype=v.mode.vec_dtype).reshape(n * block_dim)
+    v.block_dim = block_dim
+    return RC.OK
+
+
+@_api
+def AMGX_vector_set_zero(vec_h, n, block_dim):
+    v = _get(vec_h, _CVector)
+    v.v = np.zeros(n * block_dim, dtype=v.mode.vec_dtype)
+    v.block_dim = block_dim
+    return RC.OK
+
+
+@_api
+@_outputs(1)
+def AMGX_vector_download(vec_h):
+    v = _get(vec_h, _CVector)
+    if v.v is None:
+        raise AMGXError("vector not uploaded", RC.BAD_PARAMETERS)
+    return RC.OK, np.asarray(v.v).copy()
+
+
+def AMGX_vector_get_size(vec_h):
+    """rc, n, block_dim."""
+    try:
+        v = _get(vec_h, _CVector)
+        if v.v is None:
+            return RC.OK, 0, v.block_dim
+        return RC.OK, len(v.v) // v.block_dim, v.block_dim
+    except AMGXError as e:
+        return e.rc, None, None
+
+
+# ---------------------------------------------------------------------------
+# solver API
+# ---------------------------------------------------------------------------
+
+
+@_api
+@_outputs(1)
+def AMGX_solver_create(rsrc_h, mode: str, cfg_h):
+    rs = _get(rsrc_h, _CResources)
+    cfg = _get(cfg_h, Config)
+    cs = _CSolver(rs, parse_mode(mode), cfg)
+    cs.build()
+    return RC.OK, _new_handle(cs)
+
+
+@_api
+def AMGX_solver_destroy(slv_h):
+    _handles.pop(slv_h, None)
+    return RC.OK
+
+
+@_api
+def AMGX_solver_setup(slv_h, mtx_h):
+    """src/amgx_c.cu:2745."""
+    s = _get(slv_h, _CSolver)
+    m = _get(mtx_h, _CMatrix)
+    if m.A is None:
+        raise AMGXError("matrix not uploaded", RC.BAD_PARAMETERS)
+    s.solver.setup(m.A)
+    return RC.OK
+
+
+@_api
+def AMGX_solver_resetup(slv_h, mtx_h):
+    s = _get(slv_h, _CSolver)
+    m = _get(mtx_h, _CMatrix)
+    if m.A is None:
+        raise AMGXError("matrix not uploaded", RC.BAD_PARAMETERS)
+    s.solver.resetup(m.A)
+    return RC.OK
+
+
+def _do_solve(s, b_h, x_h, zero_guess):
+    b = _get(b_h, _CVector)
+    x = _get(x_h, _CVector)
+    if s.solver is None or s.solver.A is None:
+        raise AMGXError("solver not set up", RC.BAD_PARAMETERS)
+    if b.v is None:
+        raise AMGXError("rhs not uploaded", RC.BAD_PARAMETERS)
+    x0 = x.v if (x.v is not None and not zero_guess) else None
+    s.result = s.solver.solve(b.v, x0=x0,
+                              zero_initial_guess=zero_guess)
+    x.v = np.asarray(s.result.x)
+    x.block_dim = b.block_dim
+    return RC.OK
+
+
+@_api
+def AMGX_solver_solve(slv_h, b_h, x_h):
+    """src/amgx_c.cu:2813 (x holds the initial guess)."""
+    return _do_solve(_get(slv_h, _CSolver), b_h, x_h, zero_guess=False)
+
+
+@_api
+def AMGX_solver_solve_with_0_initial_guess(slv_h, b_h, x_h):
+    return _do_solve(_get(slv_h, _CSolver), b_h, x_h, zero_guess=True)
+
+
+@_api
+@_outputs(1)
+def AMGX_solver_get_status(slv_h):
+    """rc, status: 0 success, 1 failed, 2 diverged (AMGX_SOLVE_*)."""
+    s = _get(slv_h, _CSolver)
+    if s.result is None:
+        raise AMGXError("no solve performed", RC.BAD_PARAMETERS)
+    return RC.OK, (0 if s.result.converged else 1)
+
+
+@_api
+@_outputs(1)
+def AMGX_solver_get_iterations_number(slv_h):
+    s = _get(slv_h, _CSolver)
+    if s.result is None:
+        raise AMGXError("no solve performed", RC.BAD_PARAMETERS)
+    return RC.OK, s.result.iterations
+
+
+@_api
+@_outputs(1)
+def AMGX_solver_get_iteration_residual(slv_h, it: int, idx: int = 0):
+    s = _get(slv_h, _CSolver)
+    if s.result is None or s.result.res_history is None:
+        raise AMGXError("no residual history (set store_res_history=1)",
+                        RC.BAD_PARAMETERS)
+    hist = np.asarray(s.result.res_history)   # (iters+1,) or (iters+1, b)
+    if not (0 <= it < hist.shape[0]):
+        raise AMGXError("iteration out of range", RC.BAD_PARAMETERS)
+    row = np.atleast_1d(hist[it])
+    return RC.OK, float(row[min(idx, len(row) - 1)])
+
+
+# ---------------------------------------------------------------------------
+# system IO API
+# ---------------------------------------------------------------------------
+
+
+@_api
+def AMGX_read_system(mtx_h, rhs_h, sol_h, path: str):
+    """src/amgx_c.cu read_system: fills matrix + rhs + solution (missing
+    pieces default to b=ones/x=zeros as in the reference reader)."""
+    from .io import read_system as _read
+    m = _get(mtx_h, _CMatrix) if mtx_h is not None else None
+    A, b, x = _read(path, dtype=m.mode.mat_dtype if m else None)
+    if m is not None:
+        m.A = A if A.initialized else A.init()
+    n = A.num_rows * A.block_dimy
+    if rhs_h is not None:
+        rv = _get(rhs_h, _CVector)
+        rv.v = np.asarray(b) if b is not None else np.ones(
+            n, dtype=m.mode.vec_dtype if m else np.float64)
+        rv.block_dim = A.block_dimy
+    if sol_h is not None:
+        sv = _get(sol_h, _CVector)
+        sv.v = np.asarray(x) if x is not None else np.zeros(
+            n, dtype=m.mode.vec_dtype if m else np.float64)
+        sv.block_dim = A.block_dimx
+    return RC.OK
+
+
+@_api
+def AMGX_write_system(mtx_h, rhs_h, sol_h, path: str):
+    from .io import write_system as _write
+    m = _get(mtx_h, _CMatrix)
+    if m.A is None:
+        raise AMGXError("matrix not uploaded", RC.BAD_PARAMETERS)
+    b = _get(rhs_h, _CVector).v if rhs_h is not None else None
+    x = _get(sol_h, _CVector).v if sol_h is not None else None
+    _write(path, m.A, b, x)
+    return RC.OK
+
+
+@_api
+def AMGX_write_parameters_description(path: str):
+    """Dump every registered parameter (include/amgx_c.h analog)."""
+    from .config import describe_parameters
+    with open(path, "w") as f:
+        f.write(describe_parameters())
+    return RC.OK
+
+
+# ---------------------------------------------------------------------------
+# generators (AMGX_generate_distributed_poisson_7pt, src/amgx_c.cu:4731)
+# ---------------------------------------------------------------------------
+
+
+@_api
+def AMGX_generate_distributed_poisson_7pt(mtx_h, rhs_h, sol_h,
+                                          allocated_halo_depth, num_import_rings,
+                                          nx, ny, nz, px=1, py=1, pz=1):
+    """Single-controller analog: generates the GLOBAL 7-pt Poisson (the
+    mesh partitioning happens at solve time via the distributed layer,
+    not per-process as in MPI)."""
+    from .gallery import poisson
+    m = _get(mtx_h, _CMatrix)
+    A = poisson("7pt", nx * px, ny * py, nz * pz,
+                dtype=m.mode.mat_dtype)
+    m.A = A.init()
+    n = m.A.num_rows
+    if rhs_h is not None:
+        rv = _get(rhs_h, _CVector)
+        rv.v = np.ones(n, dtype=m.mode.vec_dtype)
+        rv.block_dim = 1
+    if sol_h is not None:
+        sv = _get(sol_h, _CVector)
+        sv.v = np.zeros(n, dtype=m.mode.vec_dtype)
+        sv.block_dim = 1
+    return RC.OK
+
+
+# ---------------------------------------------------------------------------
+# eigensolver API (include/amgx_eig_c.h:18-26, src/amgx_eig_c.cu)
+# ---------------------------------------------------------------------------
+
+
+@_api
+@_outputs(1)
+def AMGX_eigensolver_create(rsrc_h, mode: str, cfg_h):
+    rs = _get(rsrc_h, _CResources)
+    cfg = _get(cfg_h, Config)
+    return RC.OK, _new_handle(_CEigenSolver(rs, parse_mode(mode), cfg))
+
+
+@_api
+def AMGX_eigensolver_destroy(es_h):
+    _handles.pop(es_h, None)
+    return RC.OK
+
+
+@_api
+def AMGX_eigensolver_setup(es_h, mtx_h):
+    es = _get(es_h, _CEigenSolver)
+    m = _get(mtx_h, _CMatrix)
+    if m.A is None:
+        raise AMGXError("matrix not uploaded", RC.BAD_PARAMETERS)
+    es.solver.setup(m.A)
+    return RC.OK
+
+
+@_api
+def AMGX_eigensolver_pagerank_setup(es_h, a_vec_h):
+    return RC.OK          # dangling/teleport vectors built internally
+
+
+@_api
+def AMGX_eigensolver_solve(es_h, x_h):
+    es = _get(es_h, _CEigenSolver)
+    x = _get(x_h, _CVector)
+    es.result = es.solver.solve(x.v if x.v is not None else None)
+    if es.result.eigenvectors is not None:
+        x.v = np.asarray(es.result.eigenvectors[:, 0])
+    return RC.OK
+
+
+@_api
+@_outputs(1)
+def AMGX_eigensolver_get_eigenvalues(es_h):
+    es = _get(es_h, _CEigenSolver)
+    if es.result is None:
+        raise AMGXError("no solve performed", RC.BAD_PARAMETERS)
+    return RC.OK, np.asarray(es.result.eigenvalues).copy()

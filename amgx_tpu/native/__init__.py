@@ -59,20 +59,24 @@ def _build(target: str) -> bool:
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, target)          # atomic publish
-        for f in os.listdir(_BUILD):     # prune superseded builds
-            p = os.path.join(_BUILD, f)
-            if f.endswith(".so") and p != target:
-                try:
-                    os.unlink(p)
-                except OSError:
-                    pass
-        return True
     except (subprocess.SubprocessError, FileNotFoundError, OSError):
         try:
             os.unlink(tmp)
         except OSError:
             pass
         return False
+    # prune superseded builds; best-effort, must not fail the build
+    try:
+        for f in os.listdir(_BUILD):
+            p = os.path.join(_BUILD, f)
+            if f.endswith(".so") and p != target:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+    except OSError:
+        pass
+    return True
 
 
 def lib():

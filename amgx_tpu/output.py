@@ -1,0 +1,34 @@
+"""Print-callback indirection.
+
+Analog of the reference's registered print callback +
+`amgx_distributed_output` (src/amgx_c.cu AMGX_register_print_callback;
+only rank 0 prints). All framework output (solve stats, grid stats,
+warnings meant for the library user) goes through `amgx_output` so a
+host application can capture it; the single-controller JAX model plays
+the role of rank 0.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Callable, Optional
+
+_callback: Optional[Callable[[str, int], None]] = None
+
+
+def register_print_callback(cb: Optional[Callable[[str, int], None]]):
+    global _callback
+    _callback = cb
+
+
+def amgx_output(msg: str):
+    if _callback is not None:
+        _callback(msg, len(msg))
+    else:
+        sys.stdout.write(msg)
+
+
+def amgx_printf(*args, **kwargs):
+    """print()-style convenience routed through the callback."""
+    end = kwargs.pop("end", "\n")
+    sep = kwargs.pop("sep", " ")
+    amgx_output(sep.join(str(a) for a in args) + end)

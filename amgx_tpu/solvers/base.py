@@ -34,6 +34,7 @@ from ..errors import BadParametersError
 from ..matrix import CsrMatrix
 from ..ops import blas
 from ..ops.spmv import residual as _residual
+from ..output import amgx_printf
 
 # ---------------------------------------------------------------------------
 # convergence criteria (src/convergence/, registry src/core.cu:680-685)
@@ -339,25 +340,25 @@ class Solver:
         return res
 
     def _print_stats(self, res: SolveResult, hist):
-        print(f"    iter      Mem Usage (GB)       residual           rate")
-        print(f"    {'-' * 62}")
+        amgx_printf(f"    iter      Mem Usage (GB)       residual           rate")
+        amgx_printf(f"    {'-' * 62}")
         for i in range(res.iterations + 1):
             rate = ""
             if i > 0 and np.all(hist[i - 1] > 0):
                 rate = f"{float(np.max(hist[i] / hist[i - 1])):14.4f}"
             tag = "Ini" if i == 0 else f"{i - 1:4d}"
-            print(f"    {tag}         {0.0:10.4f}      "
+            amgx_printf(f"    {tag}         {0.0:10.4f}      "
                   f"{float(np.max(hist[i])):14.6e} {rate}")
-        print(f"    {'-' * 62}")
+        amgx_printf(f"    {'-' * 62}")
         status = "success" if res.converged else "failed"
-        print(f"    Total Iterations: {res.iterations}")
-        print(f"    Avg Convergence Rate: "
+        amgx_printf(f"    Total Iterations: {res.iterations}")
+        amgx_printf(f"    Avg Convergence Rate: "
               f"{float((np.max(hist[res.iterations]) / max(np.max(hist[0]), 1e-300)) ** (1.0 / max(res.iterations, 1))):10.4f}")
-        print(f"    Final Residual: {float(np.max(res.res_norm)):.6e}")
-        print(f"    Solve Status: {status}")
+        amgx_printf(f"    Final Residual: {float(np.max(res.res_norm)):.6e}")
+        amgx_printf(f"    Solve Status: {status}")
         if self.obtain_timings:
-            print(f"    Setup Time: {res.setup_time:.4f}s")
-            print(f"    Solve Time: {res.solve_time:.4f}s")
+            amgx_printf(f"    Setup Time: {res.setup_time:.4f}s")
+            amgx_printf(f"    Solve Time: {res.solve_time:.4f}s")
 
     # -- smoother interface (AMG levels) ---------------------------------
     def smooth(self, data, b, x, sweeps: int):

@@ -1,0 +1,227 @@
+"""C-API shim tests (capi_upload_tests.cu, capi_graceful_failure.cu,
+amgx_capi.c flow analogs)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from amgx_tpu import capi, gallery
+from amgx_tpu.errors import RC
+from amgx_tpu.io import write_system
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    assert capi.AMGX_initialize() == RC.OK
+    yield
+    capi.AMGX_finalize()
+
+
+def _poisson_csr(nx=8, ny=8):
+    A = gallery.poisson("5pt", nx, ny)
+    return (A.num_rows, A.nnz, np.asarray(A.row_offsets),
+            np.asarray(A.col_indices), np.asarray(A.values))
+
+
+def test_full_capi_flow():
+    """The amgx_capi.c call sequence end to end."""
+    rc, cfg = capi.AMGX_config_create(
+        "config_version=2, solver=PCG, preconditioner=BLOCK_JACOBI, "
+        "max_iters=200, tolerance=1e-8, monitor_residual=1, "
+        "convergence=RELATIVE_INI_CORE, store_res_history=1")
+    assert rc == RC.OK
+    rc, rsrc = capi.AMGX_resources_create_simple(cfg)
+    assert rc == RC.OK
+    rc, A = capi.AMGX_matrix_create(rsrc, "dDDI")
+    assert rc == RC.OK
+    rc, b = capi.AMGX_vector_create(rsrc, "dDDI")
+    rc, x = capi.AMGX_vector_create(rsrc, "dDDI")
+    rc, slv = capi.AMGX_solver_create(rsrc, "dDDI", cfg)
+    assert rc == RC.OK
+
+    n, nnz, ro, ci, vals = _poisson_csr()
+    assert capi.AMGX_matrix_upload_all(A, n, nnz, 1, 1, ro, ci, vals) \
+        == RC.OK
+    rc, nn, bx, by = capi.AMGX_matrix_get_size(A)
+    assert (rc, nn, bx, by) == (RC.OK, n, 1, 1)
+
+    assert capi.AMGX_vector_upload(b, n, 1, np.ones(n)) == RC.OK
+    assert capi.AMGX_vector_set_zero(x, n, 1) == RC.OK
+    assert capi.AMGX_solver_setup(slv, A) == RC.OK
+    assert capi.AMGX_solver_solve(slv, b, x) == RC.OK
+
+    rc, status = capi.AMGX_solver_get_status(slv)
+    assert (rc, status) == (RC.OK, 0)
+    rc, iters = capi.AMGX_solver_get_iterations_number(slv)
+    assert rc == RC.OK and 0 < iters <= 200
+    rc, res0 = capi.AMGX_solver_get_iteration_residual(slv, 0)
+    rc, resN = capi.AMGX_solver_get_iteration_residual(slv, iters)
+    assert resN < 1e-8 * res0 * 10
+
+    rc, sol = capi.AMGX_vector_download(x)
+    assert rc == RC.OK
+    import jax.numpy as jnp
+    from amgx_tpu.ops.spmv import spmv
+    Am = gallery.poisson("5pt", 8, 8).init()
+    r = np.asarray(spmv(Am, jnp.asarray(sol))) - 1.0
+    assert np.linalg.norm(r) < 1e-6
+
+    for h, d in ((slv, capi.AMGX_solver_destroy),
+                 (x, capi.AMGX_vector_destroy),
+                 (b, capi.AMGX_vector_destroy),
+                 (A, capi.AMGX_matrix_destroy),
+                 (rsrc, capi.AMGX_resources_destroy),
+                 (cfg, capi.AMGX_config_destroy)):
+        assert d(h) == RC.OK
+
+
+def test_replace_coefficients_and_resetup():
+    rc, cfg = capi.AMGX_config_create(
+        "solver=CG, max_iters=300, tolerance=1e-8, monitor_residual=1, "
+        "convergence=RELATIVE_INI_CORE")
+    rc, rsrc = capi.AMGX_resources_create_simple(cfg)
+    rc, A = capi.AMGX_matrix_create(rsrc, "dDDI")
+    rc, slv = capi.AMGX_solver_create(rsrc, "dDDI", cfg)
+    rc, b = capi.AMGX_vector_create(rsrc, "dDDI")
+    rc, x = capi.AMGX_vector_create(rsrc, "dDDI")
+    n, nnz, ro, ci, vals = _poisson_csr()
+    capi.AMGX_matrix_upload_all(A, n, nnz, 1, 1, ro, ci, vals)
+    capi.AMGX_vector_upload(b, n, 1, np.ones(n))
+    capi.AMGX_vector_set_zero(x, n, 1)
+    capi.AMGX_solver_setup(slv, A)
+    capi.AMGX_solver_solve(slv, b, x)
+    # scale the coefficients: solution halves
+    assert capi.AMGX_matrix_replace_coefficients(A, n, nnz, 2.0 * vals) \
+        == RC.OK
+    assert capi.AMGX_solver_resetup(slv, A) == RC.OK
+    capi.AMGX_vector_set_zero(x, n, 1)
+    capi.AMGX_solver_solve(slv, b, x)
+    rc, sol2 = capi.AMGX_vector_download(x)
+    Am = gallery.poisson("5pt", 8, 8).init()
+    import jax.numpy as jnp
+    from amgx_tpu.ops.spmv import spmv
+    r = np.asarray(spmv(Am, 2.0 * jnp.asarray(sol2))) - 1.0
+    assert np.linalg.norm(r) < 1e-6
+
+
+def test_graceful_failure():
+    """capi_graceful_failure.cu analog: bad calls return RCs, never
+    raise."""
+    assert capi.AMGX_solver_setup(99999, 99998) == RC.BAD_PARAMETERS
+    rc, _ = capi.AMGX_vector_download(12345)
+    assert rc == RC.BAD_PARAMETERS
+    rc, cfg = capi.AMGX_config_create_from_file("/nonexistent/cfg.json")
+    assert rc in (RC.IO_ERROR, RC.BAD_CONFIGURATION) and cfg is None
+    rc, rsrc = capi.AMGX_resources_create_simple(None)
+    assert rc == RC.OK
+    rc, A = capi.AMGX_matrix_create(rsrc, "zZZZ")   # invalid mode
+    assert rc != RC.OK and A is None
+    rc, A = capi.AMGX_matrix_create(rsrc, "dDDI")
+    rc, cfg = capi.AMGX_config_create("solver=CG, max_iters=10")
+    rc, slv = capi.AMGX_solver_create(rsrc, "dDDI", cfg)
+    # solve before setup
+    rc, b = capi.AMGX_vector_create(rsrc, "dDDI")
+    rc, x = capi.AMGX_vector_create(rsrc, "dDDI")
+    capi.AMGX_vector_upload(b, 4, 1, np.ones(4))
+    assert capi.AMGX_solver_solve(slv, b, x) == RC.BAD_PARAMETERS
+    # bad config string
+    rc2, _ = capi.AMGX_config_create("no_such_param=1")
+    assert rc2 != RC.OK
+
+
+def test_read_write_system_roundtrip(tmp_path):
+    rc, rsrc = capi.AMGX_resources_create_simple(None)
+    rc, A = capi.AMGX_matrix_create(rsrc, "dDDI")
+    rc, b = capi.AMGX_vector_create(rsrc, "dDDI")
+    rc, x = capi.AMGX_vector_create(rsrc, "dDDI")
+    Am = gallery.poisson("5pt", 6, 6)
+    path = str(tmp_path / "sys.mtx")
+    write_system(path, Am, b=np.arange(36, dtype=float))
+    assert capi.AMGX_read_system(A, b, x, path) == RC.OK
+    rc, n, bx, by = capi.AMGX_matrix_get_size(A)
+    assert n == 36
+    rc, bv = capi.AMGX_vector_download(b)
+    np.testing.assert_allclose(bv, np.arange(36, dtype=float))
+    # write back
+    out = str(tmp_path / "out.mtx")
+    assert capi.AMGX_write_system(A, b, None, out) == RC.OK
+    assert os.path.exists(out)
+
+
+def test_print_callback_captures_output():
+    lines = []
+    capi.AMGX_register_print_callback(lambda m, l: lines.append(m))
+    rc, cfg = capi.AMGX_config_create(
+        "solver=CG, max_iters=50, tolerance=1e-8, monitor_residual=1, "
+        "print_solve_stats=1, convergence=RELATIVE_INI_CORE")
+    rc, rsrc = capi.AMGX_resources_create_simple(cfg)
+    rc, A = capi.AMGX_matrix_create(rsrc, "dDDI")
+    rc, slv = capi.AMGX_solver_create(rsrc, "dDDI", cfg)
+    rc, b = capi.AMGX_vector_create(rsrc, "dDDI")
+    rc, x = capi.AMGX_vector_create(rsrc, "dDDI")
+    n, nnz, ro, ci, vals = _poisson_csr(6, 6)
+    capi.AMGX_matrix_upload_all(A, n, nnz, 1, 1, ro, ci, vals)
+    capi.AMGX_vector_upload(b, n, 1, np.ones(n))
+    capi.AMGX_vector_set_zero(x, n, 1)
+    capi.AMGX_solver_setup(slv, A)
+    capi.AMGX_solver_solve(slv, b, x)
+    capi.AMGX_register_print_callback(None)
+    text = "".join(lines)
+    assert "Total Iterations" in text and "Solve Status" in text
+
+
+def test_generate_poisson_7pt():
+    rc, rsrc = capi.AMGX_resources_create_simple(None)
+    rc, A = capi.AMGX_matrix_create(rsrc, "dDDI")
+    rc, b = capi.AMGX_vector_create(rsrc, "dDDI")
+    assert capi.AMGX_generate_distributed_poisson_7pt(
+        A, b, None, 1, 1, 8, 8, 8) == RC.OK
+    rc, n, _, _ = capi.AMGX_matrix_get_size(A)
+    assert n == 512
+
+
+def test_eigensolver_capi():
+    rc, cfg = capi.AMGX_config_create(
+        "eig_solver=POWER_ITERATION, eig_max_iters=2000, "
+        "eig_tolerance=1e-8, eig_eigenvector=1")
+    rc, rsrc = capi.AMGX_resources_create_simple(cfg)
+    rc, A = capi.AMGX_matrix_create(rsrc, "dDDI")
+    n, nnz, ro, ci, vals = _poisson_csr(10, 7)
+    capi.AMGX_matrix_upload_all(A, n, nnz, 1, 1, ro, ci, vals)
+    rc, es = capi.AMGX_eigensolver_create(rsrc, "dDDI", cfg)
+    assert rc == RC.OK
+    rc, x = capi.AMGX_vector_create(rsrc, "dDDI")
+    assert capi.AMGX_eigensolver_setup(es, A) == RC.OK
+    assert capi.AMGX_eigensolver_solve(es, x) == RC.OK
+    rc, eigs = capi.AMGX_eigensolver_get_eigenvalues(es)
+    assert rc == RC.OK
+    Ad = np.asarray(gallery.poisson("5pt", 10, 7).to_dense())
+    lam_ref = np.linalg.eigvalsh(Ad)[-1]
+    np.testing.assert_allclose(eigs[0], lam_ref, rtol=1e-6)
+
+
+def test_write_parameters_description(tmp_path):
+    path = str(tmp_path / "params.txt")
+    assert capi.AMGX_write_parameters_description(path) == RC.OK
+    text = open(path).read()
+    assert "max_iters" in text and "tolerance" in text
+
+
+def test_cli_example(tmp_path):
+    """Run the amgx_capi.py CLI end to end (reference example run)."""
+    Am = gallery.poisson("5pt", 8, 8)
+    path = str(tmp_path / "sys.mtx")
+    write_system(path, Am, b=np.ones(64))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "amgx_capi.py"),
+         "-m", path, "-c",
+         os.path.join(REPO, "configs", "FGMRES_AGGREGATION.json")],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert out.returncode == 0, out.stderr
+    assert "status: success" in out.stdout
