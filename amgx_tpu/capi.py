@@ -98,6 +98,15 @@ class _CMatrix:
         self.resources = resources
         self.mode = mode
         self.A: Optional[CsrMatrix] = None
+        self.part_offsets = None
+        self.row_perm = None
+
+    def set_matrix(self, A, part_offsets=None, row_perm=None):
+        """Replace the stored matrix; distributed renumbering metadata
+        belongs to a specific matrix, so it is reset together with it."""
+        self.A = A
+        self.part_offsets = part_offsets
+        self.row_perm = row_perm
 
 
 class _CVector:
@@ -300,9 +309,9 @@ def AMGX_matrix_upload_all(mtx_h, n, nnz, block_dimx, block_dimy,
         diag = np.asarray(diag_data, dtype=dt)
         if block_dimx * block_dimy > 1:
             diag = diag.reshape(n, block_dimx, block_dimy)
-    m.A = CsrMatrix.from_scipy_like(
+    m.set_matrix(CsrMatrix.from_scipy_like(
         ro, ci, vals, n, n, block_dims=(block_dimx, block_dimy),
-        diag=diag).init()
+        diag=diag).init())
     return RC.OK
 
 
@@ -507,6 +516,22 @@ def AMGX_solver_get_iteration_residual(slv_h, it: int, idx: int = 0):
 # ---------------------------------------------------------------------------
 
 
+def _fill_vectors(m, rhs_h, sol_h, A, b, x):
+    """Shared rhs/sol default-fill for the read paths (b=ones, x=zeros
+    as in the reference reader)."""
+    dt = m.mode.vec_dtype if m else np.float64
+    if rhs_h is not None:
+        rv = _get(rhs_h, _CVector)
+        rv.v = np.asarray(b) if b is not None else np.ones(
+            A.num_rows * A.block_dimy, dtype=dt)
+        rv.block_dim = A.block_dimy
+    if sol_h is not None:
+        sv = _get(sol_h, _CVector)
+        sv.v = np.asarray(x) if x is not None else np.zeros(
+            A.num_rows * A.block_dimx, dtype=dt)
+        sv.block_dim = A.block_dimx
+
+
 @_api
 def AMGX_read_system(mtx_h, rhs_h, sol_h, path: str):
     """src/amgx_c.cu read_system: fills matrix + rhs + solution (missing
@@ -515,18 +540,8 @@ def AMGX_read_system(mtx_h, rhs_h, sol_h, path: str):
     m = _get(mtx_h, _CMatrix) if mtx_h is not None else None
     A, b, x = _read(path, dtype=m.mode.mat_dtype if m else None)
     if m is not None:
-        m.A = A if A.initialized else A.init()
-    n = A.num_rows * A.block_dimy
-    if rhs_h is not None:
-        rv = _get(rhs_h, _CVector)
-        rv.v = np.asarray(b) if b is not None else np.ones(
-            n, dtype=m.mode.vec_dtype if m else np.float64)
-        rv.block_dim = A.block_dimy
-    if sol_h is not None:
-        sv = _get(sol_h, _CVector)
-        sv.v = np.asarray(x) if x is not None else np.zeros(
-            n, dtype=m.mode.vec_dtype if m else np.float64)
-        sv.block_dim = A.block_dimx
+        m.set_matrix(A if A.initialized else A.init())
+    _fill_vectors(m, rhs_h, sol_h, A, b, x)
     return RC.OK
 
 
@@ -539,6 +554,56 @@ def AMGX_write_system(mtx_h, rhs_h, sol_h, path: str):
     b = _get(rhs_h, _CVector).v if rhs_h is not None else None
     x = _get(sol_h, _CVector).v if sol_h is not None else None
     _write(path, m.A, b, x)
+    return RC.OK
+
+
+@_api
+def AMGX_read_system_distributed(mtx_h, rhs_h, sol_h, path: str,
+                                 allocated_halo_depth=1, num_partitions=None,
+                                 partition_sizes=None, partition_vector=None):
+    """src/amgx_c.cu read_system_distributed analog: global system +
+    partition vector (array or `<path>` string) -> partition-contiguous
+    renumbered system on the controller. part_offsets land on the matrix
+    object for the distributed layer."""
+    from .io.distributed import read_system_distributed
+    m = _get(mtx_h, _CMatrix)
+    kw = {}
+    if isinstance(partition_vector, str):
+        kw["partition_path"] = partition_vector
+    elif partition_vector is not None:
+        kw["partition_vector"] = np.asarray(partition_vector)
+    elif partition_sizes is not None:
+        kw["partition_sizes"] = partition_sizes
+    if num_partitions is not None:
+        kw["num_ranks"] = int(num_partitions)
+    A, b, x, part_offsets, perm = read_system_distributed(
+        path, dtype=m.mode.mat_dtype, **kw)
+    m.set_matrix(A, part_offsets=part_offsets, row_perm=perm)
+    _fill_vectors(m, rhs_h, sol_h, A, b, x)
+    return RC.OK
+
+
+@_api
+def AMGX_write_system_distributed(mtx_h, rhs_h, sol_h, path: str,
+                                  allocated_halo_depth=1,
+                                  num_partitions=None, partition_sizes=None,
+                                  partition_vector=None):
+    from .io.distributed import write_system_distributed
+    m = _get(mtx_h, _CMatrix)
+    if m.A is None:
+        raise AMGXError("matrix not uploaded", RC.BAD_PARAMETERS)
+    b = _get(rhs_h, _CVector).v if rhs_h is not None else None
+    x = _get(sol_h, _CVector).v if sol_h is not None else None
+    pv = partition_vector
+    if pv is None and partition_sizes is not None:
+        from .io.distributed import sizes_to_partition_vector
+        pv = sizes_to_partition_vector(partition_sizes, m.A.num_rows)
+    if pv is not None and m.row_perm is not None:
+        # The stored matrix is renumbered (row_perm: new -> old); the
+        # caller's vector is in original order. Align the sidecar with
+        # the written row order.
+        pv = np.asarray(pv)[np.asarray(m.row_perm)]
+    write_system_distributed(path, m.A, b, x, partition_vector=pv)
     return RC.OK
 
 
@@ -567,7 +632,7 @@ def AMGX_generate_distributed_poisson_7pt(mtx_h, rhs_h, sol_h,
     m = _get(mtx_h, _CMatrix)
     A = poisson("7pt", nx * px, ny * py, nz * pz,
                 dtype=m.mode.mat_dtype)
-    m.A = A.init()
+    m.set_matrix(A.init())
     n = m.A.num_rows
     if rhs_h is not None:
         rv = _get(rhs_h, _CVector)

@@ -1,0 +1,215 @@
+"""Distributed IO tests (distributed_io.cu analog): partition vectors,
+renumbering, consolidation-on-read, capi distributed read/write."""
+import numpy as np
+import pytest
+
+import amgx_tpu as amgx
+from amgx_tpu import capi, gallery
+from amgx_tpu.errors import RC
+from amgx_tpu.io import write_system
+from amgx_tpu.io.distributed import (consolidate_partitions,
+                                     read_partition_vector,
+                                     read_system_distributed,
+                                     renumber_by_partition,
+                                     write_system_distributed)
+
+amgx.initialize()
+
+
+@pytest.fixture()
+def system(tmp_path):
+    A = gallery.poisson("5pt", 8, 8)
+    path = str(tmp_path / "sys.mtx")
+    b = np.arange(64, dtype=float)
+    write_system(path, A, b=b)
+    return A, b, path
+
+
+def test_partition_vector_roundtrip(tmp_path):
+    pv = np.array([0, 0, 1, 1, 2, 2, 3, 3], np.int32)
+    p = str(tmp_path / "pv.bin")
+    with open(p, "wb") as f:
+        f.write(pv.tobytes())
+    np.testing.assert_array_equal(read_partition_vector(p, 8), pv)
+    # text format
+    p2 = str(tmp_path / "pv.txt")
+    with open(p2, "w") as f:
+        f.write(" ".join(map(str, pv)))
+    np.testing.assert_array_equal(read_partition_vector(p2, 8), pv)
+
+
+def test_consolidate_partitions():
+    pv = np.array([0, 1, 2, 3, 4, 5, 6, 7])
+    c = consolidate_partitions(pv, 2)
+    assert set(np.unique(c)) == {0, 1}
+    # locality: contiguous partition groups
+    assert np.all(np.diff(c) >= 0)
+    # no-op when targets >= partitions
+    np.testing.assert_array_equal(consolidate_partitions(pv, 8), pv)
+
+
+def test_renumber_preserves_system(system):
+    """The permuted system solves to the permuted solution."""
+    A, b, _ = system
+    A = A.init()
+    rng = np.random.default_rng(3)
+    pv = rng.integers(0, 4, size=64)
+    A2, b2, _, offs, perm = renumber_by_partition(A, pv, b=b)
+    # ranks contiguous after renumbering
+    pv_new = pv[perm]
+    assert np.all(np.diff(pv_new) >= 0)
+    assert offs[-1] == 64 and len(offs) == 5
+    # spectrum-preserving permutation: dense compare
+    Ad = np.asarray(A.to_dense())
+    A2d = np.asarray(A2.to_dense())
+    np.testing.assert_allclose(A2d, Ad[np.ix_(perm, perm)], atol=0)
+    np.testing.assert_allclose(b2, np.asarray(b)[perm])
+
+
+def test_read_system_distributed_solve(system, tmp_path):
+    """Renumbered system gives the same solution (un-permuted) as the
+    original — the correctness contract of distributed read."""
+    Aorig, b, path = system
+    A2, b2, _, offs, perm = read_system_distributed(
+        path, num_ranks=4)
+    from amgx_tpu.config import Config
+    from amgx_tpu.solvers import make_solver
+    cfg = Config.from_string(
+        "solver=CG, max_iters=400, tolerance=1e-10, monitor_residual=1, "
+        "convergence=RELATIVE_INI_CORE")
+    s1 = make_solver("CG", cfg, "default").setup(Aorig.init())
+    x_ref = np.asarray(s1.solve(b).x)
+    s2 = make_solver("CG", cfg, "default").setup(A2)
+    x_perm = np.asarray(s2.solve(b2).x)
+    x_unperm = np.empty_like(x_perm)
+    x_unperm[perm] = x_perm
+    np.testing.assert_allclose(x_unperm, x_ref, atol=1e-7)
+
+
+def test_write_system_distributed_sidecar(system, tmp_path):
+    A, b, _ = system
+    out = str(tmp_path / "out.mtx")
+    pv = np.arange(64) // 16
+    write_system_distributed(out, A, b=b, partition_vector=pv)
+    back = read_partition_vector(out + ".partition", 64)
+    np.testing.assert_array_equal(back, pv)
+
+
+def test_partition_sizes(system):
+    _, _, path = system
+    A2, b2, _, offs, perm = read_system_distributed(
+        path, partition_sizes=[10, 54])
+    np.testing.assert_array_equal(offs, [0, 10, 64])
+    with pytest.raises(Exception):
+        read_system_distributed(path, partition_sizes=[10, 10])
+
+
+def test_trailing_empty_ranks(system):
+    """part_offsets covers every rank even when trailing ranks own no
+    rows (offsets contract: len == num_ranks + 1)."""
+    _, _, path = system
+    pv = np.zeros(64, np.int64)
+    pv[32:] = 1
+    A2, _, _, offs, _ = read_system_distributed(
+        path, partition_vector=pv, num_ranks=4)
+    np.testing.assert_array_equal(offs, [0, 32, 64, 64, 64])
+
+
+def test_malformed_partition_vector(tmp_path):
+    p = str(tmp_path / "bad.txt")
+    with open(p, "w") as f:
+        f.write("0 1 1-2 3")
+    from amgx_tpu.errors import IOError_
+    with pytest.raises(IOError_):
+        read_partition_vector(p)
+    p2 = str(tmp_path / "bad.bin")
+    with open(p2, "wb") as f:
+        f.write(b"\xff\xfe\xfd")   # 3 bytes: not a whole int32
+    with pytest.raises(IOError_):
+        read_partition_vector(p2)
+
+
+def test_renumber_preserves_external_diag():
+    """%%AMGX-diagonal matrices keep their diagonal through renumbering."""
+    from amgx_tpu.matrix import CsrMatrix
+    A = gallery.poisson("5pt", 4, 4).init()
+    rows, cols, vals = [np.asarray(v) for v in A.coo()]
+    off = rows != cols
+    Ad = CsrMatrix.from_coo(rows[off], cols[off], vals[off], 16, 16,
+                            diag=np.full(16, 4.0)).init()
+    pv = np.array([1, 0] * 8)
+    A2, _, _, _, perm = renumber_by_partition(Ad, pv)
+    assert A2.has_external_diag
+    np.testing.assert_allclose(
+        np.asarray(A2.to_dense()),
+        np.asarray(Ad.to_dense())[np.ix_(perm, perm)])
+
+
+def test_negative_rank_rejected(system):
+    from amgx_tpu.errors import IOError_
+    _, _, path = system
+    pv = np.zeros(64, np.int64)
+    pv[5] = -1
+    with pytest.raises(IOError_):
+        read_system_distributed(path, partition_vector=pv, num_ranks=2)
+
+
+def test_renumber_block_vectors():
+    """b/x are scalar-length (n*block_dimy); permutation must move whole
+    blocks."""
+    A = gallery.poisson("5pt", 4, 4).init()
+    from amgx_tpu.matrix import CsrMatrix
+    rows, cols, vals = [np.asarray(v) for v in A.coo()]
+    bvals = np.repeat(vals, 4).reshape(-1, 2, 2)
+    Ab = CsrMatrix.from_coo(rows, cols, bvals, 16, 16,
+                            block_dims=(2, 2)).init()
+    b = np.arange(32, dtype=float)
+    pv = np.array([1, 0] * 8)
+    _, b2, _, _, perm = renumber_by_partition(Ab, pv, b=b)
+    expect = b.reshape(16, 2)[perm].ravel()
+    np.testing.assert_array_equal(b2, expect)
+
+
+def test_capi_write_after_read_sidecar_alignment(system, tmp_path):
+    """After a distributed read renumbers rows, a distributed write with
+    the original-order partition vector must permute the sidecar to the
+    written row order (round-trip stays consistent)."""
+    _, _, path = system
+    rng = np.random.default_rng(7)
+    pv = rng.integers(0, 4, size=64)
+    assert capi.AMGX_initialize() == RC.OK
+    rc, rsrc = capi.AMGX_resources_create_simple(None)
+    rc, Ah = capi.AMGX_matrix_create(rsrc, "dDDI")
+    assert capi.AMGX_read_system_distributed(
+        Ah, None, None, path, partition_vector=pv) == RC.OK
+    out = str(tmp_path / "o.mtx")
+    assert capi.AMGX_write_system_distributed(
+        Ah, None, None, out, partition_vector=pv) == RC.OK
+    back = read_partition_vector(out + ".partition", 64)
+    # written rows are partition-contiguous, so the sidecar must be too
+    assert np.all(np.diff(back) >= 0)
+    np.testing.assert_array_equal(np.bincount(back), np.bincount(pv))
+    capi.AMGX_finalize()
+
+
+def test_capi_distributed_read(system, tmp_path):
+    A, b, path = system
+    pv = np.arange(64) // 16
+    pvp = str(tmp_path / "pv.bin")
+    with open(pvp, "wb") as f:
+        f.write(pv.astype(np.int32).tobytes())
+    assert capi.AMGX_initialize() == RC.OK
+    rc, rsrc = capi.AMGX_resources_create_simple(None)
+    rc, Ah = capi.AMGX_matrix_create(rsrc, "dDDI")
+    rc, bh = capi.AMGX_vector_create(rsrc, "dDDI")
+    assert capi.AMGX_read_system_distributed(
+        Ah, bh, None, path, partition_vector=pvp,
+        num_partitions=4) == RC.OK
+    rc, n, _, _ = capi.AMGX_matrix_get_size(Ah)
+    assert n == 64
+    out = str(tmp_path / "o.mtx")
+    assert capi.AMGX_write_system_distributed(
+        Ah, bh, None, out, partition_vector=pv) == RC.OK
+    import os
+    assert os.path.exists(out) and os.path.exists(out + ".partition")
+    capi.AMGX_finalize()
