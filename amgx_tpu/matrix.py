@@ -59,7 +59,7 @@ class CsrMatrix:
     ell_cols: Optional[Array] = None   # (n, k) padded column ids
     ell_vals: Optional[Array] = None   # (n, k) | (n, k, bx, by)
     dia_offsets: Optional[tuple] = None  # static tuple of diagonal offsets
-    dia_vals: Optional[Array] = None   # (k, n) per-diagonal values
+    dia_vals: Optional[Array] = None   # (k, rows_pad, 128) tiled diagonals
     num_rows: int = 0
     num_cols: int = 0
     block_dimx: int = 1
@@ -155,13 +155,20 @@ class CsrMatrix:
         return offsets, self._build_dia_vals(offsets, row_ids)
 
     def _build_dia_vals(self, offsets, row_ids):
-        """Scatter-add CSR values onto (k, n) diagonals (duplicates sum,
-        matching the segsum/ELL paths). Shared by init and with_values."""
+        """Scatter-add CSR values onto per-diagonal rows (duplicates sum,
+        matching the segsum/ELL paths), stored tile-aligned as
+        (k, rows_pad, 128) so the Pallas SpMV kernel streams them with
+        zero re-layout (see ops/pallas_spmv.py). Shared by init and
+        with_values."""
+        from .ops.pallas_spmv import LANES, dia_padded_rows
         offs = jnp.asarray(offsets, jnp.int64)
         d_idx = jnp.searchsorted(offs, self.col_indices.astype(jnp.int64)
                                  - row_ids.astype(jnp.int64))
-        return jnp.zeros((len(offsets), self.num_rows), self.dtype).at[
+        k = len(offsets)
+        rows_pad = dia_padded_rows(k, self.num_rows)
+        flat = jnp.zeros((k, rows_pad * LANES), self.dtype).at[
             d_idx, row_ids].add(self.values)
+        return flat.reshape(k, rows_pad, LANES)
 
     def _ell_slots(self, row_ids, max_k: int):
         """Flat scatter targets mapping each CSR entry into (n, max_k)."""

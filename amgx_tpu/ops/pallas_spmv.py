@@ -1,0 +1,189 @@
+"""Pallas TPU SpMV kernel for the DIA (banded stencil) layout.
+
+The reference's SpMV fast path is a hand-tuned CUDA csrmv
+(src/multiply.cu:74-121 and the CHANGELOG "fast path" entry). The TPU
+equivalent is not a translation of that kernel: on TPU the roofline
+layout for stencil matrices is DIA — y = sum_d vals_d * shift(x, d) —
+because every stream is a dense sequential read (no gather hardware).
+XLA alone materializes each partial sum in HBM, so a 7-diagonal SpMV
+pays ~4x the minimum traffic. This kernel performs the whole reduction
+in one fused pass:
+
+- grid over row blocks of BLOCK_ROWS*128 elements, sequential on core;
+- diagonal values arrive via an auto-pipelined (k, BR, 128) block;
+- the x window (block + halo rows for every diagonal offset) is DMA'd
+  from HBM into a manually double-buffered VMEM scratch, so the next
+  block's halo loads while the current block computes;
+- lane-crossing shifts (offset % 128 != 0) use the two-row roll+select
+  trick: W[p, q] = a[p, q+r] for q < 128-r else b[p, q+r-128], where
+  a/b are consecutive row views of the window — pure VPU work.
+
+Traffic per output element for a k-diagonal matrix: k value floats +
+~1 x float + 1 y float, i.e. the HBM minimum (plus a halo sliver).
+
+The matrix stores dia_vals tile-aligned as (k, rows_pad, 128) — see
+CsrMatrix._build_dia_vals — so the kernel reads values with zero
+re-layout cost. float32 only (TPU has no native f64; the XLA spmv_dia
+path covers f64/CPU).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+_VMEM_BUDGET = 10 * 1024 * 1024  # leave headroom under ~16 MB/core
+
+
+def pick_block_rows(k: int, rows128: int) -> int:
+    """Rows (of 128 lanes) per grid block. Shared by matrix init (which
+    pads dia_vals to a multiple of this) and the kernel wrapper, so the
+    two always agree. Sized so the double-buffered values block fits
+    VMEM comfortably."""
+    budget_rows = _VMEM_BUDGET // (max(k, 1) * LANES * 4 * 2)
+    br = 512
+    while br > 8 and br > budget_rows:
+        br //= 2
+    if rows128 <= br:
+        # single block: round the whole matrix up to a tile of 8 rows
+        return max(8, -(-rows128 // 8) * 8)
+    return br
+
+
+def dia_padded_rows(k: int, n: int) -> int:
+    """Padded row count (of 128 lanes) for the tiled dia_vals store."""
+    rows128 = max(1, -(-n // LANES))
+    br = pick_block_rows(k, rows128)
+    return -(-rows128 // br) * br
+
+
+def _dia_kernel(offsets, left, block_rows, halo_rows, n_blocks, dtype):
+    """Build the kernel body. All layout numbers are static."""
+    ro = [(left + o) // LANES for o in offsets]   # window row offset
+    rl = [(left + o) % LANES for o in offsets]    # lane shift
+    win_rows = block_rows + halo_rows
+
+    def kernel(xp_ref, vals_ref, y_ref, xbuf, sems):
+        i = pl.program_id(0)
+        slot = jax.lax.rem(i, jnp.int32(2))
+
+        def dma(s, blk):
+            return pltpu.make_async_copy(
+                xp_ref.at[pl.ds(jnp.int32(blk) * jnp.int32(block_rows),
+                                win_rows)],
+                xbuf.at[jnp.int32(s)], sems.at[jnp.int32(s)])
+
+        @pl.when(i == 0)
+        def _():
+            dma(0, 0).start()
+
+        @pl.when(i + 1 < n_blocks)
+        def _():
+            dma(jax.lax.rem(i + 1, jnp.int32(2)), i + 1).start()
+
+        dma(slot, i).wait()
+
+        col = jax.lax.broadcasted_iota(jnp.int32, (block_rows, LANES), 1)
+        acc = jnp.zeros((block_rows, LANES), dtype)
+        xv = xbuf[slot]          # (win_rows, 128) view of this block's x
+        for k, _ in enumerate(offsets):
+            vk = vals_ref[k]
+            if rl[k] == 0:
+                w = jax.lax.slice_in_dim(xv, ro[k], ro[k] + block_rows, 1, 0)
+            else:
+                a = jax.lax.slice_in_dim(xv, ro[k], ro[k] + block_rows, 1, 0)
+                b = jax.lax.slice_in_dim(xv, ro[k] + 1,
+                                         ro[k] + 1 + block_rows, 1, 0)
+                shift = LANES - rl[k]
+                wa = pltpu.roll(a, jnp.int32(shift), 1)
+                wb = pltpu.roll(b, jnp.int32(shift), 1)
+                w = jnp.where(col < shift, wa, wb)
+            acc = acc + vk * w
+        y_ref[...] = acc
+
+    return kernel
+
+
+def _layout(offsets, k: int, num_rows: int):
+    """Shared layout math: (left pad, halo rows, block rows). The gate
+    and the kernel wrapper both call this so they can never diverge."""
+    left = -(-max(0, -min(offsets)) // LANES) * LANES
+    halo_rows = (left + max(max(offsets), 0)) // LANES + 1
+    br = pick_block_rows(k, max(1, -(-num_rows // LANES)))
+    return left, halo_rows, br
+
+
+def dia_spmv_supported(A, x_dtype) -> bool:
+    """Trace-time gate for the Pallas path."""
+    if jax.default_backend() != "tpu":
+        return False
+    if A.dia_vals is None or A.dia_vals.dtype != jnp.float32 \
+            or x_dtype != jnp.float32:
+        return False
+    if A.num_rows != A.num_cols:
+        return False
+    k, rows_pad, _ = A.dia_vals.shape
+    left, halo_rows, br = _layout(A.dia_offsets, k, A.num_rows)
+    if rows_pad % br != 0:
+        return False
+    # window scratch must fit alongside the values pipeline
+    win_bytes = 2 * (br + halo_rows) * LANES * 4
+    vals_bytes = 2 * k * br * LANES * 4
+    return win_bytes + vals_bytes + 2 * br * LANES * 4 <= \
+        _VMEM_BUDGET + 4 * 1024 * 1024
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("offsets", "num_rows", "interpret"))
+def _dia_spmv_call(dia_vals, x, offsets, num_rows, interpret=False):
+    k, rows_pad, _ = dia_vals.shape
+    dtype = dia_vals.dtype
+    n = num_rows
+    left, halo_rows, br = _layout(offsets, k, n)
+    n_blocks = rows_pad // br
+    xp_rows = rows_pad + halo_rows
+    xp = jnp.zeros((xp_rows * LANES,), dtype)
+    xp = jax.lax.dynamic_update_slice(xp, x.astype(dtype), (left,))
+    xp = xp.reshape(xp_rows, LANES)
+
+    kernel = _dia_kernel(offsets, left, br, halo_rows, n_blocks, dtype)
+    y2 = pl.pallas_call(
+        kernel,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(
+                (k, br, LANES),
+                lambda i: (jnp.int32(0), i, jnp.int32(0)),
+                memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((br, LANES),
+                               lambda i: (i, jnp.int32(0)),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows_pad, LANES), dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, br + halo_rows, LANES), dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * k * rows_pad * LANES,
+            bytes_accessed=(k + 2) * rows_pad * LANES * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(xp, dia_vals)
+    y = y2.reshape(-1)
+    if y.shape[0] != n:
+        y = y[:n]
+    return y
+
+
+def dia_spmv(A, x, interpret=False):
+    """Fused DIA SpMV; caller must have checked dia_spmv_supported
+    (`interpret=True` runs the Pallas interpreter — CPU test path)."""
+    return _dia_spmv_call(A.dia_vals, x, A.dia_offsets, A.num_rows,
+                          interpret=interpret)

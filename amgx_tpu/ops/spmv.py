@@ -72,20 +72,49 @@ def spmv_ell(A: CsrMatrix, x: jax.Array) -> jax.Array:
     return y
 
 
-def spmv_dia(A: CsrMatrix, x: jax.Array) -> jax.Array:
-    """y = A @ x in DIA (diagonal) storage: for each stored diagonal with
-    offset d, y += vals_d * shift(x, d). Pure dense vector multiply-adds
-    with static slices — the TPU roofline layout for stencil matrices
-    (no gather; ~2 HBM streams per diagonal)."""
+def _spmv_dia_xla(A: CsrMatrix, x: jax.Array) -> jax.Array:
+    """XLA form of the DIA SpMV (f64/CPU/batched fallback)."""
     n = A.num_rows
     offs = A.dia_offsets
+    vals = A.dia_vals.reshape(len(offs), -1)[:, :n]
     left = max(0, -min(offs))
     right = max(0, n - A.num_cols + max(offs))
     xp = jnp.pad(x, (left, right))
     y = jnp.zeros((n,), x.dtype)
     for i, d in enumerate(offs):
-        y = y + A.dia_vals[i] * jax.lax.dynamic_slice(xp, (left + d,), (n,))
+        y = y + vals[i] * jax.lax.dynamic_slice(xp, (left + d,), (n,))
     return y
+
+
+@jax.custom_batching.custom_vmap
+def _spmv_dia_pallas(A: CsrMatrix, x: jax.Array) -> jax.Array:
+    from .pallas_spmv import dia_spmv
+    return dia_spmv(A, x)
+
+
+@_spmv_dia_pallas.def_vmap
+def _spmv_dia_pallas_vmap(axis_size, in_batched, A, x):
+    """pallas_call has no batching rule for ANY-space operands; batched
+    SpMV (AffinityStrength, eigen block solvers) takes the XLA form."""
+    A_b, x_b = in_batched
+    in_axes = (jax.tree_util.tree_map(lambda b: 0 if b else None, A_b),
+               0 if x_b else None)
+    y = jax.vmap(_spmv_dia_xla, in_axes=in_axes,
+                 axis_size=axis_size)(A, x)
+    return y, True
+
+
+def spmv_dia(A: CsrMatrix, x: jax.Array) -> jax.Array:
+    """y = A @ x in DIA (diagonal) storage: for each stored diagonal with
+    offset d, y += vals_d * shift(x, d). Pure dense vector multiply-adds
+    with static slices — the TPU roofline layout for stencil matrices
+    (no gather; ~2 HBM streams per diagonal). On TPU/f32 the fused
+    Pallas kernel (ops/pallas_spmv.py) does the whole reduction in one
+    HBM pass; the XLA form covers f64, CPU, and vmapped callers."""
+    from .pallas_spmv import dia_spmv_supported
+    if dia_spmv_supported(A, x.dtype):
+        return _spmv_dia_pallas(A, x)
+    return _spmv_dia_xla(A, x)
 
 
 def spmv(A, x: jax.Array) -> jax.Array:
