@@ -30,6 +30,12 @@ class AggregationAMGLevel(AMGLevel):
         return coarse_a_from_aggregates(self.A, self.aggregates,
                                         self.coarse_size)
 
+    def reuse_structure(self, old):
+        """structure_reuse_levels: keep the aggregates map; the Galerkin
+        relabel-sum then runs against the new coefficients."""
+        self.aggregates = old.aggregates
+        self.coarse_size = old.coarse_size
+
     def level_data(self):
         d = super().level_data()
         d["aggregates"] = self.aggregates

@@ -4,10 +4,18 @@ Analog of src/aggregation/coarseAgenerators/ (low_deg 1427 LoC, thrust,
 hybrid). With piecewise-constant P (aggregates map), the Galerkin triple
 product R A P collapses to relabeling A's COO entries by aggregate id and
 coalescing duplicates — a sort + segmented-sum, the TPU-native analog of
-the reference's hash-table kernels. Runs eagerly at setup with concrete
-shapes.
+the reference's hash-table kernels.
+
+The whole product is ONE compiled program with static shapes: instead of
+compacting duplicates (data-dependent size), the coarse CSR keeps every
+relabeled entry, with the coalesced sum stored on the first occurrence of
+each (I, J) pair and zeros on the rest. Zero-valued duplicate entries are
+inert in every consumer (SpMV adds 0; diag extraction is
+first-occurrence; edge weights ignore w == 0).
 """
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -15,21 +23,74 @@ import jax.numpy as jnp
 from ...matrix import CsrMatrix
 
 
-def coarse_a_from_aggregates(A: CsrMatrix, agg, nc: int) -> CsrMatrix:
-    """A_c[I,J] = sum_{agg[i]==I, agg[j]==J} A[i,j]: relabel the COO
-    entries by aggregate id and let from_coo coalesce duplicates."""
+@jax.jit
+def _coarse_entries(A, agg):
+    """Relabel + sort + coalesce: returns sorted COO with the summed
+    value on each (I, J) pair's first occurrence (zeros on duplicates)
+    and the traced unique-entry count."""
     rows, cols, vals = A.coo()
-    Ac = CsrMatrix.from_coo(agg[rows], agg[cols], vals, nc, nc,
-                            block_dims=(A.block_dimx, A.block_dimy))
+    r2 = agg[rows].astype(jnp.int64)
+    c2 = agg[cols].astype(jnp.int64)
     if A.has_external_diag:
-        # fold external diagonal contributions into the coarse entries:
-        # diag blocks land on (agg[i], agg[i])
-        dr = agg.astype(jnp.int32)
-        Dc = CsrMatrix.from_coo(dr, dr, A.diag, nc, nc,
-                                block_dims=(A.block_dimx, A.block_dimy))
-        from ...ops.spgemm import csr_add
-        Ac = csr_add(Ac, Dc)
-    return Ac
+        # fold external diagonal contributions in: they land on
+        # (agg[i], agg[i])
+        da = agg.astype(jnp.int64)
+        r2 = jnp.concatenate([r2, da])
+        c2 = jnp.concatenate([c2, da])
+        vals = jnp.concatenate([vals, A.diag])
+    e = r2.shape[0]
+    key = r2 * (jnp.int64(A.num_rows) + 1) + c2
+    order = jnp.argsort(key, stable=True)
+    key_s = key[order]
+    r_s = r2[order].astype(jnp.int32)
+    c_s = c2[order].astype(jnp.int32)
+    v_s = vals[order]
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), key_s[1:] != key_s[:-1]])
+    seg = jnp.cumsum(first) - 1
+    vsum = jax.ops.segment_sum(v_s, seg, num_segments=e,
+                               indices_are_sorted=True)
+    fexp = first if v_s.ndim == 1 else first[:, None, None]
+    v_out = jnp.where(fexp, vsum[seg], 0.0)
+    return r_s, c_s, v_out, first, seg[-1] + 1
+
+
+@functools.partial(jax.jit, static_argnames=("bdims", "nc", "u"))
+def _compact_coarse(r_s, c_s, v_out, first, bdims, nc: int, u: int):
+    """Gather the u unique entries into an exact-size CSR (restores the
+    geometric nnz decay of the hierarchy: each coarse level stores and
+    sweeps only its real entries)."""
+    e = r_s.shape[0]
+    idx = jnp.nonzero(first, size=u, fill_value=e - 1)[0]
+    r = r_s[idx]
+    c = c_s[idx]
+    v = v_out[idx]
+    counts = jnp.bincount(r, length=nc)
+    row_offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(counts).astype(jnp.int32)])
+    is_diag = c == r
+    cand = jnp.where(is_diag, jnp.arange(u, dtype=jnp.int32), u)
+    dmin = jax.ops.segment_min(cand, r, num_segments=nc,
+                               indices_are_sorted=True)
+    diag_idx = jnp.where(dmin >= u, -1, dmin).astype(jnp.int32)
+    bx, by = bdims
+    return CsrMatrix(
+        row_offsets=row_offsets, col_indices=c, values=v,
+        diag=None, row_ids=r, diag_idx=diag_idx,
+        ell_cols=None, ell_vals=None, dia_offsets=None, dia_vals=None,
+        num_rows=nc, num_cols=nc, block_dimx=bx, block_dimy=by,
+        initialized=True)
+
+
+def coarse_a_from_aggregates(A: CsrMatrix, agg, nc: int) -> CsrMatrix:
+    """A_c[I,J] = sum_{agg[i]==I, agg[j]==J} A[i,j] — two jitted
+    sort/segmented-sum programs with static shapes. The per-level host
+    materializations are exactly two scalars: `nc` (from the selector)
+    and the unique-entry count `u`."""
+    r_s, c_s, v_out, first, u = _coarse_entries(A, agg)
+    return _compact_coarse(r_s, c_s, v_out, first,
+                           (A.block_dimx, A.block_dimy), int(nc), int(u))
 
 
 def restrict_vector(agg, nc: int, r, block_dim: int = 1):

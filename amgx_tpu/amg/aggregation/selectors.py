@@ -17,6 +17,8 @@ All of this is setup-time eager device code with concrete shapes.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -74,96 +76,129 @@ def _edge_hash(rows, cols):
     return (h & jnp.uint32(0xFFFFF)).astype(jnp.float64) / float(1 << 20)
 
 
-def _matching_pass(rows, cols, w, n, max_iters: int,
-                   deterministic: bool = True):
+def _matching_pass(rows, cols, w, n, max_iters: int, active=None,
+                   rows_sorted: bool = True):
     """One size-2 matching: returns aggregate ids (pairs + singletons).
-    Unmatched vertices keep their own id; ids are NOT yet renumbered."""
-    agg = jnp.full((n,), -1, jnp.int32)          # -1 = unaggregated
-    INF_NEG = jnp.asarray(-1.0, w.dtype)
-    # tie-breaking perturbation, small relative to the weight scale
-    scale = float(jnp.max(w)) if w.shape[0] else 1.0
-    w = w * (1.0 + 1e-3 * _edge_hash(rows, cols).astype(w.dtype)) \
-        if scale > 0 else w
+    Unmatched vertices keep their own id; ids are NOT yet renumbered.
 
-    for _ in range(max_iters):
-        un = agg < 0
-        if not bool(jnp.any(un)):
-            break
-        # strongest unaggregated neighbor of each unaggregated vertex
-        valid = un[rows] & un[cols] & (w > 0)
+    Fully jittable: lax.while_loop fixed point, static shapes. `rows`
+    entries equal to n are drop sentinels (padded edges); `active`
+    restricts matching to a traced vertex subset (padded coarse passes).
+    """
+    idx = jnp.arange(n, dtype=jnp.int32)
+    if active is None:
+        active = jnp.ones((n,), bool)
+    # tie-breaking perturbation, small relative to the weight scale
+    # (elementwise no-op for zero weights, so no host-side scale check)
+    w = w * (1.0 + 1e-3 * _edge_hash(rows, cols).astype(w.dtype))
+    INF_NEG = jnp.asarray(-1.0, w.dtype)
+
+    def lookup(mask):
+        """Vertex-property gather tolerant of the n sentinel."""
+        return jnp.concatenate([mask, jnp.zeros((1,), mask.dtype)])[
+            jnp.minimum(rows, n)], \
+            jnp.concatenate([mask, jnp.zeros((1,), mask.dtype)])[
+            jnp.minimum(cols, n)]
+
+    def cond(state):
+        it, agg = state
+        return (it < max_iters) & jnp.any((agg < 0) & active)
+
+    def body(state):
+        it, agg = state
+        un = (agg < 0) & active
+        un_r, un_c = lookup(un)
+        valid = un_r & un_c & (w > 0)
         we = jnp.where(valid, w, INF_NEG)
         wmax = jax.ops.segment_max(we, rows, num_segments=n,
-                                   indices_are_sorted=True)
+                                   indices_are_sorted=rows_sorted)
         has = wmax > 0
-        is_best = valid & (we == wmax[rows])
+        is_best = valid & (we == wmax[jnp.clip(rows, 0, n - 1)])
         # smallest-index tiebreak -> determinism
         best = jax.ops.segment_min(jnp.where(is_best, cols, n), rows,
-                                   num_segments=n, indices_are_sorted=True)
+                                   num_segments=n,
+                                   indices_are_sorted=rows_sorted)
         best = jnp.where(has, best, n)
         # handshake: best[best[i]] == i
-        best_of_best = jnp.where(best < n, best[jnp.clip(best, 0, n - 1)], n)
-        idx = jnp.arange(n, dtype=best.dtype)
+        best_of_best = jnp.where(best < n, best[jnp.clip(best, 0, n - 1)],
+                                 n)
         paired = (best < n) & (best_of_best == idx)
         leader = paired & (idx < best)
-        # aggregate id = leader index
         agg = jnp.where(leader, idx, agg)
-        agg = jnp.where(paired & ~leader, best, agg)
+        agg = jnp.where(paired & ~leader, best.astype(jnp.int32), agg)
+        return it + 1, agg
+
+    _, agg = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), jnp.full((n,), -1, jnp.int32)))
     # leftovers become singletons
-    idx = jnp.arange(n, dtype=jnp.int32)
-    agg = jnp.where(agg < 0, idx, agg)
-    return agg
+    return jnp.where(agg < 0, idx, agg)
 
 
-def _merge_singletons(rows, cols, w, agg, n):
+def _merge_singletons(rows, cols, w, agg, n, rows_sorted: bool = True):
     """Merge singleton aggregates into their strongest neighbor aggregate
-    (merge_singletons=1 semantics)."""
+    (merge_singletons=1 semantics). Jittable, sentinel-tolerant."""
     sizes = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), agg,
                                 num_segments=n)
     is_singleton = sizes[agg] == 1
-    valid = is_singleton[rows] & ~is_singleton[cols] & (w > 0)
+    pad = jnp.concatenate([is_singleton, jnp.zeros((1,), bool)])
+    s_r = pad[jnp.minimum(rows, n)]
+    s_c = pad[jnp.minimum(cols, n)]
+    valid = s_r & ~s_c & (w > 0) & (cols < n)
     we = jnp.where(valid, w, -1.0)
     wmax = jax.ops.segment_max(we, rows, num_segments=n,
-                               indices_are_sorted=True)
+                               indices_are_sorted=rows_sorted)
     has = wmax > 0
-    is_best = valid & (we == wmax[rows])
+    is_best = valid & (we == wmax[jnp.clip(rows, 0, n - 1)])
     best = jax.ops.segment_min(jnp.where(is_best, cols, n), rows,
-                               num_segments=n, indices_are_sorted=True)
+                               num_segments=n,
+                               indices_are_sorted=rows_sorted)
     target = jnp.where(has & is_singleton,
                        agg[jnp.clip(best, 0, n - 1)], agg)
     return jnp.where(is_singleton, target, agg).astype(jnp.int32)
 
 
-def _renumber(agg, n):
-    """Compact aggregate ids to 0..nc-1 (order-preserving, determinstic)."""
-    present = jnp.zeros((n,), jnp.int32).at[agg].set(1)
+def _renumber(agg, n, active=None):
+    """Compact aggregate ids to 0..nc-1 (order-preserving, deterministic).
+    Returns a *traced* nc; the caller materializes it once per level."""
+    if active is None:
+        present = jnp.zeros((n,), jnp.int32).at[agg].set(1)
+    else:
+        present = jnp.zeros((n,), jnp.int32).at[
+            jnp.where(active, agg, n)].set(1, mode="drop")
     new_id = jnp.cumsum(present) - 1
-    nc = int(new_id[-1]) + 1
+    nc = new_id[-1] + 1
     return new_id[agg].astype(jnp.int32), nc
 
 
-def _coarse_graph(rows, cols, w, agg, nc):
+def _coarse_graph(rows, cols, w, agg, nc, n):
     """Collapse the weighted graph onto aggregates (for multi-pass
-    matching): returns (crows, ccols, cw) with duplicates summed."""
-    cr = agg[rows]
-    cc = agg[cols]
-    mask = cr != cc
-    key = cr.astype(jnp.int64) * nc + cc.astype(jnp.int64)
-    key = jnp.where(mask, key, -1)
+    matching), static-shape: returns (crows, ccols, cw) of the same
+    length as the input edge list, duplicates summed onto their first
+    occurrence and non-first/invalid entries turned into drop sentinels
+    (row == col == n, w == 0)."""
+    e = rows.shape[0]
+    aggp = jnp.concatenate([agg, jnp.full((1,), n, jnp.int32)])
+    cr = aggp[jnp.minimum(rows, n)]
+    cc = aggp[jnp.minimum(cols, n)]
+    valid = (cr != cc) & (w > 0) & (rows < n)
+    INF = jnp.int64(jnp.iinfo(jnp.int64).max)
+    key = jnp.where(valid,
+                    cr.astype(jnp.int64) * (n + 1) + cc.astype(jnp.int64),
+                    INF)
     order = jnp.argsort(key, stable=True)
-    key_s, cr_s, cc_s, w_s = key[order], cr[order], cc[order], w[order]
-    start = int(jnp.searchsorted(key_s, 0))  # skip collapsed self-edges
-    key_s, cr_s, cc_s, w_s = (key_s[start:], cr_s[start:], cc_s[start:],
-                              w_s[start:])
-    if key_s.shape[0] == 0:
-        z = jnp.zeros((0,), jnp.int32)
-        return z, z, jnp.zeros((0,), w.dtype)
-    newseg = jnp.concatenate([jnp.ones((1,), bool), key_s[1:] != key_s[:-1]])
-    seg = jnp.cumsum(newseg) - 1
-    nuniq = int(seg[-1]) + 1
-    first = jnp.nonzero(newseg, size=nuniq)[0]
-    wsum = jax.ops.segment_sum(w_s, seg, num_segments=nuniq,
-                               indices_are_sorted=True)
-    return cr_s[first], cc_s[first], wsum
+    key_s = key[order]
+    cr_s, cc_s, w_s = cr[order], cc[order], w[order]
+    valid_s = key_s < INF
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), key_s[1:] != key_s[:-1]]) & valid_s
+    seg = jnp.cumsum(first) - 1
+    wsum = jax.ops.segment_sum(jnp.where(valid_s, w_s, 0.0), seg,
+                               num_segments=e)
+    keep = first
+    crows = jnp.where(keep, cr_s, n).astype(jnp.int32)
+    ccols = jnp.where(keep, cc_s, n).astype(jnp.int32)
+    cw = jnp.where(keep, wsum[jnp.clip(seg, 0, e - 1)], 0.0)
+    return crows, ccols, cw
 
 
 class AggregationSelector:
@@ -183,29 +218,41 @@ class AggregationSelector:
         raise NotImplementedError
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("passes", "max_iters", "merge", "formula"))
+def _set_aggregates_impl(A, *, passes, max_iters, merge, formula):
+    """The whole multi-pass matching as ONE compiled program (static
+    shapes throughout; coarse passes run padded to the fine vertex count
+    with an `active` mask). Returns (aggregates, traced nc)."""
+    n = A.num_rows
+    rows, cols, w = _edge_weights(A, formula)
+    agg = _matching_pass(rows, cols, w, n, max_iters)
+    if merge:
+        agg = _merge_singletons(rows, cols, w, agg, n)
+    agg, nc = _renumber(agg, n)
+    # later passes pair aggregates through the collapsed (padded) graph
+    for _ in range(passes - 1):
+        crows, ccols, cw = _coarse_graph(rows, cols, w, agg, nc, n)
+        active = jnp.arange(n) < nc
+        cagg = _matching_pass(crows, ccols, cw, n, max_iters,
+                              active=active, rows_sorted=False)
+        if merge:
+            cagg = _merge_singletons(crows, ccols, cw, cagg, n,
+                                     rows_sorted=False)
+        cagg, nc = _renumber(cagg, n, active=active)
+        agg = cagg[agg]
+    return agg, nc
+
+
 class _SizeNSelector(AggregationSelector):
     passes = 1  # SIZE_2; 2 -> SIZE_4; 3 -> SIZE_8
 
     def set_aggregates(self, A: CsrMatrix):
-        n = A.num_rows
-        rows, cols, w = _edge_weights(A, self.weight_formula)
-        agg = _matching_pass(rows, cols, w, n,
-                             self.max_matching_iterations)
-        if self.merge_singletons:
-            agg = _merge_singletons(rows, cols, w, agg, n)
-        agg, nc = _renumber(agg, n)
-        # later passes pair aggregates through the collapsed graph
-        for _ in range(self.passes - 1):
-            crows, ccols, cw = _coarse_graph(rows, cols, w, agg, nc)
-            if crows.shape[0] == 0:
-                break
-            cagg = _matching_pass(crows, ccols, cw, nc,
-                                  self.max_matching_iterations)
-            if self.merge_singletons:
-                cagg = _merge_singletons(crows, ccols, cw, cagg, nc)
-            cagg, nc = _renumber(cagg, nc)
-            agg = cagg[agg]
-        return agg, nc
+        agg, nc = _set_aggregates_impl(
+            A, passes=self.passes, max_iters=self.max_matching_iterations,
+            merge=bool(self.merge_singletons), formula=self.weight_formula)
+        return agg, int(nc)   # one host sync per level
 
 
 @registry.aggregation_selectors.register("SIZE_2")

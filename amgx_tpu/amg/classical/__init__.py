@@ -67,6 +67,10 @@ class ClassicalAMGLevel(AMGLevel):
         """P (interpolator), R = P^T, RAP
         (computeProlongationOperator :406, computeRestrictionOperator
         :441, csr_galerkin_product)."""
+        if getattr(self, "_reused", False):
+            # structure reuse: transfer operators kept, only the
+            # Galerkin product sees the new coefficients
+            return galerkin_rap(self.R, self.A, self.P)
         cfg, scope = self.cfg, self.scope
         interp_name = str(cfg.get(self.interpolator_param, scope))
         if self._aggressive:
@@ -78,6 +82,17 @@ class ClassicalAMGLevel(AMGLevel):
             ell="never")
         self.R = transpose(self.P).init(ell="never")
         return galerkin_rap(self.R, self.A, self.P)
+
+    def reuse_structure(self, old):
+        """structure_reuse_levels: keep strength/CF-split and the
+        transfer operators from the prior setup."""
+        self.strong = old.strong
+        self.cf_map = old.cf_map
+        self.coarse_size = old.coarse_size
+        self._aggressive = old._aggressive
+        self.P = old.P
+        self.R = old.R
+        self._reused = True
 
     def level_data(self):
         d = super().level_data()

@@ -50,6 +50,13 @@ class AMGLevel:
     def create_coarse_matrix(self) -> CsrMatrix:
         raise NotImplementedError
 
+    def reuse_structure(self, old: "AMGLevel"):
+        """Adopt the coarsening structure of a previous setup of this
+        level (structure_reuse_levels); create_coarse_matrix then only
+        recomputes the Galerkin product against the new coefficients."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support structure reuse")
+
     # -- solve-phase (pure) ----------------------------------------------
     def level_data(self) -> Dict[str, Any]:
         d = {"A": self.A}
@@ -90,12 +97,44 @@ class AMG:
 
     # -- setup -----------------------------------------------------------
     def setup(self, A: CsrMatrix):
-        from ..solvers.base import make_solver
         t0 = time.perf_counter()
         self.levels = []
-        level_cls = registry.amg_levels.get(self.algorithm)
         Af = A if A.initialized else A.init()
+        self._build_levels(Af, 0)
+        self._finalize_setup(t0)
+        return self
+
+    def resetup(self, A: CsrMatrix):
+        """Coefficient-replace re-setup honoring structure_reuse_levels
+        (AMG_Setup structure-reuse path, src/amg.cu:232-262): the first
+        `structure_reuse_levels` levels (-1 = all) keep their coarsening
+        structure (aggregates / CF-split + transfer operators) and only
+        recompute the Galerkin products; deeper levels rebuild fully."""
+        reuse = int(self.cfg.get("structure_reuse_levels", self.scope))
+        Af = A if A.initialized else A.init()
+        if reuse == 0 or not self.levels or \
+                Af.num_rows != self.levels[0].A.num_rows:
+            return self.setup(A)
+        t0 = time.perf_counter()
+        k = len(self.levels) if reuse < 0 else min(reuse, len(self.levels))
+        old_levels, self.levels = self.levels, []
         lvl = 0
+        while lvl < k:
+            old = old_levels[lvl]
+            if Af.num_rows != old.A.num_rows:
+                break
+            level = type(old)(Af, self.cfg, self.scope, lvl)
+            level.reuse_structure(old)
+            Ac = level.create_coarse_matrix()
+            self.levels.append(level)
+            Af = Ac if Ac.initialized else Ac.init()
+            lvl += 1
+        self._build_levels(Af, lvl)
+        self._finalize_setup(t0)
+        return self
+
+    def _build_levels(self, Af: CsrMatrix, lvl: int):
+        level_cls = registry.amg_levels.get(self.algorithm)
         while True:
             n = Af.num_rows
             stop = (lvl + 1 >= self.max_levels
@@ -117,12 +156,25 @@ class AMG:
             lvl += 1
         self.coarsest_A = Af
 
-        # smoothers (per level; fine_smoother/coarse_smoother split via
-        # the "fine_levels" parameter is honored with the simple rule the
-        # reference uses: levels < fine_levels use fine_smoother)
+    def _finalize_setup(self, t0: float):
+        from ..solvers.base import make_solver
+        # smoothers: with fine_levels >= 0, levels < fine_levels use
+        # fine_smoother and the rest use coarse_smoother (the reference's
+        # fine/coarse algorithm split); fine_levels=-1 (default) disables
+        # the split and every level uses `smoother`
         sm_name, sm_scope = self.cfg.get_solver("smoother", self.scope)
+        fine_levels = int(self.cfg.get("fine_levels", self.scope))
+        fs_name, fs_scope = self.cfg.get_solver("fine_smoother", self.scope)
+        cs2_name, cs2_scope = self.cfg.get_solver("coarse_smoother",
+                                                  self.scope)
         for level in self.levels:
-            level.smoother = make_solver(sm_name, self.cfg, sm_scope)
+            if fine_levels < 0:
+                name, scope = sm_name, sm_scope
+            elif level.level_index < fine_levels:
+                name, scope = fs_name, fs_scope
+            else:
+                name, scope = cs2_name, cs2_scope
+            level.smoother = make_solver(name, self.cfg, scope)
             level.smoother._owns_scaling = False
             if getattr(level.smoother, "needs_cf_map", False) and \
                     getattr(level, "cf_map", None) is not None:
@@ -138,7 +190,6 @@ class AMG:
         if self.print_grid_stats:
             from ..output import amgx_printf
             amgx_printf(self.grid_stats())
-        return self
 
     # -- solve-phase data -------------------------------------------------
     def solve_data(self) -> Dict[str, Any]:

@@ -217,7 +217,8 @@ def _register_default_parameters():
       None, 0.0, 1.0)
     R("initial_color", int, "initial color", 0)
     R("use_bsrxmv", int, "inert (cusparse expert API)", 0)
-    R("fine_levels", int, "levels processed with 'fine' algorithms (-1=all)", -1)
+    R("fine_levels", int, "levels < N use fine_smoother, others "
+      "coarse_smoother (-1 = no split, all use 'smoother')", -1)
     R("coloring_try_remove_last_colors", int, "try removing N last colors", 0)
     R("coloring_custom_arg", str, "custom coloring argument", "")
     R("print_coloring_info", int, "print coloring info", 0)

@@ -113,12 +113,16 @@ class CsrMatrix:
         if self.has_external_diag:
             diag_idx = None
         else:
+            # first-occurrence diagonal (rows without one keep -1) —
+            # first matters for padded-duplicate CSR, where coalesced
+            # duplicates trail the summed entry with zero values
             is_diag = (self.col_indices == row_ids)
-            # rows without a stored diagonal keep -1
-            diag_idx = jnp.full((n,), -1, dtype=jnp.int32)
-            diag_idx = diag_idx.at[jnp.where(is_diag, row_ids, n)[
-                ...]].set(jnp.arange(self.nnz, dtype=jnp.int32),
-                          mode="drop")
+            cand = jnp.where(is_diag, jnp.arange(self.nnz, dtype=jnp.int32),
+                             self.nnz)
+            dmin = jax.ops.segment_min(cand, row_ids, num_segments=n,
+                                       indices_are_sorted=True)
+            diag_idx = jnp.where(dmin >= self.nnz, -1, dmin).astype(
+                jnp.int32)
         ell_cols = ell_vals = None
         dia_offsets = dia_vals = None
         if n > 0 and self.nnz > 0 and not self.is_block \

@@ -164,6 +164,16 @@ class Solver:
     # -- setup -----------------------------------------------------------
     def setup(self, A: CsrMatrix):
         """Build solver state for matrix A (Solver::setup analog)."""
+        return self._setup_impl(A, reuse=False)
+
+    def resetup(self, A: CsrMatrix):
+        """Rebuild coefficients keeping structure where possible
+        (AMGX_solver_resetup analog). Mirrors setup but routes into
+        solver_resetup so subsystems with reusable structure (AMG with
+        structure_reuse_levels) can keep it."""
+        return self._setup_impl(A, reuse=True)
+
+    def _setup_impl(self, A: CsrMatrix, reuse: bool):
         t0 = time.perf_counter()
         if not A.initialized:
             A = A.init()
@@ -181,19 +191,18 @@ class Solver:
         # preconditioner first: solvers whose setup probes the
         # preconditioned operator (e.g. Chebyshev eigen-estimation) need it
         if self.preconditioner is not None:
-            self.preconditioner.setup(A)
-        self.solver_setup()
+            (self.preconditioner.resetup if reuse
+             else self.preconditioner.setup)(A)
+        (self.solver_resetup if reuse else self.solver_setup)()
         self._jit_cache.clear()
         self.setup_time = time.perf_counter() - t0
         return self
 
-    def resetup(self, A: CsrMatrix):
-        """Rebuild coefficients keeping structure where possible
-        (AMGX_solver_resetup analog)."""
-        return self.setup(A)
-
     def solver_setup(self):
         pass
+
+    def solver_resetup(self):
+        self.solver_setup()
 
     # -- functional pieces (pure, jittable) ------------------------------
     def solve_data(self) -> Dict[str, Any]:

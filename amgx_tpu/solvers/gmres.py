@@ -42,6 +42,11 @@ class _GmresBase(Solver):
     def __init__(self, cfg, scope="default", name="GMRES"):
         super().__init__(cfg, scope, name)
         self.m = int(cfg.get("gmres_n_restart", scope))
+        # gmres_krylov_dim caps the stored Krylov basis (reference
+        # semantics: 0 = match the restart length)
+        kdim = int(cfg.get("gmres_krylov_dim", scope))
+        if kdim > 0:
+            self.m = min(self.m, kdim)
 
     def _precond(self, data, r):
         if self.preconditioner is not None:

@@ -1,0 +1,101 @@
+"""Accepted-parameter effectiveness (VERDICT round-1 item 6): registered
+parameters must change observable behavior — accept-and-ignore is a
+correctness trap. Mirrors the reference's config-driven tests
+(src/tests/config_parsing.cu role)."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import amgx_tpu as amgx
+from amgx_tpu import gallery
+from amgx_tpu.config import Config
+from amgx_tpu.solvers import make_solver
+
+amgx.initialize()
+
+
+def _solver(extra=""):
+    cfg = Config.from_string(
+        "config_version=2, solver=AMG, algorithm=AGGREGATION, "
+        "selector=SIZE_2, smoother=BLOCK_JACOBI, coarse_solver=DENSE_LU_SOLVER, "
+        "max_levels=10, max_iters=40, tolerance=1e-8, "
+        "monitor_residual=1, convergence=RELATIVE_INI_CORE" +
+        (", " + extra if extra else ""))
+    return make_solver("AMG", cfg, "default")
+
+
+def test_fine_smoother_split():
+    """fine_levels>0 makes the first levels use fine_smoother."""
+    A = gallery.poisson("5pt", 48, 48).init()
+    s = _solver("fine_smoother=JACOBI_L1, coarse_smoother=JACOBI, "
+                "fine_levels=2, "
+                "min_coarse_rows=8, dense_lu_num_rows=8").setup(A)
+    lv = s.amg.levels
+    assert len(lv) >= 3
+    assert lv[0].smoother.name == "JACOBI_L1"
+    assert lv[1].smoother.name == "JACOBI_L1"
+    assert lv[2].smoother.name == "JACOBI"
+    # -1 (default): no split
+    s2 = _solver("fine_smoother=JACOBI_L1").setup(A)
+    assert all(l.smoother.name == "BLOCK_JACOBI" for l in s2.amg.levels)
+
+
+def test_structure_reuse_levels():
+    """resetup with structure_reuse_levels=-1 keeps the aggregates and
+    still solves the updated system correctly."""
+    A = gallery.poisson("5pt", 24, 24).init()
+    s = _solver("structure_reuse_levels=-1").setup(A)
+    agg0 = np.asarray(s.amg.levels[0].aggregates)
+    nlev0 = s.amg.num_levels
+    A2 = A.with_values(A.values * 2.0)
+    s.resetup(A2)
+    np.testing.assert_array_equal(
+        np.asarray(s.amg.levels[0].aggregates), agg0)
+    assert s.amg.num_levels == nlev0
+    # coarse operator picked up the new coefficients (2x scaling)
+    b = jnp.ones(A.num_rows)
+    res = s.solve(b)
+    r = np.asarray(b) - np.asarray(amgx.ops.spmv(A2, res.x))
+    assert np.linalg.norm(r) / np.sqrt(A.num_rows) < 1e-6
+
+
+def test_structure_reuse_zero_rebuilds():
+    """structure_reuse_levels=0 (default) rebuilds the hierarchy."""
+    A = gallery.poisson("5pt", 24, 24).init()
+    s = _solver().setup(A)
+    A2 = A.with_values(A.values * 2.0)
+    s.resetup(A2)
+    b = jnp.ones(A.num_rows)
+    res = s.solve(b)
+    r = np.asarray(b) - np.asarray(amgx.ops.spmv(A2, res.x))
+    assert np.linalg.norm(r) / np.sqrt(A.num_rows) < 1e-6
+
+
+def test_gmres_krylov_dim_caps_restart():
+    cfg = Config.from_string(
+        "solver=GMRES, gmres_n_restart=30, gmres_krylov_dim=5")
+    g = make_solver("GMRES", cfg, "default")
+    assert g.m == 5
+    cfg2 = Config.from_string("solver=GMRES, gmres_n_restart=30")
+    assert make_solver("GMRES", cfg2, "default").m == 30
+
+
+def test_classical_structure_reuse():
+    A = gallery.poisson("5pt", 20, 20).init()
+    cfg = Config.from_string(
+        "config_version=2, solver=AMG, algorithm=CLASSICAL, "
+        "selector=PMIS, interpolator=D2, smoother=BLOCK_JACOBI, "
+        "coarse_solver=DENSE_LU_SOLVER, max_iters=40, tolerance=1e-8, "
+        "monitor_residual=1, structure_reuse_levels=-1")
+    s = make_solver("AMG", cfg, "default").setup(A)
+    P0 = s.amg.levels[0].P
+    A2 = A.with_values(A.values * 3.0)
+    s.resetup(A2)
+    # transfer operators kept, coarse matrix rebuilt against new values
+    assert s.amg.levels[0].P is P0
+    Ac = s.amg.levels[0 + 1].A if len(s.amg.levels) > 1 \
+        else s.amg.coarsest_A
+    b = jnp.ones(A.num_rows)
+    res = s.solve(b)
+    r = np.asarray(b) - np.asarray(amgx.ops.spmv(A2, res.x))
+    assert np.linalg.norm(r) / np.sqrt(A.num_rows) < 1e-6

@@ -1,0 +1,143 @@
+"""Iteration-parity + robustness harness (VERDICT round-1 item 10).
+
+Parity: the four shipped configs run on fixed fixtures and must
+reproduce the recorded iteration counts exactly — the BASELINE.md
+correctness bar ("identical iteration counts"), with the recorded
+values acting as the checked-in parity table. A change to any selector,
+smoother, or convergence component that alters convergence behavior
+trips these.
+
+Robustness: NaN rhs, zero diagonal, and zero-row inputs must not hang
+or crash — mirroring src/tests/smoother_nan_random.cu and the
+zero_in_diagonal tests of the reference.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import amgx_tpu as amgx
+from amgx_tpu import gallery
+from amgx_tpu.config import Config
+from amgx_tpu.matrix import CsrMatrix
+from amgx_tpu.solvers import make_solver
+
+amgx.initialize()
+
+_CONFIG_DIR = os.path.join(os.path.dirname(__file__), "..", "configs")
+
+# parity table: (config file, fixture, recorded iteration count).
+# Regenerate deliberately (and update here) when algorithm changes are
+# intended; see docstring.
+_PARITY = [
+    ("FGMRES_AGGREGATION.json", ("7pt", (16, 16, 16)), 13),
+    ("AMG_CLASSICAL_PMIS.json", ("7pt", (16, 16, 16)), 27),
+    ("PCG_CLASSICAL_V_JACOBI.json", ("7pt", (16, 16, 16)), 12),
+    ("PBICGSTAB_AGGREGATION_W_JACOBI.json", ("7pt", (16, 16, 16)), 7),
+]
+
+
+def _run(config_name, fixture):
+    stencil, dims = fixture
+    A = gallery.poisson(stencil, *dims).init()
+    cfg = Config.from_file(os.path.join(_CONFIG_DIR, config_name))
+    slv = amgx.create_solver(cfg)
+    slv.setup(A)
+    b = jnp.ones(A.num_rows)
+    return A, b, slv.solve(b)
+
+
+@pytest.mark.parametrize("config_name,fixture,expected_iters", _PARITY)
+def test_iteration_parity(config_name, fixture, expected_iters):
+    A, b, res = _run(config_name, fixture)
+    assert bool(res.converged), f"{config_name} did not converge"
+    assert int(res.iterations) == expected_iters, (
+        f"{config_name}: {int(res.iterations)} iterations, parity table "
+        f"records {expected_iters} — update the table only if the "
+        "algorithm change is intended")
+    r = np.asarray(b) - np.asarray(amgx.ops.spmv(A, res.x))
+    assert np.linalg.norm(r) / np.linalg.norm(np.asarray(b)) < 1e-5
+
+
+# ---------------------------------------------------------------------
+# robustness (smoother_nan_random.cu / zero_in_diagonal analogs)
+# ---------------------------------------------------------------------
+
+def _simple_solver(extra=""):
+    cfg = Config.from_string(
+        "config_version=2, solver=PCG, preconditioner=BLOCK_JACOBI, "
+        "max_iters=30, tolerance=1e-8, monitor_residual=1" +
+        (", " + extra if extra else ""))
+    return make_solver("PCG", cfg, "default")
+
+
+def test_nan_rhs_does_not_hang():
+    """NaN in the rhs must terminate (diverged/not-converged), not hang
+    or return converged."""
+    A = gallery.poisson("5pt", 12, 12).init()
+    b = np.ones(144)
+    b[7] = np.nan
+    res = _simple_solver().setup(A).solve(jnp.asarray(b))
+    assert not bool(res.converged)
+
+
+def test_nan_matrix_smoothers():
+    """Smoothers fed NaN coefficients must not crash (they may return
+    NaN — the solver monitor then reports divergence)."""
+    A = gallery.poisson("5pt", 8, 8)
+    vals = np.asarray(A.values).copy()
+    vals[3] = np.nan
+    An = A.with_values(jnp.asarray(vals))
+    An = An if An.initialized else An.init()
+    for name in ["BLOCK_JACOBI", "JACOBI_L1", "GS"]:
+        s = make_solver(name, Config.from_string(
+            f"solver={name}, max_iters=2"), "default").setup(An)
+        out = s.solve(jnp.ones(64))
+        assert out.x.shape == (64,)     # no crash, shape preserved
+
+
+def test_zero_in_diagonal():
+    """A zero diagonal entry must not produce inf/NaN in Jacobi-family
+    smoothers (guarded inverse), matching the reference's
+    zero-in-diagonal robustness tests."""
+    A = gallery.poisson("5pt", 8, 8)
+    vals = np.asarray(A.values).copy()
+    ro = np.asarray(A.row_offsets)
+    ci = np.asarray(A.col_indices)
+    # zero out row 5's diagonal
+    for p in range(ro[5], ro[6]):
+        if ci[p] == 5:
+            vals[p] = 0.0
+    Az = A.with_values(jnp.asarray(vals))
+    Az = Az if Az.initialized else Az.init()
+    for name in ["BLOCK_JACOBI", "JACOBI_L1"]:
+        s = make_solver(name, Config.from_string(
+            f"solver={name}, max_iters=4"), "default").setup(Az)
+        out = s.solve(jnp.ones(64))
+        assert np.all(np.isfinite(np.asarray(out.x)))
+
+
+def test_zero_row():
+    """A fully zero row (no connections at all) must not crash setup or
+    produce non-finite smoother output."""
+    n = 36
+    A5 = gallery.poisson("5pt", 6, 6)
+    rows, cols, vals = [np.asarray(v) for v in A5.init().coo()]
+    keep = rows != 17
+    Az = CsrMatrix.from_coo(rows[keep], cols[keep], vals[keep],
+                            n, n).init()
+    s = _simple_solver().setup(Az)
+    out = s.solve(jnp.ones(n))
+    assert out.x.shape == (n,)
+
+
+def test_singular_system_reports_nonconvergence():
+    """An all-zero matrix cannot converge on a nonzero rhs; the solver
+    must terminate with converged=False (capi_graceful_failure role)."""
+    n = 16
+    Az = CsrMatrix.from_coo(np.arange(n), np.arange(n), np.zeros(n),
+                            n, n).init()
+    res = _simple_solver().setup(Az).solve(jnp.ones(n))
+    assert not bool(res.converged)
