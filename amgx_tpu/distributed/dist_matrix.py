@@ -7,10 +7,16 @@ reference's DistributedManager gather kernels + MPI Isend/Irecv ring
 (include/distributed/distributed_manager.h:75-170,
 comms_mpi_hostbuffer_stream.cu:321-676):
 
-- ring mode: gather boundary values into per-neighbor send buffers
+- "ring" mode: gather boundary values into per-neighbor send buffers
   (B2L gather analog) and `lax.ppermute` them one hop along the mesh
   axis — two permutes (toward prev, toward next) ride ICI;
-- general mode: `lax.all_gather(tiled)` + static gather by global id.
+- "a2a" mode (general partitions): per-peer send buffers swapped with
+  one `lax.all_to_all` — O(n_ranks * max_pair) traffic, the all-pairs
+  generalization of the B2L maps, replacing the old O(n_global)
+  full-vector all_gather;
+- "gather" mode: `lax.all_gather(tiled)` + static gather by global id —
+  the fallback when boundaries are so dense the all-to-all buffers
+  would exceed the gathered vector itself.
 
 Rectangular shards (the P/R transfer operators of a distributed AMG
 hierarchy) partition rows by the row-side decomposition and columns by
@@ -19,10 +25,12 @@ produces the row-side local vector, so restriction/prolongation are the
 same halo-exchange + local SpMV as the operator itself
 (classical_amg_level.cu restrict/prolongate analog).
 
-Latency hiding (interior SpMV overlapped with the exchange,
-src/multiply.cu:95-110) is left to XLA's async collectives: the exchange
-and the owned-column part of the SpMV have no data dependence, so the
-scheduler overlaps them within the fused program.
+Latency hiding is structural, matching the reference's
+interior/boundary split (src/multiply.cu:95-110): local entries are
+stored split into an *owned-column* part and a *halo-column* part, and
+y = A_own x + A_halo h where only the second term depends on the
+exchange — XLA's latency-hiding scheduler overlaps the collective with
+the owned-part SpMV because there is no data dependence between them.
 """
 from __future__ import annotations
 
@@ -32,35 +40,46 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from ..matrix import CsrMatrix
-
 
 @functools.partial(
     jax.tree_util.register_dataclass,
-    data_fields=["csr", "diag", "halo_src", "send_prev", "send_next",
-                 "recv_prev", "recv_next"],
+    data_fields=["rid_own", "ci_own", "va_own", "rid_halo", "ci_halo",
+                 "va_halo", "diag", "halo_src", "send_prev", "send_next",
+                 "recv_prev", "recv_next", "a2a_send", "a2a_recv"],
     meta_fields=["n_global", "n_local", "n_local_cols", "n_halo", "n_ranks",
-                 "axis_name", "neighbor_only"],
+                 "axis_name", "exchange_mode"],
 )
 @dataclasses.dataclass(frozen=True)
 class ShardMatrix:
     """One shard of a distributed CSR matrix (fields may be stacked with a
-    leading mesh axis outside shard_map; inside, use .local())."""
+    leading mesh axis outside shard_map; inside, use .local()).
 
-    csr: CsrMatrix
+    Entries live in two row-sorted COO sets: owned-column entries
+    (ci_own indexes the local x) and halo-column entries (ci_halo
+    indexes the exchanged halo buffer). Padding uses rid == n_local
+    (dropped by the segment sums)."""
+
+    rid_own: jax.Array          # (e_own,) int32, row id (pad n_local)
+    ci_own: jax.Array           # (e_own,) int32, owned local col (pad 0)
+    va_own: jax.Array           # (e_own,)
+    rid_halo: jax.Array         # (e_halo,) int32 (pad n_local)
+    ci_halo: jax.Array          # (e_halo,) int32, halo slot (pad 0)
+    va_halo: jax.Array          # (e_halo,)
     diag: jax.Array
     halo_src: jax.Array
     send_prev: jax.Array | None
     send_next: jax.Array | None
     recv_prev: jax.Array | None
     recv_next: jax.Array | None
+    a2a_send: jax.Array | None  # (n_ranks, max_pair) local col (pad n_lc)
+    a2a_recv: jax.Array | None  # (n_ranks, max_pair) halo slot (pad n_halo)
     n_global: int
     n_local: int
     n_local_cols: int
     n_halo: int
     n_ranks: int
     axis_name: str = "p"
-    neighbor_only: bool = False
+    exchange_mode: str = "gather"
 
     # -- operator interface (duck-typed CsrMatrix surface) ---------------
     @property
@@ -85,7 +104,7 @@ class ShardMatrix:
 
     @property
     def dtype(self):
-        return self.csr.values.dtype
+        return self.va_own.dtype
 
     def exchange_halo(self, x):
         """Fill the halo buffer from remote shards (exchange_halo analog).
@@ -93,7 +112,7 @@ class ShardMatrix:
         if self.n_ranks == 1:
             return jnp.zeros((self.n_halo,), x.dtype)
         ax = self.axis_name
-        if self.neighbor_only:
+        if self.exchange_mode == "ring":
             xp = jnp.concatenate([x, jnp.zeros((1,), x.dtype)])  # pad slot
             buf_next = xp[self.send_next]       # cols for rank+1
             buf_prev = xp[self.send_prev]       # cols for rank-1
@@ -106,18 +125,32 @@ class ShardMatrix:
             halo = halo.at[self.recv_prev].set(from_prev)
             halo = halo.at[self.recv_next].set(from_next)
             return halo[: self.n_halo]
+        if self.exchange_mode == "a2a":
+            xp = jnp.concatenate([x, jnp.zeros((1,), x.dtype)])
+            bufs = xp[self.a2a_send]            # (n_ranks, max_pair)
+            recv = jax.lax.all_to_all(bufs, ax, split_axis=0,
+                                      concat_axis=0, tiled=True)
+            halo = jnp.zeros((self.n_halo + 1,), x.dtype)
+            halo = halo.at[self.a2a_recv].set(recv)
+            return halo[: self.n_halo]
         x_all = jax.lax.all_gather(x, ax, tiled=True)   # padded global
         idx = jnp.clip(self.halo_src, 0, x_all.shape[0] - 1)
         return x_all[idx]
 
     def spmv(self, x):
-        """Distributed y = A x: halo exchange + local SpMV over the
-        concatenated [owned | halo] vector (multiply w/ halo analog,
-        src/multiply.cu:95-119)."""
+        """Distributed y = A x with the interior/boundary overlap split
+        (multiply.cu:95-119): the owned-column product has no data
+        dependence on the exchange, so XLA overlaps them."""
         halo = self.exchange_halo(x)
-        xa = jnp.concatenate([x, halo])
-        from ..ops.spmv import spmv_csr_segsum
-        return spmv_csr_segsum(self.csr, xa)
+        y = jax.ops.segment_sum(
+            self.va_own * x[self.ci_own], self.rid_own,
+            num_segments=self.n_local, indices_are_sorted=True)
+        if self.va_halo.shape[0]:
+            hp = halo if self.n_halo else jnp.zeros((1,), x.dtype)
+            y = y + jax.ops.segment_sum(
+                self.va_halo * hp[self.ci_halo], self.rid_halo,
+                num_segments=self.n_local, indices_are_sorted=True)
+        return y
 
     def diagonal(self):
         return self.diag
@@ -133,16 +166,14 @@ def shard_matrix_from_partition(p, axis_name: str = "p") -> ShardMatrix:
         raise ValueError(
             f"partition covers {p.n_ranks * p.n_local_cols} of "
             f"{p.n_global_cols} global columns")
-    csr = CsrMatrix(
-        row_offsets=p.row_offsets, col_indices=p.col_indices,
-        values=p.values, row_ids=p.row_ids,
-        num_rows=p.n_local, num_cols=p.n_local_cols + p.n_halo,
-        initialized=True)
     return ShardMatrix(
-        csr=csr, diag=p.diag, halo_src=p.halo_src,
+        rid_own=p.rid_own, ci_own=p.ci_own, va_own=p.va_own,
+        rid_halo=p.rid_halo, ci_halo=p.ci_halo, va_halo=p.va_halo,
+        diag=p.diag, halo_src=p.halo_src,
         send_prev=p.send_prev, send_next=p.send_next,
         recv_prev=p.recv_prev, recv_next=p.recv_next,
+        a2a_send=p.a2a_send, a2a_recv=p.a2a_recv,
         n_global=p.n_global, n_local=p.n_local,
         n_local_cols=p.n_local_cols, n_halo=p.n_halo,
         n_ranks=p.n_ranks, axis_name=axis_name,
-        neighbor_only=p.neighbor_only)
+        exchange_mode=p.exchange_mode)

@@ -40,27 +40,37 @@ from ..matrix import CsrMatrix
 @dataclasses.dataclass(frozen=True)
 class DistPartition:
     """Host-side partition product: stacked (n_ranks, ...) device arrays
-    ready to be shard_mapped over the mesh axis."""
+    ready to be shard_mapped over the mesh axis. Entries are split into
+    owned-column and halo-column sets (the interior/boundary overlap
+    split of src/multiply.cu:95-110)."""
 
-    # stacked local CSR (cols < n_local_cols owned; >= -> halo slot)
-    row_offsets: jnp.ndarray        # (R, n_local+1) int32
-    col_indices: jnp.ndarray        # (R, max_nnz) int32
-    values: jnp.ndarray             # (R, max_nnz)
-    row_ids: jnp.ndarray            # (R, max_nnz) int32 (pre-initialized)
+    rid_own: jnp.ndarray            # (R, e_own) int32 row id (pad n_local)
+    ci_own: jnp.ndarray             # (R, e_own) int32 owned col (pad 0)
+    va_own: jnp.ndarray             # (R, e_own)
+    rid_halo: jnp.ndarray           # (R, e_halo) int32 (pad n_local)
+    ci_halo: jnp.ndarray            # (R, e_halo) int32 halo slot (pad 0)
+    va_halo: jnp.ndarray            # (R, e_halo)
     diag: jnp.ndarray               # (R, n_local) local diagonal (pad 1.0)
     halo_src: jnp.ndarray           # (R, n_halo) global col id (pad 0)
-    # ring maps (None unless neighbor-only): send rows / recv halo slots
+    # ring maps (None unless ring mode): send rows / recv halo slots
     send_prev: Optional[jnp.ndarray]   # (R, max_send) local col (pad n_lc)
     send_next: Optional[jnp.ndarray]
     recv_prev: Optional[jnp.ndarray]   # (R, max_send) halo slot (pad n_halo)
     recv_next: Optional[jnp.ndarray]
+    # all-to-all maps (None unless a2a mode)
+    a2a_send: Optional[jnp.ndarray]    # (R, R, max_pair) local col (pad n_lc)
+    a2a_recv: Optional[jnp.ndarray]    # (R, R, max_pair) halo slot (pad n_h)
     n_global: int                   # global rows
     n_global_cols: int              # global cols
     n_local: int                    # local rows per shard
     n_local_cols: int               # local (owned) cols per shard
     n_halo: int
     n_ranks: int
-    neighbor_only: bool
+    exchange_mode: str              # "ring" | "a2a" | "gather"
+
+    @property
+    def neighbor_only(self) -> bool:
+        return self.exchange_mode == "ring"
 
 
 def partition_matrix(A: CsrMatrix, n_ranks: int) -> DistPartition:
@@ -83,7 +93,8 @@ def partition_matrix(A: CsrMatrix, n_ranks: int) -> DistPartition:
     values = np.asarray(A.values)
 
     ranks = []
-    max_nnz = 1
+    max_own = 1
+    max_hal = 1
     max_halo = 1
     for r in range(n_ranks):
         lo = min(r * n_local, n)
@@ -95,33 +106,39 @@ def partition_matrix(A: CsrMatrix, n_ranks: int) -> DistPartition:
         owned = (cols_g >= clo) & (cols_g < chi)
         halo_global = np.unique(cols_g[~owned])
         ranks.append((lo, hi, clo, s, e, cols_g, owned, halo_global))
-        max_nnz = max(max_nnz, e - s)
+        max_own = max(max_own, int(owned.sum()))
+        max_hal = max(max_hal, int((~owned).sum()))
         max_halo = max(max_halo, halo_global.size)
 
     R = n_ranks
-    ro = np.zeros((R, n_local + 1), np.int32)
-    ci = np.zeros((R, max_nnz), np.int32)
-    va = np.zeros((R, max_nnz), values.dtype)
-    rid = np.full((R, max_nnz), n_local - 1, np.int32)
+    rid_own = np.full((R, max_own), n_local, np.int32)
+    ci_own = np.zeros((R, max_own), np.int32)
+    va_own = np.zeros((R, max_own), values.dtype)
+    rid_hal = np.full((R, max_hal), n_local, np.int32)
+    ci_hal = np.zeros((R, max_hal), np.int32)
+    va_hal = np.zeros((R, max_hal), values.dtype)
     dg = np.ones((R, n_local), values.dtype)
     halo_src = np.zeros((R, max_halo), np.int64)
     for r, (lo, hi, clo, s, e, cols_g, owned, hg) in enumerate(ranks):
         nr = hi - lo
-        nnz_r = e - s
-        ro[r, : nr + 1] = row_offsets[lo:hi + 1] - s
-        ro[r, nr + 1:] = ro[r, nr]
-        slot = np.searchsorted(hg, cols_g)
-        ci[r, :nnz_r] = np.where(owned, cols_g - clo, n_local_cols + slot)
-        va[r, :nnz_r] = values[s:e]
-        rid[r, :nnz_r] = np.repeat(np.arange(nr),
-                                   np.diff(row_offsets[lo:hi + 1]))
+        lrows = np.repeat(np.arange(nr), np.diff(row_offsets[lo:hi + 1]))
+        vals_r = values[s:e]
+        no = int(owned.sum())
+        rid_own[r, :no] = lrows[owned]
+        ci_own[r, :no] = cols_g[owned] - clo
+        va_own[r, :no] = vals_r[owned]
+        nh = lrows.shape[0] - no
+        rid_hal[r, :nh] = lrows[~owned]
+        ci_hal[r, :nh] = np.searchsorted(hg, cols_g[~owned])
+        va_hal[r, :nh] = vals_r[~owned]
         halo_src[r, : hg.size] = hg
         if square:
-            local_rows = rid[r, :nnz_r]
-            is_diag = (cols_g == local_rows + lo)
-            dg[r, local_rows[is_diag]] = values[s:e][is_diag]
+            is_diag = (cols_g == lrows + lo)
+            dg[r, lrows[is_diag]] = vals_r[is_diag]
 
-    # ring eligibility: all halo cols owned by ranks r-1 / r+1
+    # exchange mode: ring if all halo cols owned by ranks r-1 / r+1;
+    # else all-to-all when the padded pair buffers beat the full gather;
+    # else all_gather fallback
     neighbor_only = n_ranks > 1
     for r, (*_, hg) in enumerate(ranks):
         if hg.size and not np.all((hg // n_local_cols >= r - 1)
@@ -130,6 +147,7 @@ def partition_matrix(A: CsrMatrix, n_ranks: int) -> DistPartition:
             break
 
     send_prev = send_next = recv_prev = recv_next = None
+    a2a_send = a2a_recv = None
     if neighbor_only:
         max_send = 1
         sp = [np.zeros(0, np.int64)] * R
@@ -163,16 +181,50 @@ def partition_matrix(A: CsrMatrix, n_ranks: int) -> DistPartition:
         send_next = jnp.asarray(send_next)
         recv_prev = jnp.asarray(recv_prev)
         recv_next = jnp.asarray(recv_next)
+        exchange_mode = "ring"
+    else:
+        # all-to-all maps: what each peer p owes rank r (and where r
+        # scatters it). hg is sorted, so per-peer slices stay aligned on
+        # both sides.
+        pair_send = [[np.zeros(0, np.int64)] * R for _ in range(R)]
+        pair_recv = [[np.zeros(0, np.int64)] * R for _ in range(R)]
+        max_pair = 0
+        for r, (*_, hg) in enumerate(ranks):
+            if not hg.size:
+                continue
+            src_rank = np.clip(hg // n_local_cols, 0, R - 1)
+            for p in np.unique(src_rank):
+                need = hg[src_rank == p]
+                pair_send[int(p)][r] = need - int(p) * n_local_cols
+                pair_recv[r][int(p)] = np.searchsorted(hg, need)
+                max_pair = max(max_pair, need.size)
+        # a2a beats the full gather when the padded buffers are smaller
+        if n_ranks > 1 and max_pair * R < n_local_cols * R // 2:
+            a2a_send = np.full((R, R, max(max_pair, 1)), n_local_cols,
+                               np.int32)
+            a2a_recv = np.full((R, R, max(max_pair, 1)), max_halo,
+                               np.int32)
+            for r in range(R):
+                for p in range(R):
+                    a2a_send[r, p, : pair_send[r][p].size] = pair_send[r][p]
+                    a2a_recv[r, p, : pair_recv[r][p].size] = pair_recv[r][p]
+            a2a_send = jnp.asarray(a2a_send)
+            a2a_recv = jnp.asarray(a2a_recv)
+            exchange_mode = "a2a"
+        else:
+            exchange_mode = "gather"
 
     return DistPartition(
-        row_offsets=jnp.asarray(ro), col_indices=jnp.asarray(ci),
-        values=jnp.asarray(va), row_ids=jnp.asarray(rid),
+        rid_own=jnp.asarray(rid_own), ci_own=jnp.asarray(ci_own),
+        va_own=jnp.asarray(va_own), rid_halo=jnp.asarray(rid_hal),
+        ci_halo=jnp.asarray(ci_hal), va_halo=jnp.asarray(va_hal),
         diag=jnp.asarray(dg), halo_src=jnp.asarray(halo_src),
         send_prev=send_prev, send_next=send_next,
         recv_prev=recv_prev, recv_next=recv_next,
+        a2a_send=a2a_send, a2a_recv=a2a_recv,
         n_global=n, n_global_cols=m, n_local=n_local,
         n_local_cols=n_local_cols, n_halo=max_halo, n_ranks=n_ranks,
-        neighbor_only=neighbor_only)
+        exchange_mode=exchange_mode)
 
 
 def partition_vector(v, n_ranks: int):

@@ -160,13 +160,15 @@ def _dinv_l1(part):
     """Per-shard L1-strengthened diagonal inverse. The off-diagonal row L1
     sums include halo columns — matching the reference's OWNED-view
     semantics."""
-    vals = part.values
-    rid = part.row_ids
     R, n_local = part.diag.shape
-    is_diag = part.col_indices == rid
-    off = jnp.where(is_diag, 0.0, jnp.abs(vals))
-    l1 = jax.vmap(lambda o, r: jax.ops.segment_sum(
-        o, r, num_segments=n_local))(off, rid)
+
+    def one(vo, ro, co, vh, rh):
+        off = jnp.where(co == ro, 0.0, jnp.abs(vo))
+        return jax.ops.segment_sum(off, ro, num_segments=n_local) + \
+            jax.ops.segment_sum(jnp.abs(vh), rh, num_segments=n_local)
+
+    l1 = jax.vmap(one)(part.va_own, part.rid_own, part.ci_own,
+                       part.va_halo, part.rid_halo)
     d = part.diag
     dl1 = d + jnp.sign(d) * l1
     return _dinv(dl1)
