@@ -31,6 +31,8 @@ from .config import Config, AMG_Config  # noqa: E402,F401
 from .matrix import CsrMatrix  # noqa: E402,F401
 from .errors import RC, AMGXError  # noqa: E402,F401
 from . import ops  # noqa: E402,F401
+from . import profiling  # noqa: E402,F401
+from . import determinism  # noqa: E402,F401
 
 _initialized = False
 

@@ -535,10 +535,20 @@ def _fill_vectors(m, rhs_h, sol_h, A, b, x):
 @_api
 def AMGX_read_system(mtx_h, rhs_h, sol_h, path: str):
     """src/amgx_c.cu read_system: fills matrix + rhs + solution (missing
-    pieces default to b=ones/x=zeros as in the reference reader)."""
+    pieces default to b=ones/x=zeros as in the reference reader). A
+    complex-valued file is converted to its K-formulation real system
+    when the resources config sets complex_conversion (readers.cu:221)."""
     from .io import read_system as _read
     m = _get(mtx_h, _CMatrix) if mtx_h is not None else None
     A, b, x = _read(path, dtype=m.mode.mat_dtype if m else None)
+    if np.issubdtype(A.values.dtype, np.complexfloating):
+        conv = 0
+        cfg = m.resources.cfg if m is not None and m.resources else None
+        if cfg is not None:
+            conv = int(cfg.get("complex_conversion", "default"))
+        if conv:
+            from .io.complex import complex_system_to_real
+            A, b, x = complex_system_to_real(A, b, x, mode=conv)
     if m is not None:
         m.set_matrix(A if A.initialized else A.init())
     _fill_vectors(m, rhs_h, sol_h, A, b, x)

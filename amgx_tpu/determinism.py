@@ -1,0 +1,76 @@
+"""Run-to-run determinism checker.
+
+Analog of src/determinism_checker.cu(:121): record bit-exact
+fingerprints of intermediate vectors at named checkpoints during one
+run, then verify that a repeat run reproduces every fingerprint. The
+framework's algorithms are deterministic by construction (no atomics,
+smallest-index tie-breaking, fixed reduction orders), and this harness
+is the tool that *proves* it for any given configuration.
+
+Usage:
+    chk = DeterminismChecker()
+    chk.observe("residual", r)          # during run 1
+    chk.start_verification()
+    chk.observe("residual", r)          # during run 2 -> raises on drift
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+class DeterminismError(AssertionError):
+    pass
+
+
+def fingerprint(x) -> str:
+    """Bit-exact digest of an array (device arrays are pulled once)."""
+    a = np.ascontiguousarray(np.asarray(x))
+    h = hashlib.sha256()
+    h.update(str(a.dtype).encode())
+    h.update(str(a.shape).encode())
+    h.update(a.tobytes())
+    return h.hexdigest()[:16]
+
+
+class DeterminismChecker:
+    """Record-then-verify fingerprint trace (determinism_checker.cu)."""
+
+    def __init__(self):
+        self._trace: Dict[str, List[str]] = {}
+        self._pos: Dict[str, int] = {}
+        self._verifying = False
+
+    def observe(self, tag: str, x):
+        fp = fingerprint(x)
+        if not self._verifying:
+            self._trace.setdefault(tag, []).append(fp)
+            return
+        seq = self._trace.get(tag)
+        i = self._pos.get(tag, 0)
+        if seq is None or i >= len(seq):
+            raise DeterminismError(
+                f"determinism: unexpected extra observation for {tag!r} "
+                f"(call #{i})")
+        if seq[i] != fp:
+            raise DeterminismError(
+                f"determinism: {tag!r} call #{i} fingerprint {fp} != "
+                f"recorded {seq[i]}")
+        self._pos[tag] = i + 1
+
+    def start_verification(self):
+        self._verifying = True
+        self._pos = {}
+
+    def finish(self):
+        """Assert the verification run covered every recorded call."""
+        for tag, seq in self._trace.items():
+            if self._pos.get(tag, 0) != len(seq):
+                raise DeterminismError(
+                    f"determinism: {tag!r} observed "
+                    f"{self._pos.get(tag, 0)}/{len(seq)} calls")
+
+    def summary(self) -> List[Tuple[str, int]]:
+        return [(t, len(s)) for t, s in sorted(self._trace.items())]

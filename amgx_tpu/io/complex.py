@@ -1,0 +1,131 @@
+"""Complex -> real system conversion (ERF K1..K4 formulations).
+
+Analog of the reference reader's complex_conversion path
+(src/readers.cu:200-420): a complex n x n system is rewritten as a real
+system the solvers can handle, either as a 2n scalar system (modes
+1..4) or as an n x n system of 2x2 blocks (modes 221..224), using the
+equivalent-real-formulation K<k>:
+
+    K1: [[ Re, -Im], [ Im,  Re]]   b = [Re; Im]   x = [Re;  Im]
+    K2: [[ Re,  Im], [ Im, -Re]]   b = [Re; Im]   x = [Re; -Im]
+    K3: [[ Im,  Re], [ Re, -Im]]   b = [Im; Re]   x = [Re;  Im]
+    K4: [[ Im, -Re], [ Re,  Im]]   b = [Im; Re]   x = [Re; -Im]
+
+(K-formulation naming after Day & Heroux, "Solving complex-valued
+linear systems via equivalent real formulations".)
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..errors import BadParametersError
+from ..matrix import CsrMatrix
+
+# per-mode 2x2 coefficient stencil: entries are (source, sign) with
+# source 're' or 'im', laid out [[TL, TR], [BL, BR]]
+_K = {
+    1: ((("re", 1), ("im", -1)), (("im", 1), ("re", 1))),
+    2: ((("re", 1), ("im", 1)), (("im", 1), ("re", -1))),
+    3: ((("im", 1), ("re", 1)), (("re", 1), ("im", -1))),
+    4: ((("im", 1), ("re", -1)), (("re", 1), ("im", 1))),
+}
+
+
+def _parts(vals, spec):
+    src, sign = spec
+    v = np.real(vals) if src == "re" else np.imag(vals)
+    return sign * v
+
+
+def complex_system_to_real(A: CsrMatrix, b=None, x=None, mode: int = 1):
+    """Convert a complex system to its K<mode> real form.
+
+    Modes 1..4 produce the 2n scalar system; 221..224 the n-row system
+    of 2x2 blocks (same K stencil per entry). Returns (A, b, x)."""
+    block = False
+    if 220 < mode < 225:
+        block, mode = True, mode - 220
+    if mode not in _K:
+        raise BadParametersError(
+            f"complex_conversion={mode}: supported modes are 1..4 "
+            "(scalar ERF) and 221..224 (2x2-block ERF)")
+    if A.is_block:
+        raise BadParametersError(
+            "complex_conversion supports scalar complex input only "
+            "(the reference has the same restriction for block input)")
+    rows, cols, vals = [np.asarray(v) for v in A.coo()]
+    n = A.num_rows
+    m = A.num_cols
+    ((tl, tr), (bl, br)) = _K[mode]
+
+    rdtype = np.real(vals[:0]).dtype       # matching real dtype
+    if block:
+        bvals = np.empty((vals.shape[0], 2, 2), rdtype)
+        bvals[:, 0, 0] = _parts(vals, tl)
+        bvals[:, 0, 1] = _parts(vals, tr)
+        bvals[:, 1, 0] = _parts(vals, bl)
+        bvals[:, 1, 1] = _parts(vals, br)
+        diag = None
+        if A.has_external_diag:
+            dv = np.asarray(A.diag)
+            diag = np.empty((n, 2, 2), rdtype)
+            diag[:, 0, 0] = _parts(dv, tl)
+            diag[:, 0, 1] = _parts(dv, tr)
+            diag[:, 1, 0] = _parts(dv, bl)
+            diag[:, 1, 1] = _parts(dv, br)
+            diag = jnp.asarray(diag)
+        A2 = CsrMatrix.from_coo(rows, cols, jnp.asarray(bvals), n, m,
+                                block_dims=(2, 2), coalesce=False,
+                                diag=diag)
+    else:
+        if A.has_external_diag:
+            raise BadParametersError(
+                "scalar ERF of an external-diagonal matrix: fold the "
+                "diagonal first")
+        r2 = np.concatenate([rows, rows, rows + n, rows + n])
+        c2 = np.concatenate([cols, cols + m, cols, cols + m])
+        v2 = np.concatenate([_parts(vals, tl), _parts(vals, tr),
+                             _parts(vals, bl), _parts(vals, br)])
+        A2 = CsrMatrix.from_coo(r2, c2, jnp.asarray(v2), 2 * n, 2 * m,
+                                coalesce=False)
+
+    def conv_vec(v, order):
+        if v is None:
+            return None
+        v = np.asarray(v)
+        re, im = np.real(v), np.imag(v)
+        if order == "re_im":
+            parts = (re, im)
+        elif order == "im_re":
+            parts = (im, re)
+        else:  # "re_negim"
+            parts = (re, -im)
+        if block:
+            return jnp.asarray(np.stack(parts, axis=1).reshape(-1))
+        return jnp.asarray(np.concatenate(parts))
+
+    b_order = "re_im" if mode in (1, 2) else "im_re"
+    x_order = "re_im" if mode in (1, 3) else "re_negim"
+    return A2, conv_vec(b, b_order), conv_vec(x, x_order)
+
+
+def real_solution_to_complex(x, mode: int = 1):
+    """Recover the complex solution from the real ERF solution."""
+    block = False
+    if 220 < mode < 225:
+        block, mode = True, mode - 220
+    if mode not in _K:
+        raise BadParametersError(
+            f"complex_conversion={mode}: supported modes are 1..4 "
+            "(scalar ERF) and 221..224 (2x2-block ERF)")
+    x = np.asarray(x)
+    if block:
+        xr = x.reshape(-1, 2)
+        re, im = xr[:, 0], xr[:, 1]
+    else:
+        n = x.shape[0] // 2
+        re, im = x[:n], x[n:]
+    if mode in (2, 4):
+        im = -im
+    return jnp.asarray(re + 1j * im)

@@ -1,0 +1,128 @@
+"""Matrix permutation / sorting / analysis kernels.
+
+Analogs of the reference's misc kernel set (src/permute.cu, sort
+utilities, and the matrix-analysis diagnostics of
+src/matrix_analysis.cu): symmetric and unsymmetric row/column
+permutations of CSR matrices, row sorting by key, and structural
+analysis (symmetry, diagonal dominance, bandwidth) used by diagnostics
+and test harnesses. All static-shape device code (sort + segment ops).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..matrix import CsrMatrix
+
+
+def _iperm(perm):
+    n = perm.shape[0]
+    ip = jnp.zeros((n,), perm.dtype).at[perm].set(
+        jnp.arange(n, dtype=perm.dtype))
+    return ip
+
+
+def permute_matrix(A: CsrMatrix, row_perm=None, col_perm=None) -> CsrMatrix:
+    """B = P_r A P_c^T: B[i, j] = A[row_perm[i], col_perm[j]].
+
+    `row_perm`/`col_perm` map new index -> old index (pass the same
+    array for the symmetric reordering of src/permute.cu). Either may
+    be None (identity)."""
+    if A.has_external_diag and not (
+            row_perm is col_perm
+            or (row_perm is not None and col_perm is not None
+                and np.array_equal(np.asarray(row_perm),
+                                   np.asarray(col_perm)))):
+        raise ValueError(
+            "permute_matrix: external-diagonal matrices support only the "
+            "symmetric permutation (row_perm == col_perm)")
+    rows, cols, vals = A.coo()
+    if row_perm is not None:
+        row_perm = jnp.asarray(row_perm, jnp.int32)
+        rows = _iperm(row_perm)[rows]
+    if col_perm is not None:
+        col_perm = jnp.asarray(col_perm, jnp.int32)
+        cols = _iperm(col_perm)[cols]
+    diag = A.diag
+    if diag is not None and row_perm is not None:
+        diag = diag[row_perm]
+    return CsrMatrix.from_coo(rows, cols, vals, A.num_rows, A.num_cols,
+                              block_dims=(A.block_dimx, A.block_dimy),
+                              coalesce=False, diag=diag)
+
+
+def permute_vector(x, perm, block_dim: int = 1):
+    """y[i] = x[perm[i]] blockwise (reference reorder kernels)."""
+    if block_dim == 1:
+        return x[perm]
+    return x.reshape(-1, block_dim)[perm].reshape(-1)
+
+
+def sort_rows_by(A: CsrMatrix, key) -> tuple:
+    """Symmetric reordering sorting rows (and matching columns) by `key`
+    ascending (stable). Returns (permuted matrix, perm) — the row-sort
+    utility role. Square matrices only (the permutation applies to both
+    sides)."""
+    if A.num_rows != A.num_cols:
+        raise ValueError(
+            "sort_rows_by: symmetric reordering requires a square matrix; "
+            "use permute_matrix with separate row/col permutations")
+    perm = jnp.argsort(jnp.asarray(key), stable=True).astype(jnp.int32)
+    return permute_matrix(A, row_perm=perm, col_perm=perm), perm
+
+
+class MatrixAnalysis(NamedTuple):
+    """Structural diagnostics (matrix_analysis.cu role)."""
+    is_structurally_symmetric: bool
+    is_symmetric: bool
+    diag_dominant_rows: int      # rows with |a_ii| >= sum_j |a_ij|
+    num_rows: int
+    nnz: int
+    bandwidth: int               # max |i - j| over stored entries
+    min_row_nnz: int
+    max_row_nnz: int
+    has_zero_diag: bool
+
+
+def analyze_matrix(A: CsrMatrix, tol: float = 0.0) -> MatrixAnalysis:
+    """Compute structural/numerical diagnostics in one device pass."""
+    A = A if A.initialized else A.init(ell="never")
+    rows, cols, vals = A.coo()
+    if A.is_block:
+        vals = vals[:, 0, 0]
+    n = A.num_rows
+    key = rows.astype(jnp.int64) * A.num_cols + cols.astype(jnp.int64)
+    key_t = cols.astype(jnp.int64) * A.num_cols + rows.astype(jnp.int64)
+    order = jnp.argsort(key_t, stable=True)
+    kt_sorted = key_t[order]
+    pos = jnp.clip(jnp.searchsorted(kt_sorted, key), 0,
+                   max(rows.shape[0] - 1, 0))
+    struct_sym = bool(jnp.all(kt_sorted[pos] == key)) if rows.shape[0] \
+        else True
+    vt = vals[order][pos]
+    num_sym = struct_sym and bool(
+        jnp.all(jnp.abs(vt - vals) <= tol + 1e-12 * jnp.abs(vals)))
+    d = A.diagonal()
+    if A.is_block:
+        d = d[:, 0, 0]
+    absrow = jax.ops.segment_sum(jnp.abs(vals), rows, num_segments=n,
+                                 indices_are_sorted=True)
+    # |a_ii| >= off-diagonal row sum (absrow includes the diagonal only
+    # when it is stored in the CSR part)
+    off = absrow if A.has_external_diag else absrow - jnp.abs(d)
+    dom = int(jnp.sum(jnp.abs(d) >= off))
+    row_nnz = jnp.diff(A.row_offsets)
+    bw = int(jnp.max(jnp.abs(rows.astype(jnp.int64)
+                             - cols.astype(jnp.int64)))) \
+        if rows.shape[0] else 0
+    return MatrixAnalysis(
+        is_structurally_symmetric=struct_sym,
+        is_symmetric=num_sym,
+        diag_dominant_rows=dom,
+        num_rows=n, nnz=A.nnz, bandwidth=bw,
+        min_row_nnz=int(jnp.min(row_nnz)) if n else 0,
+        max_row_nnz=int(jnp.max(row_nnz)) if n else 0,
+        has_zero_diag=bool(jnp.any(d == 0)))

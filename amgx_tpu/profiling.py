@@ -1,0 +1,98 @@
+"""Tracing / profiling subsystem.
+
+TPU-native analog of the reference's nvtx ranges + profiler hooks
+(src/amgx_timer.cu, include/profile.h nvtxRange, AMGX_pin_memory-era
+instrumentation): named trace regions that show up in a captured device
+profile, plus a lightweight wall-clock accumulator for setup/solve
+stage breakdowns (the reference's AMGX_timer tree).
+
+- `trace_region(name)`: context manager annotating device work with
+  `jax.profiler.TraceAnnotation` (visible in TensorBoard/Perfetto
+  traces) and accumulating host wall-clock per name.
+- `start_trace(logdir)` / `stop_trace()`: capture a device profile for
+  the enclosed region (jax.profiler wrapper; the XLA/TPU answer to
+  nsight ranges).
+- `timers()` / `reset_timers()`: the accumulated (calls, seconds) per
+  region, printed by AMGX_print_timers via the output callback.
+
+Regions are cheap no-ops for device latency (annotation only); the
+wall-clock numbers measure host-observed span, which for async
+dispatch means "time until the region's Python body returned", not
+device occupancy — use start_trace for real device timelines.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Tuple
+
+import jax
+
+_lock = threading.Lock()
+_timers: Dict[str, Tuple[int, float]] = {}
+_tracing = False
+
+
+@contextlib.contextmanager
+def trace_region(name: str):
+    """nvtxRange analog: annotate + accumulate wall-clock under `name`
+    (accounted even when the body raises)."""
+    t0 = time.perf_counter()
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        dt = time.perf_counter() - t0
+        with _lock:
+            calls, tot = _timers.get(name, (0, 0.0))
+            _timers[name] = (calls + 1, tot + dt)
+
+
+def annotate(name: str):
+    """Decorator form of trace_region."""
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with trace_region(name):
+                return fn(*a, **k)
+        return wrapper
+    return deco
+
+
+def start_trace(logdir: str):
+    """Begin a device profile capture (jax.profiler.start_trace)."""
+    global _tracing
+    jax.profiler.start_trace(logdir)
+    _tracing = True
+
+
+def stop_trace():
+    global _tracing
+    if _tracing:
+        jax.profiler.stop_trace()
+        _tracing = False
+
+
+def timers() -> Dict[str, Tuple[int, float]]:
+    with _lock:
+        return dict(_timers)
+
+
+def reset_timers():
+    with _lock:
+        _timers.clear()
+
+
+def format_timers() -> str:
+    """AMGX_timer-style report (src/amgx_timer.cu print tree role)."""
+    rows = sorted(timers().items(), key=lambda kv: -kv[1][1])
+    if not rows:
+        return "no trace regions recorded\n"
+    w = max(len(k) for k, _ in rows)
+    out = [f"{'region':<{w}}  calls   total_s     avg_ms"]
+    for name, (calls, tot) in rows:
+        out.append(f"{name:<{w}}  {calls:5d}  {tot:8.3f}  {tot/calls*1e3:9.3f}")
+    return "\n".join(out) + "\n"

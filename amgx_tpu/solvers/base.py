@@ -174,6 +174,11 @@ class Solver:
         return self._setup_impl(A, reuse=True)
 
     def _setup_impl(self, A: CsrMatrix, reuse: bool):
+        from ..profiling import trace_region
+        with trace_region(f"{self.name}.{'resetup' if reuse else 'setup'}"):
+            return self.__setup_impl(A, reuse)
+
+    def __setup_impl(self, A: CsrMatrix, reuse: bool):
         t0 = time.perf_counter()
         if not A.initialized:
             A = A.init()
@@ -314,6 +319,12 @@ class Solver:
     def solve(self, b, x0=None, zero_initial_guess: bool = False
               ) -> SolveResult:
         """Solve A x = b (Solver::solve analog, include/solvers/solver.h)."""
+        from ..profiling import trace_region
+        with trace_region(f"{self.name}.solve"):
+            return self._solve_traced(b, x0, zero_initial_guess)
+
+    def _solve_traced(self, b, x0=None, zero_initial_guess: bool = False
+                      ) -> SolveResult:
         if self.A is None:
             raise BadParametersError(
                 f"solver {self.name}: solve() before setup()")
