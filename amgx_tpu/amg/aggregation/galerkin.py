@@ -20,7 +20,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from ...matrix import CsrMatrix
+from ...matrix import CsrMatrix, lexsort_rc
 
 
 @jax.jit
@@ -29,24 +29,23 @@ def _coarse_entries(A, agg):
     value on each (I, J) pair's first occurrence (zeros on duplicates)
     and the traced unique-entry count."""
     rows, cols, vals = A.coo()
-    r2 = agg[rows].astype(jnp.int64)
-    c2 = agg[cols].astype(jnp.int64)
+    r2 = agg[rows].astype(jnp.int32)
+    c2 = agg[cols].astype(jnp.int32)
     if A.has_external_diag:
         # fold external diagonal contributions in: they land on
         # (agg[i], agg[i])
-        da = agg.astype(jnp.int64)
+        da = agg.astype(jnp.int32)
         r2 = jnp.concatenate([r2, da])
         c2 = jnp.concatenate([c2, da])
         vals = jnp.concatenate([vals, A.diag])
     e = r2.shape[0]
-    key = r2 * (jnp.int64(A.num_rows) + 1) + c2
-    order = jnp.argsort(key, stable=True)
-    key_s = key[order]
-    r_s = r2[order].astype(jnp.int32)
-    c_s = c2[order].astype(jnp.int32)
+    order = lexsort_rc(r2, c2)
+    r_s = r2[order]
+    c_s = c2[order]
     v_s = vals[order]
     first = jnp.concatenate(
-        [jnp.ones((1,), bool), key_s[1:] != key_s[:-1]])
+        [jnp.ones((1,), bool),
+         (r_s[1:] != r_s[:-1]) | (c_s[1:] != c_s[:-1])])
     seg = jnp.cumsum(first) - 1
     vsum = jax.ops.segment_sum(v_s, seg, num_segments=e,
                                indices_are_sorted=True)

@@ -25,7 +25,7 @@ import numpy as np
 
 from ... import registry
 from ...config import Config
-from ...matrix import CsrMatrix
+from ...matrix import CsrMatrix, lexsort_rc
 
 
 def _edge_weights(A: CsrMatrix, formula: int = 0):
@@ -42,18 +42,22 @@ def _edge_weights(A: CsrMatrix, formula: int = 0):
         d = A.diagonal()
     absd = jnp.abs(d)
     n = A.num_rows
-    # |a_ji| via scatter of |a_ij| into the transpose position: build a
-    # dense-free lookup by sorting the transposed key
-    key_t = cols.astype(jnp.int64) * n + rows.astype(jnp.int64)
-    key = rows.astype(jnp.int64) * n + cols.astype(jnp.int64)
-    order = jnp.argsort(key_t, stable=True)
-    # sorted transpose keys == sorted forward keys where symmetric pattern;
-    # look up |a_ji| by searching key in sorted key_t
-    sorted_kt = key_t[order]
-    pos = jnp.searchsorted(sorted_kt, key)
-    pos = jnp.clip(pos, 0, rows.shape[0] - 1)
-    match = sorted_kt[pos] == key
-    v_t = jnp.where(match, jnp.abs(v[order][pos]), 0.0)
+    # canonicalize to (row, col)-lexicographic order first — uploaded
+    # CSR may have unsorted columns within a row, and the positional
+    # alignment below requires the canonical order on both sides
+    canon = lexsort_rc(rows, cols)
+    rows, cols, v = rows[canon], cols[canon], v[canon]
+    # |a_ji| via the positional transpose alignment: sorting the entries
+    # by (col, row) puts the k-th entry's transpose partner at position
+    # k of the canonical order whenever the sparsity pattern is
+    # symmetric (two int32 sorts — no emulated 64-bit keys on TPU).
+    # Where the pattern is one-sided the pairing check fails and that
+    # edge's weight uses the present side only.
+    order = lexsort_rc(cols, rows)       # (col, row)-lexicographic
+    tr = rows[order]
+    tc = cols[order]
+    match = (tr == cols) & (tc == rows)
+    v_t = jnp.where(match, jnp.abs(v[order]), 0.0)
     if formula == 1:
         w = -0.5 * (v / jnp.where(d[rows] == 0, 1.0, d[rows])
                     + v_t / jnp.where(d[cols] == 0, 1.0, d[cols]))
@@ -181,16 +185,16 @@ def _coarse_graph(rows, cols, w, agg, nc, n):
     cr = aggp[jnp.minimum(rows, n)]
     cc = aggp[jnp.minimum(cols, n)]
     valid = (cr != cc) & (w > 0) & (rows < n)
-    INF = jnp.int64(jnp.iinfo(jnp.int64).max)
-    key = jnp.where(valid,
-                    cr.astype(jnp.int64) * (n + 1) + cc.astype(jnp.int64),
-                    INF)
-    order = jnp.argsort(key, stable=True)
-    key_s = key[order]
-    cr_s, cc_s, w_s = cr[order], cc[order], w[order]
-    valid_s = key_s < INF
+    # invalid entries sort last: both coordinates forced to n (int32
+    # two-pass lexsort — no emulated 64-bit keys)
+    cr_k = jnp.where(valid, cr, n).astype(jnp.int32)
+    cc_k = jnp.where(valid, cc, n).astype(jnp.int32)
+    order = lexsort_rc(cr_k, cc_k)
+    cr_s, cc_s, w_s = cr_k[order], cc_k[order], w[order]
+    valid_s = cr_s < n
     first = jnp.concatenate(
-        [jnp.ones((1,), bool), key_s[1:] != key_s[:-1]]) & valid_s
+        [jnp.ones((1,), bool),
+         (cr_s[1:] != cr_s[:-1]) | (cc_s[1:] != cc_s[:-1])]) & valid_s
     seg = jnp.cumsum(first) - 1
     wsum = jax.ops.segment_sum(jnp.where(valid_s, w_s, 0.0), seg,
                                num_segments=e)

@@ -27,6 +27,18 @@ import numpy as np
 
 from .errors import BadParametersError
 
+
+def lexsort_rc(rows, cols):
+    """Stable (rows, cols)-lexicographic order via two int32 argsorts.
+
+    TPU-first replacement for the single int64 `row * ncols + col` key:
+    the TPU has no native 64-bit integers, so an int64 sort compiles to
+    (and executes as) a slow emulated form — two stable 32-bit sorts
+    are strictly cheaper at every problem size."""
+    order1 = jnp.argsort(cols, stable=True)
+    order2 = jnp.argsort(rows[order1], stable=True)
+    return order1[order2]
+
 Array = jax.Array
 
 
@@ -148,8 +160,8 @@ class CsrMatrix:
         """Diagonal (DIA) storage when the sparsity is banded with few
         distinct offsets (stencil matrices). On TPU this is the fast SpMV
         layout: shifted dense multiply-adds, no gather at all."""
-        offs = jnp.unique(self.col_indices.astype(jnp.int64)
-                          - row_ids.astype(jnp.int64))
+        offs = jnp.unique(self.col_indices.astype(jnp.int32)
+                          - row_ids.astype(jnp.int32))
         k = int(offs.shape[0])
         n = self.num_rows
         if k > self.DIA_MAX_OFFSETS or k * n > self.DIA_FILL_RATIO * \
@@ -165,9 +177,9 @@ class CsrMatrix:
         zero re-layout (see ops/pallas_spmv.py). Shared by init and
         with_values."""
         from .ops.pallas_spmv import LANES, dia_padded_rows
-        offs = jnp.asarray(offsets, jnp.int64)
-        d_idx = jnp.searchsorted(offs, self.col_indices.astype(jnp.int64)
-                                 - row_ids.astype(jnp.int64))
+        offs = jnp.asarray(offsets, jnp.int32)
+        d_idx = jnp.searchsorted(offs, self.col_indices.astype(jnp.int32)
+                                 - row_ids.astype(jnp.int32))
         k = len(offsets)
         rows_pad = dia_padded_rows(k, self.num_rows)
         flat = jnp.zeros((k, rows_pad * LANES), self.dtype).at[
@@ -259,11 +271,35 @@ class CsrMatrix:
                                                   self.row_ids))
         return out
 
-    def interior_exterior_split(self, num_interior: int):
-        """Placeholder for the distributed INTERIOR/OWNED view split
-        (include/matrix.h:82-88); real splitting lives in
-        distributed/dist_matrix.py."""
-        return num_interior
+    def interior_exterior_split(self, num_owned_cols: int):
+        """INTERIOR/BOUNDARY view split (include/matrix.h:82-88 views):
+        returns (A_interior, A_boundary) where A_interior keeps the
+        entries whose column is owned (< num_owned_cols) and A_boundary
+        the rest — y = A x == A_int x + A_bnd x. Both views share this
+        matrix's shape; the split is by entry, matching the
+        latency-hiding decomposition the distributed SpMV uses
+        (multiply.cu:95-110, distributed/dist_matrix.py)."""
+        if self.is_block:
+            raise BadParametersError(
+                "interior_exterior_split: scalar matrices only")
+        src = self if self.initialized else self.init(ell="never")
+        rows, cols, vals = src.coo()
+        interior = cols < num_owned_cols
+        vi = jnp.where(interior, vals, 0.0)
+        vb = jnp.where(interior, 0.0, vals)
+        base = dict(row_offsets=src.row_offsets,
+                    col_indices=src.col_indices,
+                    row_ids=rows, num_rows=src.num_rows,
+                    num_cols=src.num_cols, initialized=True)
+        A_int = CsrMatrix(values=vi, diag=src.diag, diag_idx=src.diag_idx,
+                          ell_cols=None, ell_vals=None, dia_offsets=None,
+                          dia_vals=None, **base)
+        A_bnd = CsrMatrix(values=vb, diag=None,
+                          diag_idx=jnp.full((src.num_rows,), -1,
+                                            jnp.int32),
+                          ell_cols=None, ell_vals=None, dia_offsets=None,
+                          dia_vals=None, **base)
+        return A_int, A_bnd
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -277,12 +313,12 @@ class CsrMatrix:
         cols = jnp.asarray(cols, jnp.int32)
         vals = jnp.asarray(vals)
         bx, by = block_dims
-        key = rows.astype(jnp.int64) * num_cols + cols.astype(jnp.int64)
-        order = jnp.argsort(key, stable=True)
-        rows, cols, vals, key = rows[order], cols[order], vals[order], key[order]
+        order = lexsort_rc(rows, cols)
+        rows, cols, vals = rows[order], cols[order], vals[order]
         if coalesce and rows.shape[0] > 0:
             newseg = jnp.concatenate(
-                [jnp.ones((1,), bool), key[1:] != key[:-1]])
+                [jnp.ones((1,), bool),
+                 (rows[1:] != rows[:-1]) | (cols[1:] != cols[:-1])])
             seg = jnp.cumsum(newseg) - 1
             nuniq = int(seg[-1]) + 1
             first = jnp.nonzero(newseg, size=nuniq)[0]

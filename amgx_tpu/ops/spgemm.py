@@ -21,7 +21,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..matrix import CsrMatrix
+from ..matrix import CsrMatrix, lexsort_rc
 
 
 def _fold_diag(A: CsrMatrix) -> CsrMatrix:
@@ -65,15 +65,16 @@ def csr_multiply(A: CsrMatrix, B: CsrMatrix) -> CsrMatrix:
         prods = jnp.einsum("nxk,nky->nxy", A.values[src_a], B.values[src_b])
     else:
         prods = A.values[src_a] * B.values[src_b]
-    key = out_rows.astype(jnp.int64) * B.num_cols + out_cols.astype(jnp.int64)
-    order = jnp.argsort(key, stable=True)
-    key, out_rows, out_cols, prods = (key[order], out_rows[order],
-                                      out_cols[order], prods[order])
-    if key.shape[0] == 0:
+    order = lexsort_rc(out_rows, out_cols)
+    out_rows, out_cols, prods = (out_rows[order], out_cols[order],
+                                 prods[order])
+    if out_rows.shape[0] == 0:
         return CsrMatrix.from_scipy_like(
             jnp.zeros(A.num_rows + 1, jnp.int32), out_cols, prods,
             A.num_rows, B.num_cols, (A.block_dimx, B.block_dimy))
-    newseg = jnp.concatenate([jnp.ones((1,), bool), key[1:] != key[:-1]])
+    newseg = jnp.concatenate(
+        [jnp.ones((1,), bool),
+         (out_rows[1:] != out_rows[:-1]) | (out_cols[1:] != out_cols[:-1])])
     seg = jnp.cumsum(newseg) - 1
     nuniq = int(seg[-1]) + 1
     first = jnp.nonzero(newseg, size=nuniq)[0]
