@@ -50,6 +50,32 @@ def _parse_header(lines):
     return fmt, field, symmetry, amgx_tokens, body_start
 
 
+def _parse_body(body_lines, expected_total: int) -> np.ndarray:
+    """Parse the numeric body: native C parser (one pass, memory speed)
+    with the pure-numpy tokenizer as fallback."""
+    from ..native import lib
+    native = lib()
+    if native is not None and hasattr(native, "amgx_mm_parse"):
+        import ctypes
+        text = "".join(body_lines).encode()
+        out = np.empty(expected_total, np.float64)
+        native.amgx_mm_parse.restype = ctypes.c_longlong
+        got = native.amgx_mm_parse(
+            ctypes.c_char_p(text), ctypes.c_longlong(len(text)),
+            ctypes.c_longlong(expected_total),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        if got >= 0:
+            return out[:got]
+        # malformed for the fast path -> let the fallback report it
+    body_vals = []
+    for ln in body_lines:
+        s = ln.split()
+        if not s or s[0].startswith("%"):
+            continue
+        body_vals.extend(s)
+    return np.array(body_vals, dtype=np.float64)
+
+
 def read_system(path: str, dtype=np.float64
                 ) -> Tuple[CsrMatrix, Optional[jnp.ndarray],
                            Optional[jnp.ndarray]]:
@@ -87,16 +113,16 @@ def read_system(path: str, dtype=np.float64
         raise IOError_("matrix dimensions do not match block sizes")
     n, m = rows_s // bx, cols_s // by
 
-    # bulk-parse the numeric body with numpy
     per_entry = 2 + (0 if is_pattern else (2 if is_complex else 1))
-    body_vals = []
-    for ln in lines[body + 1:]:
-        s = ln.split()
-        if not s or s[0].startswith("%"):
-            continue
-        body_vals.extend(s)
-    data = np.array(body_vals, dtype=np.float64)
     need = entries_s * per_entry
+    # everything the sections can hold, for the one-pass native parse
+    # (diag is stored as reals — matching its consumption below)
+    cmul = 2 if is_complex else 1
+    expected_total = need \
+        + (n * bx * by if has_diag else 0) \
+        + (n * bx * cmul if has_rhs else 0) \
+        + (m * by * cmul if has_soln else 0)
+    data = _parse_body(lines[body + 1:], expected_total)
     if data.size < need:
         raise IOError_(f"matrix body truncated: {data.size} < {need} numbers")
     ent = data[:need].reshape(entries_s, per_entry)
