@@ -4,8 +4,18 @@ Analog of src/aggregation/aggregation_amg_level.cu (2654 LoC): the
 selector builds an `aggregates` map, restriction/prolongation are
 segment-sum / gather with that map (no explicit CSR transfer operators),
 and the coarse matrix is the COO-relabel Galerkin product.
+
+GEO (structured pairing) levels additionally know the grid geometry:
+restriction/prolongation become axis reshape-sums / broadcasts — pure
+dense data movement with no gather/scatter at all (the TPU-optimal
+shape) — and the coarse matrix inherits the coarse grid annotation so
+the whole hierarchy stays banded/DIA.
 """
 from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
 
 from ... import registry
 from ...config import Config
@@ -16,25 +26,87 @@ from .galerkin import (coarse_a_from_aggregates, prolongate_corr,
                        restrict_vector)
 
 
+def _geo_restrict(r, fine_shape, axis):
+    """Pair-sum along one grid axis: the piecewise-constant restriction
+    of a structured pairing, as a reshape + sum (no scatter)."""
+    nx, ny, nz = fine_shape
+    v = r.reshape(nz, ny, nx)                  # linear index: x fastest
+    dims = 2 - axis                            # array axis being paired
+    e = v.shape[dims]
+    if e % 2 == 0:
+        body, tail = v, None
+    else:
+        sl = [slice(None)] * 3
+        sl[dims] = slice(0, e - 1)
+        body = v[tuple(sl)]
+        sl[dims] = slice(e - 1, e)
+        tail = v[tuple(sl)]
+    shp = list(body.shape)
+    shp[dims] //= 2
+    shp.insert(dims + 1, 2)
+    out = body.reshape(shp).sum(axis=dims + 1)
+    if tail is not None:
+        out = jnp.concatenate([out, tail], axis=dims)
+    return out.reshape(-1)
+
+
+def _geo_prolongate(xc, fine_shape, coarse_shape, axis):
+    """Broadcast along the paired grid axis (P = pairwise-constant)."""
+    nx, ny, nz = coarse_shape
+    v = xc.reshape(nz, ny, nx)
+    dims = 2 - axis
+    out = jnp.repeat(v, 2, axis=dims)
+    fine_e = fine_shape[axis]
+    if out.shape[dims] != fine_e:               # odd fine extent: trim
+        sl = [slice(None)] * 3
+        sl[dims] = slice(0, fine_e)
+        out = out[tuple(sl)]
+    return out.reshape(-1)
+
+
 @registry.amg_levels.register("AGGREGATION")
 class AggregationAMGLevel(AMGLevel):
     algorithm = "AGGREGATION"
+
+    geo_axes = None          # set when the selector pairs geometrically
+    geo_fine_shape = None
+    geo_coarse_shape = None
 
     def create_coarse_vertices(self):
         sel_name = str(self.cfg.get("selector", self.scope))
         sel = registry.aggregation_selectors.create(
             sel_name, self.cfg, self.scope)
         self.aggregates, self.coarse_size = sel.set_aggregates(self.A)
+        if getattr(sel, "pair_axes", None) is not None and \
+                not self.A.is_block:
+            self.geo_axes = sel.pair_axes
+            self.geo_fine_shape = sel.fine_shape
+            self.geo_coarse_shape = sel.coarse_shape
+
+    def _geo_shapes(self):
+        """Intermediate grid shapes for the per-axis transfer sequence."""
+        shapes = [self.geo_fine_shape]
+        for a in self.geo_axes:
+            s = list(shapes[-1])
+            s[a] = (s[a] + 1) // 2
+            shapes.append(tuple(s))
+        return shapes
 
     def create_coarse_matrix(self) -> CsrMatrix:
-        return coarse_a_from_aggregates(self.A, self.aggregates,
-                                        self.coarse_size)
+        Ac = coarse_a_from_aggregates(self.A, self.aggregates,
+                                      self.coarse_size)
+        if self.geo_coarse_shape is not None:
+            Ac = dataclasses.replace(Ac, grid_shape=self.geo_coarse_shape)
+        return Ac
 
     def reuse_structure(self, old):
         """structure_reuse_levels: keep the aggregates map; the Galerkin
         relabel-sum then runs against the new coefficients."""
         self.aggregates = old.aggregates
         self.coarse_size = old.coarse_size
+        self.geo_axes = old.geo_axes
+        self.geo_fine_shape = old.geo_fine_shape
+        self.geo_coarse_shape = old.geo_coarse_shape
 
     def level_data(self):
         d = super().level_data()
@@ -45,6 +117,11 @@ class AggregationAMGLevel(AMGLevel):
         if "R" in data:       # distributed: explicit sharded R = P^T
             from ...ops.spmv import spmv
             return spmv(data["R"], r)
+        if self.geo_axes is not None:
+            shapes = self._geo_shapes()
+            for k, a in enumerate(self.geo_axes):
+                r = _geo_restrict(r, shapes[k], a)
+            return r
         return restrict_vector(data["aggregates"], self.coarse_size, r,
                                self.A.block_dimx)
 
@@ -52,4 +129,10 @@ class AggregationAMGLevel(AMGLevel):
         if "P" in data:       # distributed: explicit sharded P
             from ...ops.spmv import spmv
             return spmv(data["P"], xc)
+        if self.geo_axes is not None:
+            shapes = self._geo_shapes()
+            for k in range(len(self.geo_axes) - 1, -1, -1):
+                xc = _geo_prolongate(xc, shapes[k], shapes[k + 1],
+                                     self.geo_axes[k])
+            return xc
         return prolongate_corr(data["aggregates"], xc, self.A.block_dimx)

@@ -296,10 +296,61 @@ class DummySelector(AggregationSelector):
         return agg, nc
 
 
-@registry.aggregation_selectors.register("GEO")
 @registry.aggregation_selectors.register("PARALLEL_GREEDY")
 class ParallelGreedySelector(_SizeNSelector):
     """Greedy matching selector (parallel_greedy_selector.cu analog);
     shares the handshaking fixed-point with SIZE_2."""
 
     passes = 1
+
+
+@registry.aggregation_selectors.register("GEO")
+class GeoSelector(AggregationSelector):
+    """Geometric aggregation (geo_selector.cu analog — the reference
+    selector that aggregates by spatial position instead of matrix
+    weights). TPU redesign: on a structured grid (CsrMatrix.grid_shape,
+    set by the gallery / C-API Poisson generators) each aggregate is the
+    2x2x2 block of grid points (every axis with extent >= 2 halved):
+
+      agg(x, y, z) = linear coarse index of (x//2, y//2, z//2).
+
+    The Galerkin product of a separable stencil operator under this
+    blocking is again a stencil operator with the same diagonal
+    structure, so every level of the hierarchy keeps the DIA roofline
+    SpMV layout (no gathers or scatters anywhere in the cycle), and
+    restriction/prolongation collapse to per-axis reshape-sums /
+    broadcasts (amg/aggregation/__init__.py).
+    """
+
+    def set_aggregates(self, A: CsrMatrix):
+        shape = A.grid_shape
+        n = A.num_rows
+        if shape is None or int(np.prod(shape)) != n:
+            from ...errors import BadParametersError
+            raise BadParametersError(
+                "GEO selector requires a structured-grid matrix "
+                "(CsrMatrix.grid_shape); use SIZE_2/PARALLEL_GREEDY for "
+                "unstructured matrices")
+        nx, ny, nz = shape
+        axes = tuple(a for a, e in enumerate((nx, ny, nz)) if e >= 2)
+        if not axes:
+            self.fine_shape = shape
+            self.pair_axes = None
+            self.coarse_shape = shape
+            return jnp.arange(n, dtype=jnp.int32), n
+        cnx = (nx + 1) // 2 if 0 in axes else nx
+        cny = (ny + 1) // 2 if 1 in axes else ny
+        cnz = (nz + 1) // 2 if 2 in axes else nz
+        i = jnp.arange(n, dtype=jnp.int32)
+        x = i % nx
+        t = i // nx
+        y = t % ny
+        z = t // ny
+        cx = x // 2 if 0 in axes else x
+        cy = y // 2 if 1 in axes else y
+        cz = z // 2 if 2 in axes else z
+        agg = (cz * cny + cy) * cnx + cx
+        self.fine_shape = shape
+        self.pair_axes = axes
+        self.coarse_shape = (cnx, cny, cnz)
+        return agg.astype(jnp.int32), int(cnx * cny * cnz)

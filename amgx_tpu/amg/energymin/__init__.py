@@ -10,7 +10,7 @@ init_Pvalues kernels).
 
 TPU redesign of the same scheme: every coarse point's patch is padded to
 one static size and the whole set is solved as ONE batched dense
-`jnp.linalg.solve` — (nc, k, k) patches ride the MXU, replacing the
+QR solve (ops/dense.py) — (nc, k, k) patches ride the MXU, replacing the
 reference's per-column warp kernels. Column j's values are the local
 harmonic extension (energy minimizer with unit value at the coarse
 point):
@@ -111,11 +111,12 @@ class EMInterpolator(EnergyminInterpolator):
         rhs = np.where(mask, rhs, 0.0)
 
         # one batched dense solve on the MXU (the em.cu patch inverses)
-        pF = -jnp.linalg.solve(jnp.asarray(A_FF),
-                               jnp.asarray(rhs)[..., None])[..., 0]
+        from ...ops.dense import solve_qr
+        pF = -solve_qr(jnp.asarray(A_FF), jnp.asarray(rhs))
         pF = np.asarray(pF)
         # singular patches (zero diagonals, saddle blocks) come out
-        # non-finite from the LU: drop those columns' fine entries so
+        # non-finite from the factorization: drop those columns' fine
+        # entries so
         # the coarse point degrades to injection instead of poisoning
         # P and the Galerkin product with NaNs
         pF = np.where(np.isfinite(pF), pF, 0.0)

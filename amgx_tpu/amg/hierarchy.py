@@ -89,6 +89,7 @@ class AMG:
         self.dense_lu_num_rows = int(cfg.get("dense_lu_num_rows", scope))
         self.cycle_name = str(cfg.get("cycle", scope)).upper()
         self.cycle_iters = int(cfg.get("cycle_iters", scope))
+        self.precision = str(cfg.get("amg_precision", scope))
         self.print_grid_stats = bool(cfg.get("print_grid_stats", scope))
         self.intensive_smoothing = bool(cfg.get("intensive_smoothing", scope))
         self.levels: List[AMGLevel] = []
@@ -127,7 +128,7 @@ class AMG:
             level.reuse_structure(old)
             Ac = level.create_coarse_matrix()
             self.levels.append(level)
-            Af = Ac if Ac.initialized else Ac.init()
+            Af = Ac.build_spmv_layout() if Ac.initialized else Ac.init()
             lvl += 1
         self._build_levels(Af, lvl)
         self._finalize_setup(t0)
@@ -152,7 +153,7 @@ class AMG:
                 break
             Ac = level.create_coarse_matrix()
             self.levels.append(level)
-            Af = Ac if Ac.initialized else Ac.init()
+            Af = Ac.build_spmv_layout() if Ac.initialized else Ac.init()
             lvl += 1
         self.coarsest_A = Af
 
@@ -192,11 +193,30 @@ class AMG:
             amgx_printf(self.grid_stats())
 
     # -- solve-phase data -------------------------------------------------
+    _PRECISIONS = {"double": None, "float": "float32", "bfloat16": "bfloat16"}
+
     def solve_data(self) -> Dict[str, Any]:
-        return {
+        data = {
             "levels": [lv.level_data() for lv in self.levels],
             "coarse": self.coarse_solver.solve_data(),
         }
+        dt = self._PRECISIONS[self.precision]
+        if dt is not None:
+            # mixed-precision preconditioning (the dDFI-mode analog,
+            # include/amgx_config.h:102-131): the whole stored hierarchy
+            # and cycle run in reduced precision inside an f64 flexible
+            # Krylov outer loop — on TPU this halves (or quarters) HBM
+            # traffic and turns on the f32 Pallas SpMV kernels
+            import jax
+            import jax.numpy as jnp
+
+            def cast(leaf):
+                if hasattr(leaf, "dtype") and \
+                        jnp.issubdtype(leaf.dtype, jnp.inexact):
+                    return leaf.astype(dt)
+                return leaf
+            data = jax.tree.map(cast, data)
+        return data
 
     def _sweeps(self, level_index: int, pre: bool) -> int:
         s = self.presweeps if pre else self.postsweeps
@@ -207,9 +227,17 @@ class AMG:
         return s
 
     def cycle(self, data, b, x):
-        """One multigrid cycle (CycleFactory::generate analog)."""
+        """One multigrid cycle (CycleFactory::generate analog). With
+        amg_precision=float/bfloat16 the cycle computes in the reduced
+        precision and the correction is returned in the caller's dtype."""
         from .cycles import run_cycle
-        return run_cycle(self, self.cycle_name, data, b, x)
+        dt = self._PRECISIONS[self.precision]
+        if dt is None:
+            return run_cycle(self, self.cycle_name, data, b, x)
+        out_dtype = x.dtype
+        x = run_cycle(self, self.cycle_name, data,
+                      b.astype(dt), x.astype(dt))
+        return x.astype(out_dtype)
 
     # -- observability ----------------------------------------------------
     def grid_stats(self) -> str:

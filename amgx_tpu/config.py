@@ -168,6 +168,10 @@ def _register_default_parameters():
     R("coarsest_sweeps", int, "smoothing iterations at coarsest level", 2)
     R("cycle_iters", int, "CG-cycle inner iterations", 2)
     R("structure_reuse_levels", int, "hierarchy reuse depth on resetup", 0)
+    R("amg_precision", str, "precision of the stored hierarchy + cycle "
+      "(TPU-native mixed-precision preconditioning, the dDFI-mode analog: "
+      "a float32/bfloat16 cycle inside an f64 flexible Krylov solver)",
+      "double", ("double", "float", "bfloat16"))
     R("error_scaling", int, "coarse-correction scaling mode", 0, (0, 2, 3))
     R("reuse_scale", int, "reuse correction scale for next N iters", 0)
     R("scaling_smoother_steps", int, "smoother steps before computing scale", 2)

@@ -118,14 +118,14 @@ class DistributedSolver:
         def shard_fn(data, b, x0):
             local = jax.tree.map(lambda a: a[0], data)
             with comms.collective_axis(axis):
-                x, iters, conv, rn, n0, hist = raw(local, b[0], x0[0])
-            return x[None], iters, conv, rn, n0, hist
+                x, stats = raw(local, b[0], x0[0])
+            return x[None], stats
 
         pspec = jax.tree.map(lambda _: P(axis), self._data)
         mapped = shard_map(
             shard_fn, mesh=self.mesh,
             in_specs=(pspec, P(axis), P(axis)),
-            out_specs=(P(axis), P(), P(), P(), P(), P()),
+            out_specs=(P(axis), P()),
             check_vma=False)
         return jax.jit(mapped)
 
@@ -138,13 +138,13 @@ class DistributedSolver:
         if self._fn is None:
             self._fn = self._build_fn()
         t0 = time.perf_counter()
-        x, iters, conv, rn, n0, hist = self._fn(self._data, bl, xl)
-        x.block_until_ready()
+        x, stats = jax.block_until_ready(self._fn(self._data, bl, xl))
         solve_time = time.perf_counter() - t0
-        iters_i = int(iters)
+        iters_i, conv, n0, rn, hist = self.solver.unpack_stats(
+            stats, self.solver.max_iters + 1)
         return SolveResult(
             x=unpartition_vector(x, n), iterations=iters_i,
-            converged=bool(conv), res_norm=np.asarray(rn),
+            converged=conv, res_norm=np.asarray(rn),
             norm0=np.asarray(n0),
             res_history=np.asarray(hist)[: iters_i + 1]
             if self.solver.store_res_history else None,

@@ -58,8 +58,12 @@ def poisson(points: str, nx: int, ny: int = 1, nz: int = 1,
     counts = np.bincount(rows, minlength=n)
     row_offsets = np.zeros(n + 1, np.int32)
     np.cumsum(counts, out=row_offsets[1:])
-    return CsrMatrix.from_scipy_like(row_offsets, cols.astype(np.int32),
-                                     jnp.asarray(vals), n, n)
+    A = CsrMatrix.from_scipy_like(row_offsets, cols.astype(np.int32),
+                                  jnp.asarray(vals), n, n)
+    # structured-grid annotation: lets the GEO aggregation selector keep
+    # every coarse level banded (DIA) instead of falling to gather paths
+    import dataclasses
+    return dataclasses.replace(A, grid_shape=(nx, ny, nz))
 
 
 def poisson5pt(nx, ny, dtype=np.float64):

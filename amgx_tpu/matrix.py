@@ -52,7 +52,7 @@ def _seg_sum(data, seg_ids, num_segments):
     data_fields=["row_offsets", "col_indices", "values", "diag",
                  "row_ids", "diag_idx", "ell_cols", "ell_vals", "dia_vals"],
     meta_fields=["num_rows", "num_cols", "block_dimx", "block_dimy",
-                 "initialized", "dia_offsets"],
+                 "initialized", "dia_offsets", "grid_shape"],
 )
 @dataclasses.dataclass(frozen=True)
 class CsrMatrix:
@@ -77,6 +77,10 @@ class CsrMatrix:
     block_dimx: int = 1
     block_dimy: int = 1
     initialized: bool = False
+    # structured-grid annotation (nx, ny, nz), x fastest — set by the
+    # gallery generators and propagated by the GEO aggregation path so
+    # every coarse level keeps the banded/DIA roofline layout
+    grid_shape: Optional[tuple] = None
 
     # ------------------------------------------------------------------
     @property
@@ -135,6 +139,18 @@ class CsrMatrix:
                                        indices_are_sorted=True)
             diag_idx = jnp.where(dmin >= self.nnz, -1, dmin).astype(
                 jnp.int32)
+        ell_cols, ell_vals, dia_offsets, dia_vals = self._choose_layout(
+            row_ids, row_nnz, ell, ell_max_ratio)
+        return dataclasses.replace(
+            self, row_ids=row_ids, diag_idx=diag_idx,
+            ell_cols=ell_cols, ell_vals=ell_vals,
+            dia_offsets=dia_offsets, dia_vals=dia_vals, initialized=True)
+
+    def _choose_layout(self, row_ids, row_nnz, ell: str,
+                       ell_max_ratio: float):
+        """DIA-if-banded else ELL-if-tight layout choice (shared by init
+        and build_spmv_layout)."""
+        n = self.num_rows
         ell_cols = ell_vals = None
         dia_offsets = dia_vals = None
         if n > 0 and self.nnz > 0 and not self.is_block \
@@ -147,10 +163,24 @@ class CsrMatrix:
                 ell == "auto" and max_k > 0 and max_k / mean <= ell_max_ratio)
             if want_ell and max_k > 0:
                 ell_cols, ell_vals = self._build_ell(row_ids, row_nnz, max_k)
+        return ell_cols, ell_vals, dia_offsets, dia_vals
+
+    def build_spmv_layout(self, ell: str = "auto",
+                          ell_max_ratio: float = 3.0) -> "CsrMatrix":
+        """Add a DIA/ELL fast-path layout to an already-initialized
+        matrix (the AMG setup produces initialized exact-size CSR coarse
+        operators; without this they would SpMV through the scatter-based
+        segment-sum path, which is the slow shape on TPU)."""
+        if not self.initialized:
+            return self.init(ell=ell, ell_max_ratio=ell_max_ratio)
+        if self.dia_vals is not None or self.ell_cols is not None:
+            return self
+        row_nnz = jnp.diff(self.row_offsets)
+        ell_cols, ell_vals, dia_offsets, dia_vals = self._choose_layout(
+            self.row_ids, row_nnz, ell, ell_max_ratio)
         return dataclasses.replace(
-            self, row_ids=row_ids, diag_idx=diag_idx,
-            ell_cols=ell_cols, ell_vals=ell_vals,
-            dia_offsets=dia_offsets, dia_vals=dia_vals, initialized=True)
+            self, ell_cols=ell_cols, ell_vals=ell_vals,
+            dia_offsets=dia_offsets, dia_vals=dia_vals)
 
     # ------------------------------------------------------------------
     DIA_MAX_OFFSETS = 32
@@ -349,6 +379,19 @@ class CsrMatrix:
             else jnp.asarray(diag),
             num_rows=int(num_rows), num_cols=int(num_cols),
             block_dimx=block_dims[0], block_dimy=block_dims[1])
+
+    def astype(self, dtype) -> "CsrMatrix":
+        """Cast all floating-point payloads (values/diag + any built
+        ELL/DIA layouts) to `dtype`, keeping structure arrays intact.
+        Used by the mixed-precision execution paths (amg_precision,
+        REFINEMENT) to derive the reduced-precision operator."""
+        def cast(a):
+            if a is not None and jnp.issubdtype(a.dtype, jnp.inexact):
+                return a.astype(dtype)
+            return a
+        return dataclasses.replace(
+            self, values=cast(self.values), diag=cast(self.diag),
+            ell_vals=cast(self.ell_vals), dia_vals=cast(self.dia_vals))
 
     def coo(self):
         """Return (row_ids, col_indices, values) COO triplets. Computes

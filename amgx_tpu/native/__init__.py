@@ -59,7 +59,14 @@ def _build(target: str) -> bool:
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, target)          # atomic publish
-    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+    except (subprocess.SubprocessError, FileNotFoundError, OSError) as e:
+        detail = ""
+        stderr = getattr(e, "stderr", None)
+        if stderr:
+            detail = ": " + stderr.decode("utf-8", "replace")[-300:]
+        warnings.warn(
+            "native library build failed; native fast paths disabled, "
+            "pure-Python fallbacks in use" + detail, RuntimeWarning)
         try:
             os.unlink(tmp)
         except OSError:

@@ -12,6 +12,9 @@
 #include <cctype>
 #include <cstdlib>
 #include <locale.h>
+#if !defined(__GLIBC__) && (defined(__APPLE__) || defined(__FreeBSD__))
+#include <xlocale.h>   // strtod_l lives here on macOS/BSD
+#endif
 
 extern "C" long long amgx_mm_parse(const char *buf, long long len,
                                    long long max_count, double *out) {

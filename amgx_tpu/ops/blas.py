@@ -64,6 +64,16 @@ def dot(x, y, axis_name: Optional[str] = None, num_owned: Optional[int] = None):
     return _psum(jnp.vdot(x, y), axis_name)
 
 
+def mdot(V, w, axis_name: Optional[str] = None,
+         num_owned: Optional[int] = None):
+    """Row-wise dots <V[j], w> as ONE (m, n) @ (n,) matvec (the
+    MXU-friendly shape for Gram-Schmidt panels); distributed-safe via
+    psum like `dot`."""
+    if num_owned is not None:
+        V, w = V[:, :num_owned], w[:num_owned]
+    return _psum(V @ w, axis_name)
+
+
 def nrm1(x, axis_name: Optional[str] = None, num_owned: Optional[int] = None):
     if num_owned is not None:
         x = x[:num_owned]

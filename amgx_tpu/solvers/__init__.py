@@ -9,5 +9,6 @@ from . import multicolor  # noqa: F401
 from . import idr  # noqa: F401
 from . import polynomial  # noqa: F401
 from . import kaczmarz  # noqa: F401
+from . import refinement  # noqa: F401
 
 from .base import Solver, SolveResult, make_solver  # noqa: F401

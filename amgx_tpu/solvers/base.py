@@ -197,11 +197,16 @@ class Solver:
         # preconditioned operator (e.g. Chebyshev eigen-estimation) need it
         if self.preconditioner is not None:
             (self.preconditioner.resetup if reuse
-             else self.preconditioner.setup)(A)
+             else self.preconditioner.setup)(self.precond_operator(A))
         (self.solver_resetup if reuse else self.solver_setup)()
         self._jit_cache.clear()
         self.setup_time = time.perf_counter() - t0
         return self
+
+    def precond_operator(self, A: CsrMatrix) -> CsrMatrix:
+        """The operator the preconditioner tree is set up against
+        (REFINEMENT overrides this with the reduced-precision cast)."""
+        return A
 
     def solver_setup(self):
         pass
@@ -311,10 +316,39 @@ class Solver:
 
             final = jax.lax.while_loop(cond, body, state)
             x_final = self.finalize(data, b, final)
-            return (x_final, final["iters"], final["converged"],
-                    final["res_norm"], norm0, final["res_hist"])
+            # pack every scalar/stat output into ONE auxiliary array:
+            # remote/tunneled TPU rigs pay a full round trip PER awaited
+            # output buffer, so (x, stats) costs two concurrent awaits
+            # where six separate outputs cost six serialized ones
+            # at least f32 so iteration counts survive the cast exactly
+            # even for bf16/f16 solves
+            rdt = jnp.promote_types(jnp.asarray(norm0).dtype, jnp.float32)
+            stats = jnp.concatenate([
+                jnp.reshape(final["iters"].astype(rdt), (1,)),
+                jnp.reshape(final["converged"].astype(rdt), (1,)),
+                jnp.ravel(jnp.asarray(norm0)),
+                jnp.ravel(jnp.asarray(final["res_norm"])),
+                jnp.ravel(jnp.asarray(final["res_hist"]))])
+            return x_final, stats
 
         return solve_fn
+
+    @staticmethod
+    def unpack_stats(stats, hist_len: int):
+        """Invert the stats packing of _build_solve_fn: returns
+        (iters, converged, norm0, res_norm, res_hist) as numpy values.
+        The norm width (1, or block_size for per-component block norms)
+        is recovered from the packed length."""
+        stats = np.asarray(stats)
+        nb = (stats.size - 2) // (2 + hist_len)
+        iters = int(stats[0])
+        converged = bool(stats[1])
+        norm0 = stats[2:2 + nb]
+        res_norm = stats[2 + nb:2 + 2 * nb]
+        hist = stats[2 + 2 * nb:].reshape(hist_len, nb)
+        if nb == 1:
+            norm0, res_norm, hist = norm0[0], res_norm[0], hist[:, 0]
+        return iters, converged, norm0, res_norm, hist
 
     def solve(self, b, x0=None, zero_initial_guess: bool = False
               ) -> SolveResult:
@@ -342,15 +376,15 @@ class Solver:
         if key not in self._jit_cache:
             self._jit_cache[key] = jax.jit(self._build_solve_fn())
         t0 = time.perf_counter()
-        x, iters, converged, res_norm, norm0, hist = self._jit_cache[key](
-            self.solve_data(), b, x0)
-        x.block_until_ready()
+        x, stats = jax.block_until_ready(self._jit_cache[key](
+            self.solve_data(), b, x0))
         if self.scaler is not None:
             x = self.scaler.from_scaled_x(x)
         solve_time = time.perf_counter() - t0
-        iters_i = int(iters)
+        iters_i, converged, norm0, res_norm, hist = self.unpack_stats(
+            stats, self.max_iters + 1)
         res = SolveResult(
-            x=x, iterations=iters_i, converged=bool(converged),
+            x=x, iterations=iters_i, converged=converged,
             res_norm=np.asarray(res_norm), norm0=np.asarray(norm0),
             res_history=np.asarray(hist)[:iters_i + 1]
             if self.store_res_history else None,

@@ -1,16 +1,17 @@
 """Dense direct solver (coarse-grid solver).
 
 Analog of src/solvers/dense_lu_solver.cu (cuSolverDn getrf/getrs,
-:514-580): densify the (small) matrix once at setup, LU-factor it, and
-back-substitute per application. On TPU the batched triangular solves run
-on the MXU; the coarsest AMG level is replicated across the mesh, so the
-factorization is the `exact_coarse_solve` analog (the distributed layer
-all-gathers the coarse matrix before calling this, mirroring
-dense_lu_solver.cu:783-930).
+:514-580): densify the (small) matrix once at setup, factor it, and
+back-substitute per application. XLA:TPU does not implement f64 LU
+(see ops/dense.py), so the factorization is Householder QR — same
+O(n^3) setup / O(n^2) apply split as getrf/getrs, and the triangular
+solve runs on the MXU. The coarsest AMG level is replicated across the
+mesh, so this factorization is the `exact_coarse_solve` analog (the
+distributed layer all-gathers the coarse rhs before calling this,
+mirroring dense_lu_solver.cu:783-930).
 """
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 import jax.scipy.linalg as jsl
 
@@ -33,16 +34,21 @@ class DenseLUSolver(Solver):
         dense = jnp.where(
             jnp.diag(zero_rows), jnp.eye(dense.shape[0], dtype=dense.dtype),
             dense)
-        self._lu, self._piv = jsl.lu_factor(dense)
+        self._qt, self._r = self._factor(dense)
+
+    @staticmethod
+    def _factor(dense):
+        q, r = jnp.linalg.qr(dense)
+        return q.T, r
 
     def solve_data(self):
         d = super().solve_data()
-        d["lu"] = self._lu
-        d["piv"] = self._piv
+        d["qt"] = self._qt
+        d["r"] = self._r
         return d
 
     def _direct(self, data, rhs):
-        return jsl.lu_solve((data["lu"], data["piv"]), rhs)
+        return jsl.solve_triangular(data["r"], data["qt"] @ rhs, lower=False)
 
     def solve_iteration(self, data, b, st):
         x = self._direct(data, b)

@@ -128,15 +128,19 @@ class _GmresBase(Solver):
             Z = jax.lax.dynamic_update_index_in_dim(st["Z"], z, i, 0)
         w = spmv(A, z)
 
-        # modified Gram-Schmidt against all rows (zero rows are no-ops)
-        h = jnp.zeros((m + 1,), w.dtype)
-
-        def mgs_body(j, carry):
-            w, h = carry
-            hj = blas.dot(V[j], w)
-            return w - hj * V[j], h.at[j].set(hj)
-
-        w, h = jax.lax.fori_loop(0, m, mgs_body, (w, h))
+        # classical Gram-Schmidt with reorthogonalization (CGS2) against
+        # all rows (zero rows are no-ops): each pass is ONE (m+1, n)
+        # matvec pair on the MXU instead of m serialized dot/axpy round
+        # trips — the TPU-native reformulation of the reference's MGS
+        # loop (fgmres_solver.cu), with CGS2 restoring MGS-level
+        # orthogonality. The row-dot matvec finishes with a psum when
+        # running inside shard_map (the MPI_Allreduce analog), exactly
+        # like blas.dot.
+        h = blas.mdot(V, w)
+        w = w - V.T @ h
+        h2 = blas.mdot(V, w)
+        w = w - V.T @ h2
+        h = h + h2
         h_last = blas.nrm2(w)
         h = h.at[i + 1].set(h_last)
         V = jax.lax.dynamic_update_index_in_dim(

@@ -46,6 +46,7 @@ from .. import registry
 from ..errors import BadParametersError
 from ..matrix import CsrMatrix
 from ..ops.coloring import color_matrix
+from ..ops.dense import abs_det, inverse, safe_inverse
 from ..ops.spmv import spmv
 from .base import Solver
 from .relaxation import _apply_dinv, l1_strengthened_diag, safe_recip
@@ -103,7 +104,7 @@ class MulticolorGSSolver(_ColoredSolver):
     def solver_setup(self):
         self._color()
         d = self.A.diagonal()
-        self._dinv = jnp.linalg.inv(d) if self.A.is_block else safe_recip(d)
+        self._dinv = safe_inverse(d) if self.A.is_block else safe_recip(d)
 
     def solve_data(self):
         d = super().solve_data()
@@ -237,10 +238,10 @@ class MulticolorDILUSolver(_ColoredSolver):
                                         indices_are_sorted=True)
                 blk = d - e
                 # singular guard: fall back to identity like the scalar 1/0
-                det_ok = jnp.abs(jnp.linalg.det(blk)) > 0
+                det_ok = abs_det(blk) > 0
                 blk = jnp.where(det_ok[:, None, None], blk, eye[None])
-                inv = jnp.linalg.inv(blk)
-                Einv = jnp.where((colors == c)[:, None, None], inv, Einv)
+                Einv = jnp.where((colors == c)[:, None, None],
+                                 inverse(blk), Einv)
         else:
             Einv = jnp.zeros((n,), A.dtype)
             for c in range(self.num_colors):

@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import registry
+from ..ops.dense import safe_inverse
 from ..ops.spmv import spmv
 from .base import Solver
 
@@ -26,7 +27,7 @@ def _invert_diag(A):
     """D^{-1}: scalar reciprocal or batched block inverse."""
     d = A.diagonal()
     if A.is_block:
-        return jnp.linalg.inv(d)
+        return safe_inverse(d)
     return safe_recip(d)
 
 
@@ -105,7 +106,7 @@ class JacobiL1Solver(Solver):
                                      num_segments=A.num_rows,
                                      indices_are_sorted=True)
             d = A.diagonal() + jnp.eye(A.block_dimx)[None] * l1[:, :, None]
-            self._dinv = jnp.linalg.inv(d)
+            self._dinv = safe_inverse(d)
         else:
             self._dinv = safe_recip(l1_strengthened_diag(A))
 
