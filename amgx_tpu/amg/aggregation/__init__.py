@@ -22,32 +22,18 @@ from ...config import Config
 from ...matrix import CsrMatrix
 from ..hierarchy import AMGLevel
 from . import selectors  # noqa: F401  (registers selectors)
-from .galerkin import (coarse_a_from_aggregates, prolongate_corr,
-                       restrict_vector)
+from .galerkin import (coarse_a_from_aggregates, geo_shapes,
+                       pair_sum_axis, prolongate_corr, restrict_vector)
 
 
 def _geo_restrict(r, fine_shape, axis):
     """Pair-sum along one grid axis: the piecewise-constant restriction
-    of a structured pairing, as a reshape + sum (no scatter)."""
+    of a structured pairing, as a reshape + sum (no scatter). Shares
+    pair_sum_axis with the structured Galerkin so the transfer operators
+    and the coarse operator can never drift apart."""
     nx, ny, nz = fine_shape
     v = r.reshape(nz, ny, nx)                  # linear index: x fastest
-    dims = 2 - axis                            # array axis being paired
-    e = v.shape[dims]
-    if e % 2 == 0:
-        body, tail = v, None
-    else:
-        sl = [slice(None)] * 3
-        sl[dims] = slice(0, e - 1)
-        body = v[tuple(sl)]
-        sl[dims] = slice(e - 1, e)
-        tail = v[tuple(sl)]
-    shp = list(body.shape)
-    shp[dims] //= 2
-    shp.insert(dims + 1, 2)
-    out = body.reshape(shp).sum(axis=dims + 1)
-    if tail is not None:
-        out = jnp.concatenate([out, tail], axis=dims)
-    return out.reshape(-1)
+    return pair_sum_axis(v, fine_shape[axis], axis).reshape(-1)
 
 
 def _geo_prolongate(xc, fine_shape, coarse_shape, axis):
@@ -85,14 +71,15 @@ class AggregationAMGLevel(AMGLevel):
 
     def _geo_shapes(self):
         """Intermediate grid shapes for the per-axis transfer sequence."""
-        shapes = [self.geo_fine_shape]
-        for a in self.geo_axes:
-            s = list(shapes[-1])
-            s[a] = (s[a] + 1) // 2
-            shapes.append(tuple(s))
-        return shapes
+        return geo_shapes(self.geo_fine_shape, self.geo_axes)
 
     def create_coarse_matrix(self) -> CsrMatrix:
+        if self.geo_axes is not None:
+            from .galerkin import geo_coarse_dia
+            Ac = geo_coarse_dia(self.A, self.geo_fine_shape,
+                                self.geo_axes, self.geo_coarse_shape)
+            if Ac is not None:      # structured sort-free Galerkin
+                return Ac
         Ac = coarse_a_from_aggregates(self.A, self.aggregates,
                                       self.coarse_size)
         if self.geo_coarse_shape is not None:

@@ -19,6 +19,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...matrix import CsrMatrix, lexsort_rc
 
@@ -90,6 +91,239 @@ def coarse_a_from_aggregates(A: CsrMatrix, agg, nc: int) -> CsrMatrix:
     r_s, c_s, v_out, first, u = _coarse_entries(A, agg)
     return _compact_coarse(r_s, c_s, v_out, first,
                            (A.block_dimx, A.block_dimy), int(nc), int(u))
+
+
+# ---------------------------------------------------------------------------
+# structured (GEO) Galerkin fast path
+# ---------------------------------------------------------------------------
+
+def _decompose(d: int, nx: int, ny: int, nz: int):
+    """Split a linear DIA offset into (dx, dy, dz) grid shifts; returns
+    None when the offset is not a small stencil shift."""
+    for dz in (0, -1, 1, -2, 2):
+        if abs(dz) > min(2, nz - 1):
+            continue
+        for dy in (0, -1, 1, -2, 2):
+            if abs(dy) > min(2, ny - 1):
+                continue
+            dx = d - dz * nx * ny - dy * nx
+            if abs(dx) <= min(3, nx - 1):
+                return dx, dy, dz
+    return None
+
+
+def pair_sum_axis(v3, e, axis):
+    """Pair-sum a (nz, ny, nx) array along ONE grid axis of extent `e`
+    (odd extents keep a singleton tail) — the single source of truth for
+    the structured aggregation map agg(x,y,z) = (x//2, y//2, z//2),
+    shared by the GEO transfer operators and the structured Galerkin."""
+    dims = 2 - axis
+    if e % 2 == 0:
+        body, tail = v3, None
+    else:
+        sl = [slice(None)] * 3
+        sl[dims] = slice(0, e - 1)
+        body = v3[tuple(sl)]
+        sl[dims] = slice(e - 1, e)
+        tail = v3[tuple(sl)]
+    shp = list(body.shape)
+    shp[dims] //= 2
+    shp.insert(dims + 1, 2)
+    out = body.reshape(shp).sum(axis=dims + 1)
+    if tail is not None:
+        out = jnp.concatenate([out, tail], axis=dims)
+    return out
+
+
+def geo_shapes(fine_shape, axes):
+    """Intermediate grid shapes of the per-axis pairing sequence."""
+    shapes = [tuple(fine_shape)]
+    for a in axes:
+        s = list(shapes[-1])
+        s[a] = (s[a] + 1) // 2
+        shapes.append(tuple(s))
+    return shapes
+
+
+def _pair_sum3(v3, axes, shapes):
+    out = v3
+    for k, a in enumerate(axes):
+        out = pair_sum_axis(out, shapes[k][a], a)
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("shifts", "shape"))
+def _any_wrapped(vals, shifts, shape):
+    """True when any nonzero lies where its geometric shift exits the
+    grid (the classification would be wrong). `shifts`/`shape` are
+    hashable statics so this caches across setups and levels."""
+    nx, ny, nz = shape
+    n = nx * ny * nz
+    sh = jnp.asarray(shifts, jnp.int32)
+    ix = jnp.arange(n, dtype=jnp.int32)
+    gx = ix % nx
+    gy = (ix // nx) % ny
+    gz = ix // (nx * ny)
+    dx = sh[:, 0][:, None]
+    dy = sh[:, 1][:, None]
+    dz = sh[:, 2][:, None]
+    ok = ((gx + dx >= 0) & (gx + dx < nx) & (gy + dy >= 0)
+          & (gy + dy < ny) & (gz + dz >= 0) & (gz + dz < nz))
+    return jnp.any(jnp.where(ok, 0.0, vals) != 0)
+
+
+@functools.lru_cache(maxsize=256)
+def _geo_contrib_table(dia_offsets, shifts, axes, coarse_shape):
+    """Static contribution table: which fine diagonals (with which
+    parity masks) land on which coarse diagonals."""
+    cnx, cny, cnz = coarse_shape
+    paired = set(axes)
+
+    def splits(delta, axis):
+        if axis not in paired:
+            return [(delta, None)]
+        lo = delta // 2                      # x even: (x+d)//2 - x//2
+        hi = (delta + 1) // 2                # x odd
+        if lo == hi:
+            return [(lo, None)]
+        return [(lo, 0), (hi, 1)]            # (coarse shift, fine parity)
+
+    table = {}
+    for t in range(len(dia_offsets)):
+        dx, dy, dz = shifts[t]
+        for cdx, px in splits(dx, 0):
+            for cdy, py in splits(dy, 1):
+                for cdz, pz in splits(dz, 2):
+                    cd = (cdz * cny + cdy) * cnx + cdx
+                    table.setdefault((cd, cdx, cdy, cdz), []).append(
+                        (t, px, py, pz))
+    coffsets = tuple(sorted(table, key=lambda k: k[0]))
+    contribs = tuple(tuple(table[k]) for k in coffsets)
+    return coffsets, contribs
+
+
+@functools.partial(jax.jit, static_argnames=("coffsets", "contribs",
+                                             "fine_shape", "axes"))
+def _geo_compute(vals, coffsets, contribs, fine_shape, axes):
+    """The whole structured Galerkin numeric phase as one cached jitted
+    program: parity-masked accumulation + reshape pair-sums."""
+    nx, ny, nz = fine_shape
+    shapes = geo_shapes(fine_shape, axes)
+    v3 = vals.reshape(len(vals), nz, ny, nx)
+    xpar = jnp.arange(nx, dtype=jnp.int32) % 2
+    ypar = jnp.arange(ny, dtype=jnp.int32) % 2
+    zpar = jnp.arange(nz, dtype=jnp.int32) % 2
+    outs = []
+    for entries in contribs:
+        acc = jnp.zeros((nz, ny, nx), vals.dtype)
+        for (t, px, py, pz) in entries:
+            m = v3[t]
+            if px is not None:
+                m = m * (xpar == px)[None, None, :]
+            if py is not None:
+                m = m * (ypar == py)[None, :, None]
+            if pz is not None:
+                m = m * (zpar == pz)[:, None, None]
+            acc = acc + m
+        outs.append(_pair_sum3(acc, axes, shapes).reshape(-1))
+    return jnp.stack(outs)               # (kc, nc)
+
+
+@functools.lru_cache(maxsize=256)
+def _geo_csr_structure(coffsets, coarse_shape):
+    """CSR structure of the coarse stencil (host numpy, vectorized;
+    cached so resetup rebuilds only the numeric phase)."""
+    cnx, cny, cnz = coarse_shape
+    nc = cnx * cny * cnz
+    ci = np.arange(nc, dtype=np.int32)
+    cx = ci % cnx
+    cy = (ci // cnx) % cny
+    cz = ci // (cnx * cny)
+    valid = np.stack([
+        (cx + cdx >= 0) & (cx + cdx < cnx) & (cy + cdy >= 0)
+        & (cy + cdy < cny) & (cz + cdz >= 0) & (cz + cdz < cnz)
+        for (_, cdx, cdy, cdz) in coffsets])          # (kc, nc)
+    counts = valid.sum(axis=0).astype(np.int32)
+    row_offsets = np.zeros(nc + 1, np.int32)
+    np.cumsum(counts, out=row_offsets[1:])
+    # entries ordered (row, offset-rank) = (row, ascending column)
+    off_idx, rows = np.nonzero(valid)
+    order = np.lexsort((off_idx, rows))
+    off_e = off_idx[order].astype(np.int32)
+    row_e = rows[order].astype(np.int32)
+    col_e = row_e + np.asarray([k[0] for k in coffsets], np.int32)[off_e]
+    # diagonal position within each row (-1 when offset 0 is not stored)
+    zero_rank = next((i for i, k in enumerate(coffsets) if k[0] == 0),
+                     None)
+    diag_idx = np.full(nc, -1, np.int32)
+    if zero_rank is not None:
+        is_diag = off_e == zero_rank
+        diag_idx[row_e[is_diag]] = np.nonzero(is_diag)[0].astype(np.int32)
+    return row_offsets, off_e, row_e, col_e, diag_idx
+
+
+def geo_coarse_dia(A: CsrMatrix, fine_shape, axes, coarse_shape):
+    """Galerkin product for a structured (GEO) pairing of a banded DIA
+    stencil operator, computed WITHOUT sorts or scatters.
+
+    For a fine entry A[i, i+d] with grid shift (dx, dy, dz), the coarse
+    offset along each paired axis is floor((x+dx)/2) - floor(x/2) — a
+    parity-dependent split into at most two coarse shifts per axis. Each
+    fine diagonal therefore scatters into a statically-known set of
+    coarse diagonals with parity masks, and the aggregate summation is
+    the same reshape pair-sum as the restriction operator. One jitted
+    program; numerically identical to the generic COO relabel+sum (both
+    compute sum over fine pairs), so iteration counts are unchanged.
+
+    Returns the coarse CsrMatrix (initialized, DIA layout attached) or
+    None when the fast path does not apply (non-stencil offsets, or
+    entries that wrap grid rows).
+    """
+    nx, ny, nz = fine_shape
+    cnx, cny, cnz = coarse_shape
+    nc = cnx * cny * cnz
+    if A.dia_offsets is None or A.grid_shape != tuple(fine_shape) \
+            or A.is_block:
+        return None
+    decomp = {}
+    for d in A.dia_offsets:
+        g = _decompose(int(d), nx, ny, nz)
+        if g is None:
+            return None
+        decomp[int(d)] = g
+
+    n = A.num_rows
+    vals = A.dia_vals.reshape(len(A.dia_offsets), -1)[:, :n]
+    # wrap check (one device reduction, one scalar sync per level): a
+    # geometric shift must keep every nonzero inside the grid — entries
+    # that cross a grid row boundary would be misclassified
+    shifts = tuple(decomp[int(d)] for d in A.dia_offsets)
+    if bool(_any_wrapped(vals, shifts, tuple(fine_shape))):
+        return None
+
+    coffsets, contribs = _geo_contrib_table(
+        tuple(int(d) for d in A.dia_offsets), shifts, tuple(axes),
+        (cnx, cny, cnz))
+    cvals = _geo_compute(vals, coffsets, contribs, tuple(fine_shape),
+                         tuple(axes))
+    (row_offsets, off_e, row_e, col_e, diag_idx) = _geo_csr_structure(
+        coffsets, (cnx, cny, cnz))
+    values = cvals[jnp.asarray(off_e), jnp.asarray(row_e)]
+    from ...ops.pallas_spmv import LANES, dia_padded_rows
+    kc = len(coffsets)
+    rows_pad = dia_padded_rows(kc, nc)
+    dia_vals = jnp.zeros((kc, rows_pad * LANES), cvals.dtype
+                         ).at[:, :nc].set(cvals).reshape(kc, rows_pad,
+                                                         LANES)
+    return CsrMatrix(
+        row_offsets=jnp.asarray(row_offsets),
+        col_indices=jnp.asarray(col_e), values=values, diag=None,
+        row_ids=jnp.asarray(row_e), diag_idx=jnp.asarray(diag_idx),
+        ell_cols=None, ell_vals=None,
+        dia_offsets=tuple(int(k[0]) for k in coffsets),
+        dia_vals=dia_vals, num_rows=nc, num_cols=nc,
+        block_dimx=1, block_dimy=1, initialized=True,
+        grid_shape=tuple(coarse_shape))
 
 
 def restrict_vector(agg, nc: int, r, block_dim: int = 1):

@@ -341,7 +341,10 @@ class GeoSelector(AggregationSelector):
         cnx = (nx + 1) // 2 if 0 in axes else nx
         cny = (ny + 1) // 2 if 1 in axes else ny
         cnz = (nz + 1) // 2 if 2 in axes else nz
-        i = jnp.arange(n, dtype=jnp.int32)
+        # pure index arithmetic: host numpy (a single device transfer)
+        # instead of ~10 eager device ops — on tunneled TPU rigs every
+        # eager dispatch costs a full round trip
+        i = np.arange(n, dtype=np.int32)
         x = i % nx
         t = i // nx
         y = t % ny
@@ -353,4 +356,4 @@ class GeoSelector(AggregationSelector):
         self.fine_shape = shape
         self.pair_axes = axes
         self.coarse_shape = (cnx, cny, cnz)
-        return agg.astype(jnp.int32), int(cnx * cny * cnz)
+        return jnp.asarray(agg, jnp.int32), int(cnx * cny * cnz)
