@@ -33,6 +33,9 @@ from .errors import RC, AMGXError  # noqa: E402,F401
 from . import ops  # noqa: E402,F401
 from . import profiling  # noqa: E402,F401
 from . import determinism  # noqa: E402,F401
+from . import memory_info  # noqa: E402,F401
+from . import thread_manager  # noqa: E402,F401
+from .resources import Resources  # noqa: E402,F401
 
 _initialized = False
 

@@ -142,8 +142,11 @@ class _CEigenSolver:
 
 
 class _CResources:
-    def __init__(self, cfg: Optional[Config]):
+    def __init__(self, cfg: Optional[Config], device_num: int = 0,
+                 devices=None):
+        from .resources import Resources
         self.cfg = cfg
+        self.res = Resources(cfg, device_num=device_num, devices=devices)
 
 
 # ---------------------------------------------------------------------------
@@ -264,15 +267,29 @@ def AMGX_resources_create_simple(cfg_h=None):
 
 @_api
 @_outputs(1)
-def AMGX_resources_create(cfg_h, _comm=None, _device_num=0, _devices=None):
+def AMGX_resources_create(cfg_h, _comm=None, device_num=0, devices=None):
     cfg = _get(cfg_h, Config) if cfg_h is not None else None
-    return RC.OK, _new_handle(_CResources(cfg))
+    return RC.OK, _new_handle(
+        _CResources(cfg, device_num=device_num, devices=devices))
 
 
 @_api
 def AMGX_resources_destroy(rsrc_h):
     _handles.pop(rsrc_h, None)
     return RC.OK
+
+
+@_api
+@_outputs(2)
+def AMGX_resources_get_memory_usage(rsrc_h):
+    """rc, bytes_in_use, peak high-water mark (MemoryInfo analog;
+    include/memory_info.h:33) over the resources' devices. Backends
+    without allocator statistics (CPU) report zeros."""
+    from . import memory_info
+    rs = _get(rsrc_h, _CResources)
+    cur = int(rs.res.memory_stats().get("bytes_in_use", 0))
+    memory_info.update_max_memory_usage()
+    return RC.OK, cur, max(memory_info.get_max_memory_usage(), cur)
 
 
 # ---------------------------------------------------------------------------
@@ -309,9 +326,10 @@ def AMGX_matrix_upload_all(mtx_h, n, nnz, block_dimx, block_dimy,
         diag = np.asarray(diag_data, dtype=dt)
         if block_dimx * block_dimy > 1:
             diag = diag.reshape(n, block_dimx, block_dimy)
-    m.set_matrix(CsrMatrix.from_scipy_like(
-        ro, ci, vals, n, n, block_dims=(block_dimx, block_dimy),
-        diag=diag).init())
+    with m.resources.res.device_context():
+        m.set_matrix(CsrMatrix.from_scipy_like(
+            ro, ci, vals, n, n, block_dims=(block_dimx, block_dimy),
+            diag=diag).init())
     return RC.OK
 
 
@@ -354,6 +372,45 @@ def AMGX_matrix_get_size(mtx_h):
 def AMGX_matrix_get_nnz(mtx_h):
     m = _get(mtx_h, _CMatrix)
     return RC.OK, (m.A.nnz if m.A is not None else 0)
+
+
+@_api
+def AMGX_matrix_attach_geometry(mtx_h, geox, geoy, geoz=None, n=None):
+    """AMGX_matrix_attach_geometry (src/amgx_c.cu:3143): attach per-row
+    coordinates so geometry-aware selectors (GEO) can run. TPU redesign:
+    for a lexicographically-ordered structured grid the coordinates
+    collapse to a (nx, ny, nz) grid annotation (CsrMatrix.grid_shape),
+    which is what the structured-pairing GEO selector and the sort-free
+    structured Galerkin consume. Non-grid coordinates are rejected."""
+    import dataclasses
+    m = _get(mtx_h, _CMatrix)
+    if m.A is None:
+        raise AMGXError("matrix not uploaded", RC.BAD_PARAMETERS)
+    gx = np.asarray(geox, np.float64)
+    gy = np.asarray(geoy, np.float64)
+    gz = (np.asarray(geoz, np.float64) if geoz is not None
+          else np.zeros_like(gx))
+    if n is not None and n != m.A.num_rows:
+        raise AMGXError("attach_geometry: n mismatch", RC.BAD_PARAMETERS)
+    nx = np.unique(gx).size
+    ny = np.unique(gy).size
+    nz = np.unique(gz).size
+    if nx * ny * nz != m.A.num_rows:
+        raise AMGXError(
+            "attach_geometry: coordinates do not form a structured "
+            "nx*ny*nz grid", RC.BAD_PARAMETERS)
+    # verify lexicographic ordering (x fastest) — the layout grid_shape
+    # asserts; rank the coordinates and rebuild the linear index
+    rx = np.searchsorted(np.unique(gx), gx)
+    ry = np.searchsorted(np.unique(gy), gy)
+    rz = np.searchsorted(np.unique(gz), gz)
+    lin = (rz * ny + ry) * nx + rx
+    if not np.array_equal(lin, np.arange(m.A.num_rows)):
+        raise AMGXError(
+            "attach_geometry: rows are not in lexicographic grid order "
+            "(x fastest); renumber the system first", RC.BAD_PARAMETERS)
+    m.A = dataclasses.replace(m.A, grid_shape=(int(nx), int(ny), int(nz)))
+    return RC.OK
 
 
 # ---------------------------------------------------------------------------

@@ -98,6 +98,41 @@ def _shard_smoother_data(sm, A_sh: ShardMatrix, n_ranks: int):
     return out
 
 
+class _ConsolidationBoundaryLevel:
+    """Wraps the last SHARDED level when coarse-level consolidation is
+    on (glue_matrices analog, include/distributed/glue.h:200): its
+    restriction all_gathers the coarse rhs so every deeper level runs
+    REPLICATED on full vectors (no halo traffic — the right trade once
+    a level's per-shard row count is small enough that latency
+    dominates), and its prolongation slices the local piece back out.
+    The reference merges shards onto sub-communicators; on a TPU mesh
+    the latency-optimal merge target is full replication, which is also
+    what its exact_coarse_solve does one level further down."""
+
+    def __init__(self, level, axis: str, n_ranks: int, nc_global: int):
+        self._level = level
+        self._axis = axis
+        self._n_ranks = n_ranks
+        self._nc_global = nc_global
+        self._nc_local = -(-nc_global // n_ranks)
+
+    def __getattr__(self, name):
+        return getattr(self._level, name)
+
+    def restrict(self, data, r):
+        bc_local = self._level.restrict(data, r)[: self._nc_local]
+        bc = jax.lax.all_gather(bc_local, self._axis, tiled=True)
+        return bc[: self._nc_global]
+
+    def prolongate(self, data, xc):
+        pad = self._n_ranks * self._nc_local - self._nc_global
+        xp = jnp.pad(xc, (0, pad))
+        rank = jax.lax.axis_index(self._axis)
+        xc_local = jax.lax.dynamic_slice(xp, (rank * self._nc_local,),
+                                         (self._nc_local,))
+        return self._level.prolongate(data, xc_local)
+
+
 class DistributedCoarseSolver:
     """exact_coarse_solve analog (dense_lu_solver.cu:783-930): all_gather
     the coarse rhs, apply the replicated inner solver redundantly on
@@ -135,12 +170,30 @@ def shard_amg(amg, n_ranks: int, axis: str):
         raise BadParametersError(
             "distributed AMG: K-cycles (CG/CGF) not yet supported; "
             "use cycle=V, W or F")
-    if isinstance(amg.coarse_solver, DistributedCoarseSolver):
+    if isinstance(amg.coarse_solver, DistributedCoarseSolver) or any(
+            isinstance(lv, _ConsolidationBoundaryLevel)
+            for lv in amg.levels):
         raise BadParametersError(
             "shard_amg: hierarchy is already sharded; re-run setup() "
             "before sharding again")
+    # coarse-level consolidation (amg_consolidation_flag +
+    # matrix_consolidation_lower_threshold, src/core.cu:316-322): once a
+    # level's per-shard row count falls below the threshold, that level
+    # and everything deeper run replicated
+    boundary = len(amg.levels)
+    if bool(amg.cfg.get("amg_consolidation_flag", amg.scope)):
+        lower = int(amg.cfg.get("matrix_consolidation_lower_threshold",
+                                amg.scope))
+        if lower > 0:
+            for k, lvl in enumerate(amg.levels):
+                if lvl.A.num_rows / n_ranks < lower:
+                    boundary = max(k, 1)     # finest level stays sharded
+                    break
     levels_data = []
-    for lvl in amg.levels:
+    for k, lvl in enumerate(amg.levels):
+        if k >= boundary:                    # replicated (glued) level
+            levels_data.append(_replicate(lvl.level_data(), n_ranks))
+            continue
         A_sh = _shard(lvl.A, n_ranks, axis)
         P, R = _transfer_ops(lvl)
         ld = {
@@ -152,11 +205,18 @@ def shard_amg(amg, n_ranks: int, axis: str):
             ld["smoother"] = _shard_smoother_data(lvl.smoother, A_sh,
                                                   n_ranks)
         levels_data.append(ld)
-    # replicated coarsest level
     nc = amg.coarsest_A.num_rows
-    nc_local = -(-nc // n_ranks)
     coarse_data = _replicate(amg.coarse_solver.solve_data(), n_ranks)
-    amg.coarse_solver = DistributedCoarseSolver(
-        amg.coarse_solver, axis, n_ranks, nc, nc_local,
-        amg.coarsest_sweeps)
+    if boundary < len(amg.levels):
+        # vectors are already global below the boundary: the coarse
+        # solver applies directly, and the boundary level's transfers
+        # gather/slice across the mesh
+        nb = amg.levels[boundary].A.num_rows
+        amg.levels[boundary - 1] = _ConsolidationBoundaryLevel(
+            amg.levels[boundary - 1], axis, n_ranks, nb)
+    else:
+        nc_local = -(-nc // n_ranks)
+        amg.coarse_solver = DistributedCoarseSolver(
+            amg.coarse_solver, axis, n_ranks, nc, nc_local,
+            amg.coarsest_sweeps)
     return {"levels": levels_data, "coarse": coarse_data}

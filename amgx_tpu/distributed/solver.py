@@ -37,9 +37,15 @@ _SUPPORTED_PRECONDS = {"NOSOLVER", "DUMMY", "BLOCK_JACOBI", "JACOBI",
                        "JACOBI_L1", "AMG"}
 
 
-def default_mesh(n_devices: Optional[int] = None, axis: str = "p") -> Mesh:
-    devs = jax.devices()
+def default_mesh(n_devices: Optional[int] = None, axis: str = "p",
+                 devices=None) -> Mesh:
+    devs = devices if devices is not None else jax.devices()
     n = n_devices or len(devs)
+    if n > len(devs):
+        raise BadParametersError(
+            f"default_mesh: {n} devices requested but only {len(devs)} "
+            f"visible ({devs[0].platform}); on CPU force virtual devices "
+            "before any jax call (see _cpu_backend.force_cpu)")
     return Mesh(np.array(devs[:n]), (axis,))
 
 

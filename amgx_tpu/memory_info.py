@@ -1,0 +1,57 @@
+"""Device-memory usage tracking (MemoryInfo analog).
+
+The reference tracks a process-wide high-water mark through
+`MemoryInfo::updateMaxMemoryUsage` (include/memory_info.h:33) and prints
+it in the per-iteration solve-stats table. Here the numbers come from
+the backend's allocator statistics (`device.memory_stats()` on TPU; CPU
+reports none and reads as zero), sampled at update points rather than
+hooked into every allocation — XLA owns the allocator.
+"""
+from __future__ import annotations
+
+_max_bytes = 0
+
+
+def sum_device_stats(devices) -> dict:
+    """Sum allocator statistics over `devices` (empty dict when the
+    backend reports none)."""
+    total: dict = {}
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            for k, v in stats.items():
+                if isinstance(v, (int, float)):
+                    total[k] = total.get(k, 0) + v
+    return total
+
+
+def _current_bytes() -> int:
+    import jax
+    return int(sum_device_stats(jax.local_devices()).get(
+        "bytes_in_use", 0))
+
+
+def update_max_memory_usage() -> int:
+    """Sample current device usage, fold into the high-water mark, and
+    return the current bytes (updateMaxMemoryUsage analog)."""
+    global _max_bytes
+    cur = _current_bytes()
+    _max_bytes = max(_max_bytes, cur)
+    return cur
+
+
+def get_max_memory_usage() -> int:
+    """High-water mark in bytes since process start / last reset."""
+    return _max_bytes
+
+
+def get_memory_usage_gb() -> float:
+    return _current_bytes() / 2**30
+
+
+def reset():
+    global _max_bytes
+    _max_bytes = 0

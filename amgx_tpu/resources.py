@@ -1,0 +1,77 @@
+"""Per-process platform context (Resources analog).
+
+The reference's `Resources` (include/resources.h:21-59, src/resources.cu)
+carries the config, the CUDA devices, streams, and memory-pool handles
+for every object created against it. The TPU-native equivalent carries
+the JAX platform/device selection and the device mesh used by the
+distributed layer — streams and memory pools are owned by XLA, so what
+remains is *placement*: which chip(s) arrays created through the C API
+land on, and where device memory statistics come from.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .config import Config
+from .errors import BadParametersError
+
+
+class Resources:
+    """Device/platform context: config + device selection + mesh."""
+
+    def __init__(self, cfg: Optional[Config] = None, device_num: int = 0,
+                 devices=None):
+        import jax
+        self.cfg = cfg
+        all_devices = jax.devices()
+        if devices:                      # explicit device-ordinal list
+            try:
+                self.devices = [all_devices[int(d)] for d in devices]
+            except IndexError:
+                raise BadParametersError(
+                    f"Resources: device ordinals {devices} out of range "
+                    f"({len(all_devices)} visible)")
+            self._primary = 0
+        else:
+            # own every visible device; device_num selects the primary
+            # one for single-device objects (resources_create semantics)
+            if not (0 <= device_num < len(all_devices)):
+                raise BadParametersError(
+                    f"Resources: device_num {device_num} out of range "
+                    f"({len(all_devices)} visible)")
+            self.devices = list(all_devices)
+            self._primary = device_num
+
+    @property
+    def device(self):
+        """Primary device for single-device objects."""
+        return self.devices[self._primary]
+
+    @property
+    def platform(self) -> str:
+        return self.device.platform
+
+    @property
+    def num_devices(self) -> int:
+        return len(self.devices)
+
+    def device_context(self):
+        """Context manager placing newly created arrays on this
+        resources' primary device (jax.default_device)."""
+        import jax
+        return jax.default_device(self.device)
+
+    def mesh(self, n_devices: Optional[int] = None, axis: str = "p"):
+        """1-D device mesh over this resources' devices (the distributed
+        layer's domain-decomposition axis; SURVEY §2.6)."""
+        from .distributed.solver import default_mesh
+        return default_mesh(n_devices, axis, devices=self.devices)
+
+    def memory_stats(self) -> dict:
+        """Summed memory statistics over this resources' devices
+        (bytes_in_use / peak_bytes_in_use where the backend reports
+        them; empty dict otherwise)."""
+        from .memory_info import sum_device_stats
+        return sum_device_stats(self.devices)

@@ -173,6 +173,13 @@ class Solver:
         structure_reuse_levels) can keep it."""
         return self._setup_impl(A, reuse=True)
 
+    def setup_async(self, A: CsrMatrix):
+        """Run setup on a worker thread (AsyncSolverSetupTask analog,
+        include/amg_level.h:25-39); returns a task whose wait() joins
+        and re-raises. The solver must not be used before wait()."""
+        from ..thread_manager import setup_async
+        return setup_async(self, A)
+
     def _setup_impl(self, A: CsrMatrix, reuse: bool):
         from ..profiling import trace_region
         with trace_region(f"{self.name}.{'resetup' if reuse else 'setup'}"):
@@ -394,6 +401,8 @@ class Solver:
         return res
 
     def _print_stats(self, res: SolveResult, hist):
+        from ..memory_info import update_max_memory_usage
+        mem_gb = update_max_memory_usage() / 2**30
         amgx_printf(f"    iter      Mem Usage (GB)       residual           rate")
         amgx_printf(f"    {'-' * 62}")
         for i in range(res.iterations + 1):
@@ -401,7 +410,7 @@ class Solver:
             if i > 0 and np.all(hist[i - 1] > 0):
                 rate = f"{float(np.max(hist[i] / hist[i - 1])):14.4f}"
             tag = "Ini" if i == 0 else f"{i - 1:4d}"
-            amgx_printf(f"    {tag}         {0.0:10.4f}      "
+            amgx_printf(f"    {tag}         {mem_gb:10.4f}      "
                   f"{float(np.max(hist[i])):14.6e} {rate}")
         amgx_printf(f"    {'-' * 62}")
         status = "success" if res.converged else "failed"

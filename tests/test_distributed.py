@@ -231,3 +231,35 @@ def test_distributed_amg_kcycle_rejected(mesh):
     ds = DistributedSolver(cfg, mesh)
     with pytest.raises(BadParametersError):
         ds.setup(A)
+
+
+@pytest.mark.parametrize("extra,expect_boundary", [
+    ("", False),
+    (", amg:amg_consolidation_flag=1,"
+     " amg:matrix_consolidation_lower_threshold=40", True),
+])
+def test_distributed_amg_consolidation(mesh, extra, expect_boundary):
+    """Coarse-level consolidation (glue_matrices analog, glue.h:200):
+    levels whose per-shard row count falls below the threshold run
+    replicated; iteration counts must still match the single-device
+    hierarchy exactly."""
+    from amgx_tpu.distributed.amg import _ConsolidationBoundaryLevel
+    A = gallery.poisson("7pt", 6, 6, 4 * NDEV).init()
+    b = jnp.ones(A.num_rows)
+    cfg_str = (_AMG_BASE + ", amg:algorithm=AGGREGATION,"
+               " amg:selector=SIZE_2, amg:smoother=BLOCK_JACOBI,"
+               " amg:relaxation_factor=0.9" + extra)
+    ref = _single_device_iters(cfg_str, A, b)
+    assert ref.converged
+
+    ds = DistributedSolver(Config.from_string(cfg_str), mesh)
+    ds.setup(A)
+    amg_h = ds.solver.preconditioner.amg
+    wrapped = any(isinstance(lv, _ConsolidationBoundaryLevel)
+                  for lv in amg_h.levels)
+    assert wrapped == expect_boundary
+    res = ds.solve(np.asarray(b))
+    assert res.converged
+    assert res.iterations == ref.iterations
+    r = np.asarray(ops.residual(A, jnp.asarray(np.asarray(res.x)), b))
+    assert np.linalg.norm(r) < 1e-6 * np.linalg.norm(np.asarray(b))

@@ -1,0 +1,156 @@
+"""L0 runtime components: Resources, memory info, thread manager /
+async setup, signal handler (reference analogs: include/resources.h:21,
+include/memory_info.h:33, src/thread_manager.cu + amg_level.h:25-39,
+src/amg_signal.cu)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import amgx_tpu as amgx
+from amgx_tpu import capi, gallery, memory_info, thread_manager
+from amgx_tpu.config import Config
+from amgx_tpu.errors import AMGXError, RC
+
+amgx.initialize()
+
+
+class TestResources:
+    def test_device_selection_and_platform(self):
+        import jax
+        rs = amgx.Resources()
+        assert rs.num_devices == len(jax.devices())
+        assert rs.platform in ("cpu", "tpu")
+        with rs.device_context():
+            x = jnp.ones(4)
+        assert list(x.devices())[0] == rs.device
+        rs1 = amgx.Resources(device_num=min(1, rs.num_devices - 1))
+        assert rs1.device == jax.devices()[min(1, rs.num_devices - 1)]
+        # explicit ordinal list restricts ownership
+        rs2 = amgx.Resources(devices=[0])
+        assert rs2.num_devices == 1
+
+    def test_bad_device_num_rejected(self):
+        with pytest.raises(AMGXError):
+            amgx.Resources(device_num=99)
+
+    def test_mesh(self):
+        rs = amgx.Resources()
+        mesh = rs.mesh(8)
+        assert mesh.devices.size == 8
+        with pytest.raises(AMGXError):
+            rs.mesh(4096)
+
+    def test_capi_resources_surface(self):
+        rc, cfg_h = capi.AMGX_config_create("solver=CG, max_iters=5")
+        assert rc == RC.OK
+        rc, rsrc = capi.AMGX_resources_create(cfg_h, None, 0, None)
+        assert rc == RC.OK
+        rc, cur, peak = capi.AMGX_resources_get_memory_usage(rsrc)
+        assert rc == RC.OK and peak >= cur >= 0
+        assert capi.AMGX_resources_destroy(rsrc) == RC.OK
+        assert capi.AMGX_config_destroy(cfg_h) == RC.OK
+
+
+class TestMemoryInfo:
+    def test_high_water_mark_monotone(self):
+        memory_info.reset()
+        a = memory_info.update_max_memory_usage()
+        peak = memory_info.get_max_memory_usage()
+        assert peak >= a >= 0
+        assert memory_info.get_memory_usage_gb() >= 0.0
+
+
+class TestAsyncSetup:
+    def test_async_setup_matches_sync(self):
+        A = gallery.poisson("7pt", 8, 8, 8).init()
+        b = jnp.ones(A.num_rows)
+        cfg = Config.from_string(
+            "solver=FGMRES, max_iters=40, monitor_residual=1,"
+            " tolerance=1e-8, gmres_n_restart=10,"
+            " preconditioner(amg)=AMG, amg:algorithm=AGGREGATION,"
+            " amg:selector=GEO, amg:smoother=BLOCK_JACOBI,"
+            " amg:max_iters=1, amg:cycle=V")
+        ref = amgx.create_solver(cfg)
+        ref.setup(A)
+        r_ref = ref.solve(b)
+
+        slv = amgx.create_solver(cfg)
+        task = slv.setup_async(A)
+        assert task.wait() is slv
+        assert task.done()
+        res = slv.solve(b)
+        assert res.converged == r_ref.converged
+        assert res.iterations == r_ref.iterations
+
+    def test_async_setup_propagates_errors(self):
+        slv = amgx.create_solver(Config.from_string(
+            "solver=REFINEMENT, max_iters=5, preconditioner=NOSOLVER"))
+        task = slv.setup_async(gallery.poisson("5pt", 6, 6).init())
+        with pytest.raises(AMGXError):
+            task.wait()
+
+    def test_parallel_setups(self):
+        As = [gallery.poisson("5pt", 10 + i, 10).init() for i in range(3)]
+        cfg = Config.from_string("solver=BLOCK_JACOBI, max_iters=4")
+        solvers = [amgx.create_solver(cfg) for _ in As]
+        tasks = [s.setup_async(A) for s, A in zip(solvers, As)]
+        for t in tasks:
+            t.wait()
+        for s, A in zip(solvers, As):
+            res = s.solve(jnp.ones(A.num_rows))
+            assert np.all(np.isfinite(np.asarray(res.x)))
+
+
+def test_signal_handler_install_reset():
+    import faulthandler
+    assert capi.AMGX_install_signal_handler() == RC.OK
+    assert faulthandler.is_enabled()
+    assert capi.AMGX_reset_signal_handler() == RC.OK
+    assert not faulthandler.is_enabled()
+
+
+class TestAttachGeometry:
+    """AMGX_matrix_attach_geometry (src/amgx_c.cu:3143): coordinates of
+    a lexicographic structured grid collapse to the grid_shape
+    annotation the GEO selector consumes."""
+
+    def _upload(self, A):
+        rc, cfg_h = capi.AMGX_config_create("solver=CG, max_iters=5")
+        rc, rsrc = capi.AMGX_resources_create(cfg_h, None, 0, None)
+        rc, mtx = capi.AMGX_matrix_create(rsrc, "dDDI")
+        assert capi.AMGX_matrix_upload_all(
+            mtx, A.num_rows, A.nnz, 1, 1, np.asarray(A.row_offsets),
+            np.asarray(A.col_indices), np.asarray(A.values)) == RC.OK
+        return mtx
+
+    @staticmethod
+    def _coords(nx, ny, nz):
+        ix, iy, iz = np.meshgrid(np.arange(nx), np.arange(ny),
+                                 np.arange(nz), indexing="ij")
+        order = np.argsort(((iz * ny + iy) * nx + ix).ravel())
+        return (ix.ravel()[order].astype(float),
+                iy.ravel()[order].astype(float),
+                iz.ravel()[order].astype(float))
+
+    def test_attach_sets_grid_shape(self):
+        A = gallery.poisson("7pt", 6, 5, 4)
+        mtx = self._upload(A)
+        gx, gy, gz = self._coords(6, 5, 4)
+        assert capi.AMGX_matrix_attach_geometry(mtx, gx, gy, gz,
+                                                A.num_rows) == RC.OK
+        assert capi._get(mtx, capi._CMatrix).A.grid_shape == (6, 5, 4)
+
+    def test_attach_rejects_non_grid(self):
+        A = gallery.poisson("5pt", 4, 4)
+        mtx = self._upload(A)
+        rng = np.random.default_rng(0)
+        gx = rng.random(16); gy = rng.random(16)
+        assert capi.AMGX_matrix_attach_geometry(mtx, gx, gy) != RC.OK
+
+    def test_attach_rejects_wrong_order(self):
+        A = gallery.poisson("5pt", 4, 4)
+        mtx = self._upload(A)
+        gx, gy, gz = self._coords(4, 4, 1)
+        # y-fastest ordering: not the layout grid_shape asserts
+        assert capi.AMGX_matrix_attach_geometry(
+            mtx, gy, gx, gz, A.num_rows) != RC.OK
