@@ -37,16 +37,22 @@ def _geo_restrict(r, fine_shape, axis):
 
 
 def _geo_prolongate(xc, fine_shape, coarse_shape, axis):
-    """Broadcast along the paired grid axis (P = pairwise-constant)."""
+    """Broadcast along the paired grid axis (P = pairwise-constant).
+    Implemented as two interior-padded copies (even + odd positions)
+    instead of jnp.repeat: repeat's internal `(..., 2)` reshape puts the
+    pair in the minor dimension, which TPU tiling pads 128x."""
+    import jax
     nx, ny, nz = coarse_shape
     v = xc.reshape(nz, ny, nx)
     dims = 2 - axis
-    out = jnp.repeat(v, 2, axis=dims)
     fine_e = fine_shape[axis]
-    if out.shape[dims] != fine_e:               # odd fine extent: trim
-        sl = [slice(None)] * 3
-        sl[dims] = slice(0, fine_e)
-        out = out[tuple(sl)]
+    cn = v.shape[dims]
+    zero = jnp.zeros((), v.dtype)
+    cfg_e = [(0, 0, 0)] * 3
+    cfg_o = [(0, 0, 0)] * 3
+    cfg_e[dims] = (0, fine_e - (2 * cn - 1), 1)   # values at even slots
+    cfg_o[dims] = (1, fine_e - 2 * cn, 1)         # values at odd slots
+    out = jax.lax.pad(v, zero, cfg_e) + jax.lax.pad(v, zero, cfg_o)
     return out.reshape(-1)
 
 

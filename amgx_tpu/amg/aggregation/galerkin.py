@@ -116,22 +116,23 @@ def pair_sum_axis(v3, e, axis):
     """Pair-sum a (nz, ny, nx) array along ONE grid axis of extent `e`
     (odd extents keep a singleton tail) — the single source of truth for
     the structured aggregation map agg(x,y,z) = (x//2, y//2, z//2),
-    shared by the GEO transfer operators and the structured Galerkin."""
+    shared by the GEO transfer operators and the structured Galerkin.
+
+    Implemented as two strided slices + add: a `(..., e//2, 2)` reshape
+    would put the pair in the minor dimension, which TPU tiling pads
+    128x (a 4 GB temp at 256^3)."""
     dims = 2 - axis
-    if e % 2 == 0:
-        body, tail = v3, None
-    else:
-        sl = [slice(None)] * 3
-        sl[dims] = slice(0, e - 1)
-        body = v3[tuple(sl)]
-        sl[dims] = slice(e - 1, e)
-        tail = v3[tuple(sl)]
-    shp = list(body.shape)
-    shp[dims] //= 2
-    shp.insert(dims + 1, 2)
-    out = body.reshape(shp).sum(axis=dims + 1)
-    if tail is not None:
-        out = jnp.concatenate([out, tail], axis=dims)
+
+    def sl(start, stop):
+        s = [slice(None)] * 3
+        s[dims] = slice(start, stop, 2)
+        return v3[tuple(s)]
+
+    out = sl(0, e - 1) + sl(1, e)
+    if e % 2:
+        s = [slice(None)] * 3
+        s[dims] = slice(e - 1, e)
+        out = jnp.concatenate([out, v3[tuple(s)]], axis=dims)
     return out
 
 

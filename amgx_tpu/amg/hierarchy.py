@@ -59,8 +59,14 @@ class AMGLevel:
 
     # -- solve-phase (pure) ----------------------------------------------
     def level_data(self) -> Dict[str, Any]:
-        d = {"A": self.A}
+        # slim matrices: the cycle only SpMVs against level operators,
+        # so layout-only views keep multi-GB unused CSR payloads out of
+        # the solve program's HBM arguments
+        A = self.A.slim_for_spmv()
+        d = {"A": A}
         if self.smoother is not None:
+            # the smoother's solve_data already slims its own A when its
+            # sweeps only SpMV (Solver.slim_A_ok)
             d["smoother"] = self.smoother.solve_data()
         return d
 

@@ -283,13 +283,11 @@ def AMGX_resources_destroy(rsrc_h):
 @_outputs(2)
 def AMGX_resources_get_memory_usage(rsrc_h):
     """rc, bytes_in_use, peak high-water mark (MemoryInfo analog;
-    include/memory_info.h:33) over the resources' devices. Backends
-    without allocator statistics (CPU) report zeros."""
-    from . import memory_info
+    include/memory_info.h:33), both scoped to the resources' devices.
+    Backends without allocator statistics (CPU) report zeros."""
     rs = _get(rsrc_h, _CResources)
-    cur = int(rs.res.memory_stats().get("bytes_in_use", 0))
-    memory_info.update_max_memory_usage()
-    return RC.OK, cur, max(memory_info.get_max_memory_usage(), cur)
+    cur, peak = rs.res.update_memory_usage()
+    return RC.OK, cur, peak
 
 
 # ---------------------------------------------------------------------------
@@ -495,7 +493,8 @@ def AMGX_solver_setup(slv_h, mtx_h):
     m = _get(mtx_h, _CMatrix)
     if m.A is None:
         raise AMGXError("matrix not uploaded", RC.BAD_PARAMETERS)
-    s.solver.setup(m.A)
+    with s.resources.res.device_context():
+        s.solver.setup(m.A)
     return RC.OK
 
 
@@ -517,8 +516,9 @@ def _do_solve(s, b_h, x_h, zero_guess):
     if b.v is None:
         raise AMGXError("rhs not uploaded", RC.BAD_PARAMETERS)
     x0 = x.v if (x.v is not None and not zero_guess) else None
-    s.result = s.solver.solve(b.v, x0=x0,
-                              zero_initial_guess=zero_guess)
+    with s.resources.res.device_context():
+        s.result = s.solver.solve(b.v, x0=x0,
+                                  zero_initial_guess=zero_guess)
     x.v = np.asarray(s.result.x)
     x.block_dim = b.block_dim
     return RC.OK

@@ -247,6 +247,11 @@ class CsrMatrix:
         (computeDiagonal analog, src/matrix.cu)."""
         if self.has_external_diag:
             return self.diag
+        if self.dia_offsets is not None and 0 in self.dia_offsets:
+            # O(1) from the DIA layout: row-major slice of the main
+            # diagonal (avoids the values gather entirely)
+            idx0 = self.dia_offsets.index(0)
+            return self.dia_vals[idx0].reshape(-1)[: self.num_rows]
         A = self if self.initialized else self.init(ell="never")
         safe = jnp.maximum(A.diag_idx, 0)
         d = A.values[safe]
@@ -379,6 +384,29 @@ class CsrMatrix:
             else jnp.asarray(diag),
             num_rows=int(num_rows), num_cols=int(num_cols),
             block_dimx=block_dims[0], block_dimy=block_dims[1])
+
+    def slim_for_spmv(self) -> "CsrMatrix":
+        """Drop every array the SpMV dispatch path does not read, given
+        the built layout (DIA keeps only dia_vals; ELL keeps the padded
+        arrays). Solve-phase data pytrees use this so multi-GB unused
+        CSR payloads don't occupy HBM as program arguments (at 256^3 the
+        fine matrix's unused values/col_indices/row_ids cost ~2 GB).
+        The result supports spmv()/residual() ONLY — setup-phase
+        consumers (diagonal, coo, Galerkin) need the full matrix."""
+        if not self.initialized:
+            return self
+        dummy_i = jnp.zeros((1,), jnp.int32)
+        if self.dia_vals is not None:
+            return dataclasses.replace(
+                self, values=jnp.zeros((1,), self.dtype),
+                col_indices=dummy_i, row_ids=None, diag_idx=None,
+                row_offsets=dummy_i, ell_cols=None, ell_vals=None)
+        if self.ell_cols is not None:
+            return dataclasses.replace(
+                self, values=jnp.zeros((1,), self.dtype),
+                col_indices=dummy_i, row_ids=None, diag_idx=None,
+                row_offsets=dummy_i)
+        return self
 
     def astype(self, dtype) -> "CsrMatrix":
         """Cast all floating-point payloads (values/diag + any built

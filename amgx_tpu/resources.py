@@ -75,3 +75,14 @@ class Resources:
         them; empty dict otherwise)."""
         from .memory_info import sum_device_stats
         return sum_device_stats(self.devices)
+
+    _peak_bytes = 0
+
+    def update_memory_usage(self):
+        """Sample current usage over this resources' devices and fold
+        into this resources' own high-water mark; returns (current,
+        peak) bytes (MemoryInfo::updateMaxMemoryUsage analog, scoped to
+        the resources like the reference's per-Resources pools)."""
+        cur = int(self.memory_stats().get("bytes_in_use", 0))
+        self._peak_bytes = max(self._peak_bytes, cur)
+        return cur, self._peak_bytes

@@ -118,6 +118,10 @@ class Solver:
     uses_preconditioner = False
     # smoothers can be used by AMG levels; they expose smooth()
     is_smoother = False
+    # True when solve-phase code only SpMVs against data["A"], so a
+    # layout-only slim view may replace it (KACZMARZ reads COO structure
+    # per sweep and opts out)
+    slim_A_ok = True
 
     def __init__(self, cfg: Config, scope: str = "default",
                  name: str = "?"):
@@ -224,8 +228,13 @@ class Solver:
     # -- functional pieces (pure, jittable) ------------------------------
     def solve_data(self) -> Dict[str, Any]:
         """The pytree of device data the jitted solve needs. Includes the
-        preconditioner's data under 'precond'."""
-        d: Dict[str, Any] = {"A": self.A}
+        preconditioner's data under 'precond'. Solvers whose iterations
+        only SpMV against A (slim_A_ok) pass a layout-only view so
+        unused CSR payloads stay out of the solve program's HBM."""
+        A = self.A
+        if self.slim_A_ok and hasattr(A, "slim_for_spmv"):
+            A = A.slim_for_spmv()
+        d: Dict[str, Any] = {"A": A}
         if self.preconditioner is not None:
             d["precond"] = self.preconditioner.solve_data()
         return d

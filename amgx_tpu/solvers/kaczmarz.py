@@ -38,6 +38,7 @@ from .relaxation import safe_recip
 class KaczmarzSolver(Solver):
 
     is_smoother = True
+    slim_A_ok = False      # _project reads COO structure per sweep
 
     def __init__(self, cfg, scope="default", name="KACZMARZ"):
         super().__init__(cfg, scope, name)

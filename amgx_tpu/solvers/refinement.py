@@ -59,8 +59,11 @@ class RefinementSolver(Solver):
         self._inner_fn = self.preconditioner._build_solve_fn()
 
     def solve_data(self):
-        # overrides the base: the inner data is the f32 solve tree
-        return {"A": self.A, "inner": self.preconditioner.solve_data()}
+        # overrides the base: the inner data is the f32 solve tree; the
+        # outer operator is only ever SpMV'd (defect computation), so a
+        # layout-only view suffices
+        return {"A": self.A.slim_for_spmv(),
+                "inner": self.preconditioner.solve_data()}
 
     def computes_residual(self):
         return True

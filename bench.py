@@ -91,28 +91,31 @@ def bench_stream_ceiling():
     return 2 * rows * 128 * 4 / dt / 1e9
 
 
-def bench_flagship(n: int = 128):
+def bench_flagship(n: int = 128, tolerance: str = "1e-8", reps: int = 3):
     """REFINEMENT(FGMRES + GEO-aggregation AMG, f32 inner) on 7-pt
-    Poisson n^3, f64 system, true relative residual <= 1e-8. Setup AND
-    solve run entirely on the TPU (the jitted static-shape setup path)."""
+    Poisson n^3, f64 system, true relative residual <= tolerance. Setup
+    AND solve run entirely on the TPU (jitted static-shape setup)."""
     A = amgx.gallery.poisson("7pt", n, n, n).init()
     b = jnp.ones(A.num_rows)
-    slv = amgx.create_solver(Config.from_string(FLAGSHIP))
+    flagship = FLAGSHIP.replace("tolerance=1e-8", f"tolerance={tolerance}")
+    assert tolerance == "1e-8" or flagship != FLAGSHIP, \
+        "FLAGSHIP tolerance literal drifted; fix the replace target"
+    slv = amgx.create_solver(Config.from_string(flagship))
     t0 = time.perf_counter()
     slv.setup(A)
     setup_cold_s = time.perf_counter() - t0
     # warm setup: what resetup/compile-cached production runs see
-    slv2 = amgx.create_solver(Config.from_string(FLAGSHIP))
+    slv2 = amgx.create_solver(Config.from_string(flagship))
     t0 = time.perf_counter()
     slv2.setup(A)
     setup_s = time.perf_counter() - t0
     res = slv2.solve(b)                       # compile
     times = []
-    for _ in range(3):
+    for _ in range(reps):
         t0 = time.perf_counter()
         res = slv2.solve(b)
         times.append(time.perf_counter() - t0)
-    solve_s = sorted(times)[1]
+    solve_s = sorted(times)[len(times) // 2]
     rel = float(
         np.linalg.norm(np.asarray(amgx.ops.residual(A, res.x, b)))
         / np.linalg.norm(np.asarray(b)))
@@ -151,13 +154,34 @@ def main():
         value = spmv_s * 1e3
         metric = "poisson7pt_128^3 SpMV"
         unit = "ms"
-    print(json.dumps({
-        "metric": metric,
-        "value": value,
-        "unit": unit,
-        "vs_baseline": round(spmv_gbps / A100_HBM_GBPS, 4),
-        "extra": extra,
-    }))
+
+    def emit():
+        print(json.dumps({
+            "metric": metric,
+            "value": value,
+            "unit": unit,
+            "vs_baseline": round(spmv_gbps / A100_HBM_GBPS, 4),
+            "extra": extra,
+        }), flush=True)
+
+    # emit the headline line NOW, then attempt the 256^3 north star
+    # (BASELINE.md) and re-emit enriched: harness that read the last
+    # complete line get the north-star numbers; a timeout mid-256^3
+    # still leaves a valid headline line
+    emit()
+    try:
+        (sc, sw, ss, it, cv, rel) = bench_flagship(
+            256, tolerance="1e-10", reps=1)
+        extra.update({
+            "northstar_256^3_setup_warm_s": round(sw, 2),
+            "northstar_256^3_solve_s": round(ss, 3),
+            "northstar_256^3_outer_iters": it,
+            "northstar_256^3_converged": cv,
+            "northstar_256^3_true_rel_residual": rel,
+        })
+        emit()
+    except Exception:  # pragma: no cover - bench robustness
+        pass
 
 
 if __name__ == "__main__":
