@@ -107,8 +107,14 @@ def _register_default_parameters():
       ("INTERIOR", "OWNED", "FULL", "ALL"))
     R("separation_exterior", str, "calculation-limit view", "OWNED",
       ("INTERIOR", "OWNED", "FULL", "ALL"))
-    R("min_rows_latency_hiding", int, "rows below which latency hiding is off; <0 disables", -1)
-    R("exact_coarse_solve", int, "dense-LU coarse solve gathers global coarse matrix", 0, BOOL01)
+    R("min_rows_latency_hiding", int, "inert by design: the TPU build's "
+      "interior/halo split is structural (ShardMatrix) and XLA overlaps "
+      "the collective with the owned-part SpMV at every size, so there "
+      "is no kernel-split overhead to disable", -1)
+    R("exact_coarse_solve", int, "inert by design: the distributed "
+      "coarse solve is ALWAYS exact on TPU (all_gather + replicated "
+      "factorization, distributed/amg.py) - the stronger behavior the "
+      "reference gates behind this flag", 0, BOOL01)
     R("matrix_halo_exchange", int, "0 none / 1 diagonal / 2 full", 0)
     R("boundary_coloring", str, "boundary coloring handling", "SYNC_COLORS",
       ("FIRST", "SYNC_COLORS", "LAST"))
@@ -177,9 +183,13 @@ def _register_default_parameters():
     R("scaling_smoother_steps", int, "smoother steps before computing scale", 2)
     R("intensive_smoothing", int, "drastically increase smoothing", 0)
     # aggregation
-    R("coarseAgenerator", str, "Galerkin product method", "LOW_DEG",
+    R("coarseAgenerator", str, "Galerkin product method; all reference "
+      "choices compute the same product, so every name maps to the one "
+      "TPU implementation (sort/segment-sum, or the sort-free "
+      "structured path for GEO levels)", "LOW_DEG",
       ("LOW_DEG", "THRUST", "HYBRID"))
-    R("coarseAgenerator_coarse", str, "Galerkin method for coarser levels", "LOW_DEG")
+    R("coarseAgenerator_coarse", str, "Galerkin method for coarser levels "
+      "(same mapping as coarseAgenerator)", "LOW_DEG")
     R("interpolator", str, "classical interpolation", "D1")
     R("energymin_interpolator", str, "energymin interpolation", "EM")
     R("energymin_selector", str, "energymin selection", "CR")

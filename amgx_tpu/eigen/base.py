@@ -143,7 +143,25 @@ class EigenSolver:
             lam, vec, resid = self.finalize(data, final)
             scale = jnp.maximum(jnp.max(jnp.abs(lam)), 1e-30)
             conv = jnp.all(resid <= tol * scale)
-            return lam, vec, resid, final["iters"], conv
+            # pack scalars/small stats into ONE auxiliary output:
+            # remote/tunneled rigs pay a round trip per awaited buffer
+            # (see solvers/base.py)
+            rdt = jnp.promote_types(jnp.asarray(lam).dtype, jnp.float32)
+            if jnp.issubdtype(rdt, jnp.complexfloating):
+                rdt = jnp.float64
+                lam_flat = jnp.concatenate([jnp.real(lam), jnp.imag(lam)])
+                complex_lam = True
+            else:
+                lam_flat = jnp.ravel(lam)
+                complex_lam = False
+            stats = jnp.concatenate([
+                jnp.reshape(final["iters"].astype(rdt), (1,)),
+                jnp.reshape(conv.astype(rdt), (1,)),
+                lam_flat.astype(rdt), jnp.ravel(resid).astype(rdt)])
+            if vec is None:
+                vec = jnp.zeros((0,), stats.dtype)
+            self._complex_lam = complex_lam
+            return vec, stats
 
         return solve_fn
 
@@ -163,10 +181,22 @@ class EigenSolver:
         if key not in self._jit_cache:
             self._jit_cache[key] = jax.jit(self._build_solve_fn())
         t0 = time.perf_counter()
-        lam, vec, resid, iters, conv = self._jit_cache[key](
-            self.solve_data(), x0)
-        jax.block_until_ready(lam)
+        vec, stats = jax.block_until_ready(self._jit_cache[key](
+            self.solve_data(), x0))
         solve_time = time.perf_counter() - t0
+        stats = np.asarray(stats)                   # one host fetch
+        iters = int(stats[0])
+        conv = bool(stats[1])
+        body = stats[2:]
+        if getattr(self, "_complex_lam", False):
+            m = body.size // 3
+            lam = body[:m] + 1j * body[m:2 * m]
+            resid = body[2 * m:]
+        else:
+            m = body.size // 2
+            lam, resid = body[:m], body[m:]
+        if vec.size == 0:
+            vec = None
         lam, vec, resid, iters, conv = self.postprocess(
             lam, vec, resid, iters, conv)
         return EigenResult(

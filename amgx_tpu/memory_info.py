@@ -9,7 +9,10 @@ hooked into every allocation — XLA owns the allocator.
 """
 from __future__ import annotations
 
-_max_bytes = 0
+# single process-wide tracker: per-device high-water marks, so scoped
+# views (Resources over a device subset) and global views read the same
+# samples instead of maintaining parallel peaks that can disagree
+_peak_per_dev: dict = {}
 
 
 def sum_device_stats(devices) -> dict:
@@ -28,30 +31,49 @@ def sum_device_stats(devices) -> dict:
     return total
 
 
-def _current_bytes() -> int:
+def _sample(devices):
+    """Sample bytes_in_use per device, folding each into its peak;
+    returns (current_sum, peak_sum) over `devices`."""
+    cur_sum = 0
+    peak_sum = 0
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        cur = int(stats.get("bytes_in_use", 0)) if stats else 0
+        key = repr(d)
+        _peak_per_dev[key] = max(_peak_per_dev.get(key, 0), cur)
+        cur_sum += cur
+        peak_sum += _peak_per_dev[key]
+    return cur_sum, peak_sum
+
+
+def update_max_memory_usage(devices=None) -> int:
+    """Sample current device usage (all local devices by default), fold
+    into the per-device high-water marks, and return the current bytes
+    (updateMaxMemoryUsage analog)."""
     import jax
-    return int(sum_device_stats(jax.local_devices()).get(
-        "bytes_in_use", 0))
-
-
-def update_max_memory_usage() -> int:
-    """Sample current device usage, fold into the high-water mark, and
-    return the current bytes (updateMaxMemoryUsage analog)."""
-    global _max_bytes
-    cur = _current_bytes()
-    _max_bytes = max(_max_bytes, cur)
+    cur, _ = _sample(devices if devices is not None
+                     else jax.local_devices())
     return cur
 
 
+def usage_over(devices):
+    """(current, peak) bytes over the given devices, sharing the
+    process-wide per-device peaks."""
+    return _sample(devices)
+
+
 def get_max_memory_usage() -> int:
-    """High-water mark in bytes since process start / last reset."""
-    return _max_bytes
+    """High-water mark in bytes (sum of per-device peaks)."""
+    return sum(_peak_per_dev.values())
 
 
 def get_memory_usage_gb() -> float:
-    return _current_bytes() / 2**30
+    import jax
+    return _sample(jax.local_devices())[0] / 2**30
 
 
 def reset():
-    global _max_bytes
-    _max_bytes = 0
+    _peak_per_dev.clear()

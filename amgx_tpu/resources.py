@@ -76,13 +76,10 @@ class Resources:
         from .memory_info import sum_device_stats
         return sum_device_stats(self.devices)
 
-    _peak_bytes = 0
-
     def update_memory_usage(self):
-        """Sample current usage over this resources' devices and fold
-        into this resources' own high-water mark; returns (current,
-        peak) bytes (MemoryInfo::updateMaxMemoryUsage analog, scoped to
-        the resources like the reference's per-Resources pools)."""
-        cur = int(self.memory_stats().get("bytes_in_use", 0))
-        self._peak_bytes = max(self._peak_bytes, cur)
-        return cur, self._peak_bytes
+        """(current, peak) bytes over this resources' devices
+        (MemoryInfo::updateMaxMemoryUsage analog). Peaks are per-device
+        and process-wide, so samples taken elsewhere (e.g. during a
+        solve's stats print) are visible here too."""
+        from .memory_info import usage_over
+        return usage_over(self.devices)

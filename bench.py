@@ -155,33 +155,45 @@ def main():
         metric = "poisson7pt_128^3 SpMV"
         unit = "ms"
 
-    def emit():
-        print(json.dumps({
-            "metric": metric,
-            "value": value,
-            "unit": unit,
-            "vs_baseline": round(spmv_gbps / A100_HBM_GBPS, 4),
-            "extra": extra,
-        }), flush=True)
+    # the 256^3 north star (BASELINE.md), under a SIGALRM wall-clock
+    # budget so the single JSON line always prints even if this phase
+    # stalls on a slow rig
+    import signal
 
-    # emit the headline line NOW, then attempt the 256^3 north star
-    # (BASELINE.md) and re-emit enriched: harness that read the last
-    # complete line get the north-star numbers; a timeout mid-256^3
-    # still leaves a valid headline line
-    emit()
-    try:
-        (sc, sw, ss, it, cv, rel) = bench_flagship(
-            256, tolerance="1e-10", reps=1)
-        extra.update({
-            "northstar_256^3_setup_warm_s": round(sw, 2),
-            "northstar_256^3_solve_s": round(ss, 3),
-            "northstar_256^3_outer_iters": it,
-            "northstar_256^3_converged": cv,
-            "northstar_256^3_true_rel_residual": rel,
-        })
-        emit()
-    except Exception:  # pragma: no cover - bench robustness
+    class _Budget(Exception):
         pass
+
+    def _on_alarm(*_a):  # pragma: no cover - timing dependent
+        raise _Budget()
+
+    try:
+        old = signal.signal(signal.SIGALRM, _on_alarm)
+        signal.alarm(420)
+        try:
+            (sc, sw, ss, it, cv, rel) = bench_flagship(
+                256, tolerance="1e-10", reps=1)
+            extra.update({
+                "northstar_256^3_setup_warm_s": round(sw, 2),
+                "northstar_256^3_solve_s": round(ss, 3),
+                "northstar_256^3_outer_iters": it,
+                "northstar_256^3_converged": cv,
+                "northstar_256^3_true_rel_residual": rel,
+            })
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)
+    except _Budget:  # pragma: no cover - timing dependent
+        extra["northstar_error"] = "wall-clock budget exceeded"
+    except Exception as e:  # pragma: no cover - bench robustness
+        extra["northstar_error"] = str(e)[:200]
+
+    print(json.dumps({
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+        "vs_baseline": round(spmv_gbps / A100_HBM_GBPS, 4),
+        "extra": extra,
+    }))
 
 
 if __name__ == "__main__":
