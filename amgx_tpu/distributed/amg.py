@@ -170,6 +170,10 @@ def shard_amg(amg, n_ranks: int, axis: str):
         raise BadParametersError(
             "distributed AMG: K-cycles (CG/CGF) not yet supported; "
             "use cycle=V, W or F")
+    if amg.levels and amg.levels[0].A.is_block:
+        raise BadParametersError(
+            "distributed AMG: scalar matrices only (distributed Krylov + "
+            "block-Jacobi supports block systems)")
     if isinstance(amg.coarse_solver, DistributedCoarseSolver) or any(
             isinstance(lv, _ConsolidationBoundaryLevel)
             for lv in amg.levels):

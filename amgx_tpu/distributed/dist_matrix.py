@@ -47,7 +47,7 @@ import jax.numpy as jnp
                  "va_halo", "diag", "halo_src", "send_prev", "send_next",
                  "recv_prev", "recv_next", "a2a_send", "a2a_recv"],
     meta_fields=["n_global", "n_local", "n_local_cols", "n_halo", "n_ranks",
-                 "axis_name", "exchange_mode"],
+                 "axis_name", "exchange_mode", "bdimx", "bdimy"],
 )
 @dataclasses.dataclass(frozen=True)
 class ShardMatrix:
@@ -80,27 +80,31 @@ class ShardMatrix:
     n_ranks: int
     axis_name: str = "p"
     exchange_mode: str = "gather"
+    # original block dims: entries are stored scalar-expanded, but the
+    # block shape drives block-diagonal smoother applications and norms
+    bdimx: int = 1
+    bdimy: int = 1
 
     # -- operator interface (duck-typed CsrMatrix surface) ---------------
     @property
     def num_rows(self):
-        return self.n_local
+        return self.n_local // self.bdimx
 
     @property
     def num_cols(self):
-        return self.n_local_cols
+        return self.n_local_cols // self.bdimy
 
     @property
     def block_dimx(self):
-        return 1
+        return self.bdimx
 
     @property
     def block_dimy(self):
-        return 1
+        return self.bdimy
 
     @property
     def is_block(self):
-        return False
+        return self.bdimx * self.bdimy > 1
 
     @property
     def dtype(self):
@@ -176,4 +180,5 @@ def shard_matrix_from_partition(p, axis_name: str = "p") -> ShardMatrix:
         n_global=p.n_global, n_local=p.n_local,
         n_local_cols=p.n_local_cols, n_halo=p.n_halo,
         n_ranks=p.n_ranks, axis_name=axis_name,
-        exchange_mode=p.exchange_mode)
+        exchange_mode=p.exchange_mode, bdimx=p.block_dimx,
+        bdimy=p.block_dimy)

@@ -67,6 +67,11 @@ class DistPartition:
     n_halo: int
     n_ranks: int
     exchange_mode: str              # "ring" | "a2a" | "gather"
+    # original block dims (entries are expanded to scalars; block rows
+    # never split across shards) + per-block-row diagonal blocks
+    block_dimx: int = 1
+    block_dimy: int = 1
+    diag_block: Optional[jnp.ndarray] = None   # (R, nb_local, bx, by)
 
     @property
     def neighbor_only(self) -> bool:
@@ -78,15 +83,27 @@ def partition_matrix(A: CsrMatrix, n_ranks: int) -> DistPartition:
     (loadDistributedMatrix / create_B2L / renumber_to_local analog).
     Columns are partitioned by their own dimension, so rectangular
     transfer operators shard consistently with the vectors they act on."""
-    if A.is_block:
-        raise BadParametersError(
-            "distributed block matrices not yet supported; flatten blocks")
     if A.has_external_diag:
         raise BadParametersError("fold external diagonal before partitioning")
+    bx, by = A.block_dimx, A.block_dimy
+    diag_block_g = None
+    if A.is_block:
+        # expand b x b blocks to scalar entries (the scalar decomposition
+        # is exact); keep the block diagonal for block-exact smoothers
+        if bx != by:
+            raise BadParametersError(
+                "distributed block matrices must be square-blocked")
+        diag_block_g = np.asarray(A.diagonal())
+        A = _expand_blocks(A)
     n = A.num_rows
     m = A.num_cols
     n_local = -(-n // n_ranks)
     n_local_cols = -(-m // n_ranks)
+    if bx > 1:
+        # block rows must stay rank-local so block-diagonal smoother
+        # applications see whole blocks
+        n_local = -(-n_local // bx) * bx
+        n_local_cols = -(-n_local_cols // by) * by
     square = (n == m)
     row_offsets = np.asarray(A.row_offsets)
     col_indices = np.asarray(A.col_indices)
@@ -214,6 +231,16 @@ def partition_matrix(A: CsrMatrix, n_ranks: int) -> DistPartition:
         else:
             exchange_mode = "gather"
 
+    diag_block = None
+    if diag_block_g is not None:
+        nb_local = n_local // bx
+        pad = n_ranks * nb_local - diag_block_g.shape[0]
+        db = np.concatenate([
+            diag_block_g,
+            np.broadcast_to(np.eye(bx, dtype=diag_block_g.dtype),
+                            (pad, bx, bx))]) if pad else diag_block_g
+        diag_block = jnp.asarray(db.reshape(n_ranks, nb_local, bx, bx))
+
     return DistPartition(
         rid_own=jnp.asarray(rid_own), ci_own=jnp.asarray(ci_own),
         va_own=jnp.asarray(va_own), rid_halo=jnp.asarray(rid_hal),
@@ -224,14 +251,40 @@ def partition_matrix(A: CsrMatrix, n_ranks: int) -> DistPartition:
         a2a_send=a2a_send, a2a_recv=a2a_recv,
         n_global=n, n_global_cols=m, n_local=n_local,
         n_local_cols=n_local_cols, n_halo=max_halo, n_ranks=n_ranks,
-        exchange_mode=exchange_mode)
+        exchange_mode=exchange_mode, block_dimx=bx, block_dimy=by,
+        diag_block=diag_block)
 
 
-def partition_vector(v, n_ranks: int):
-    """Split + zero-pad a global vector into stacked (n_ranks, n_local)."""
+def _expand_blocks(A: CsrMatrix) -> CsrMatrix:
+    """Host-side expansion of a block-CSR matrix into the equivalent
+    scalar CSR (each b x b block becomes b^2 scalar entries). Exact: the
+    scalar operator is the same linear map over the flat vector."""
+    bx, by = A.block_dimx, A.block_dimy
+    rows, cols, vals = (np.asarray(x) for x in A.coo())
+    e = rows.shape[0]
+    r_s = (rows[:, None, None] * bx
+           + np.arange(bx)[None, :, None]).repeat(by, axis=2).reshape(-1)
+    c_s = (cols[:, None, None] * by
+           + np.arange(by)[None, None, :]).repeat(bx, axis=1).reshape(-1)
+    v_s = np.asarray(vals).reshape(e, bx, by).reshape(-1)
+    order = np.lexsort((c_s, r_s))
+    r_s, c_s, v_s = r_s[order], c_s[order], v_s[order]
+    n, m = A.num_rows * bx, A.num_cols * by
+    counts = np.bincount(r_s, minlength=n)
+    row_offsets = np.zeros(n + 1, np.int32)
+    np.cumsum(counts, out=row_offsets[1:])
+    return CsrMatrix.from_scipy_like(
+        row_offsets, c_s.astype(np.int32), jnp.asarray(v_s), n, m)
+
+
+def partition_vector(v, n_ranks: int, n_local: Optional[int] = None):
+    """Split + zero-pad a global vector into stacked (n_ranks, n_local).
+    Pass the partition's n_local for block systems (partition_matrix
+    rounds it up so block rows stay rank-local)."""
     v = np.asarray(v)
     n = v.shape[0]
-    n_local = -(-n // n_ranks)
+    if n_local is None:
+        n_local = -(-n // n_ranks)
     out = np.zeros((n_ranks, n_local), v.dtype)
     out.reshape(-1)[:n] = v
     return jnp.asarray(out)

@@ -90,6 +90,13 @@ class DistributedSolver:
         s = self.solver
         while s is not None:
             if s.name == "AMG":
+                if A.is_block:
+                    # fail fast: shard_amg would reject blocks anyway,
+                    # but only after the full global hierarchy build
+                    raise BadParametersError(
+                        "distributed AMG: scalar matrices only "
+                        "(distributed Krylov + block-Jacobi supports "
+                        "block systems)")
                 s.amg.setup(A)
             s.A = self.shard_A           # duck-typed operator view
             s = s.preconditioner
@@ -104,8 +111,18 @@ class DistributedSolver:
         def chain_data(s):
             d = {"A": self.shard_A}
             if s.name in ("BLOCK_JACOBI", "JACOBI"):
-                d["dinv"] = _dinv(self.part.diag)
+                if self.part.diag_block is not None:
+                    # block-exact Jacobi: batched inverse of the block
+                    # diagonal, partitioned by block rows
+                    from ..ops.dense import safe_inverse
+                    d["dinv"] = safe_inverse(self.part.diag_block)
+                else:
+                    d["dinv"] = _dinv(self.part.diag)
             elif s.name == "JACOBI_L1":
+                if self.part.diag_block is not None:
+                    raise BadParametersError(
+                        "distributed JACOBI_L1: scalar matrices only; "
+                        "use BLOCK_JACOBI for block systems")
                 d["dinv"] = _dinv_l1(self.part)
             elif s.name == "AMG":
                 from .amg import shard_amg
@@ -137,10 +154,11 @@ class DistributedSolver:
 
     def solve(self, b, x0=None) -> SolveResult:
         n = self.part.n_global
-        bl = partition_vector(np.asarray(b), self.n_ranks)
+        bl = partition_vector(np.asarray(b), self.n_ranks,
+                              self.part.n_local)
         xl = partition_vector(
             np.zeros(n, bl.dtype) if x0 is None else np.asarray(x0),
-            self.n_ranks)
+            self.n_ranks, self.part.n_local)
         if self._fn is None:
             self._fn = self._build_fn()
         t0 = time.perf_counter()

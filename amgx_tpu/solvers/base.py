@@ -315,7 +315,11 @@ class Solver:
                 if monitor:
                     rn_int = self.internal_res_norm(core)
                     if rn_int is not None:
-                        rn = rn_int
+                        # internal estimates (GMRES |g[i+1]|) are scalar;
+                        # broadcast to the monitored norm's shape (block
+                        # norms are per-component vectors)
+                        rn = jnp.broadcast_to(jnp.asarray(rn_int),
+                                              np.shape(norm0))
                     elif self.computes_residual():
                         rn = self._norm(core["r"])
                     else:

@@ -1,7 +1,11 @@
 """Benchmark entry point (run on the real TPU chip by the driver).
 
-Prints ONE JSON line:
+Prints the result JSON line
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
+immediately after the 128^3 headline phase, then re-prints it enriched
+with the optional 256^3 north-star numbers. CONSUMERS SHOULD TAKE THE
+LAST COMPLETE LINE of stdout: both lines are valid result objects, so a
+harness timeout during the 256^3 phase still leaves the headline.
 
 Headline: 7-pt Poisson 128^3 (2.1M rows) solved to a TRUE 1e-8 relative
 residual in full f64 accuracy — BASELINE.md milestone 3 scaled to one
@@ -155,9 +159,22 @@ def main():
         metric = "poisson7pt_128^3 SpMV"
         unit = "ms"
 
+    def emit():
+        print(json.dumps({
+            "metric": metric,
+            "value": value,
+            "unit": unit,
+            "vs_baseline": round(spmv_gbps / A100_HBM_GBPS, 4),
+            "extra": extra,
+        }), flush=True)
+
+    # headline line first: if the optional 256^3 phase stalls past every
+    # guard (SIGALRM cannot interrupt a hung native XLA call) and the
+    # harness kills the process, a valid result line already exists.
+    # Consumers take the LAST complete line (see module docstring).
+    emit()
     # the 256^3 north star (BASELINE.md), under a SIGALRM wall-clock
-    # budget so the single JSON line always prints even if this phase
-    # stalls on a slow rig
+    # budget as the in-process guard
     import signal
 
     class _Budget(Exception):
@@ -186,14 +203,7 @@ def main():
         extra["northstar_error"] = "wall-clock budget exceeded"
     except Exception as e:  # pragma: no cover - bench robustness
         extra["northstar_error"] = str(e)[:200]
-
-    print(json.dumps({
-        "metric": metric,
-        "value": value,
-        "unit": unit,
-        "vs_baseline": round(spmv_gbps / A100_HBM_GBPS, 4),
-        "extra": extra,
-    }))
+    emit()                  # final (enriched) line
 
 
 if __name__ == "__main__":
