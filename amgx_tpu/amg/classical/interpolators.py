@@ -35,6 +35,41 @@ def _coarse_index(cf_map):
     return jnp.where(is_c, cidx, -1), nc
 
 
+def _compact_coo(rows, cols, vals, mask, n, num_cols=None):
+    """Device compaction of masked COO entries into an exact-size CSR:
+    one host scalar sync (the count) + a sized nonzero gather — the
+    static-shape idiom the aggregation Galerkin uses, replacing the
+    round-1 host-numpy compress."""
+    u = int(jnp.sum(mask))                       # one sync
+    m = num_cols if num_cols is not None else n
+    if u == 0:
+        return CsrMatrix.from_scipy_like(
+            jnp.zeros((n + 1,), jnp.int32), jnp.zeros((0,), jnp.int32),
+            jnp.zeros((0,), vals.dtype), n, m)
+    idx = jnp.nonzero(mask, size=u)[0]           # ascending -> CSR order
+    r = rows[idx].astype(jnp.int32)
+    c = cols[idx].astype(jnp.int32)
+    v = vals[idx]
+    counts = jnp.bincount(r, length=n)
+    ro = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                          jnp.cumsum(counts).astype(jnp.int32)])
+    return CsrMatrix.from_scipy_like(ro, c, v, n, m)
+
+
+def _coo_member(keys_sorted, key_vals, ri, cj, n):
+    """(ri, cj) membership against a row-major-sorted COO whose value is
+    a positive indicator — binary search, no compaction or sort. Entries
+    with non-positive values (masked/padded) never match because
+    searchsorted('left') lands on the first occurrence of a key, which
+    holds the coalesced sum."""
+    key = ri.astype(jnp.int64) * n + cj.astype(jnp.int64)
+    if keys_sorted.shape[0] == 0:
+        return jnp.zeros(key.shape, bool)
+    pos = jnp.clip(jnp.searchsorted(keys_sorted, key), 0,
+                   keys_sorted.shape[0] - 1)
+    return (keys_sorted[pos] == key) & (key_vals[pos] > 0)
+
+
 class Interpolator:
     def __init__(self, cfg, scope):
         self.cfg = cfg
@@ -80,45 +115,25 @@ class Distance2Interpolator(Interpolator):
         strongC = strong & is_C[cols]
         strongF = strong & ~is_C[cols] & offd
 
-        def filtered(mask):
-            """CSR keeping only masked entries (host-side compress)."""
-            m = np.asarray(mask)
-            r = np.asarray(rows)[m]
-            c = np.asarray(cols)[m]
-            v = np.asarray(vals)[m]
-            counts = np.bincount(r, minlength=n)
-            ro = np.zeros(n + 1, np.int32)
-            np.cumsum(counts, out=ro[1:])
-            return CsrMatrix.from_scipy_like(ro, c.astype(np.int32),
-                                             jnp.asarray(v), n, n)
-
-        Fmat = filtered(strongF)                  # i -> k (strong F)
-        Abar = filtered(neg)                      # k -> m (neg couplings)
+        Fmat = _compact_coo(rows, cols, vals, strongF, n)  # i -> k
+        Abar = _compact_coo(rows, cols, vals, neg, n)      # k -> m
 
         # C-hat membership set: strong C neighbors + two-hop through F
-        Sc01 = filtered(strongC)
-        Sc01 = CsrMatrix.from_scipy_like(
-            Sc01.row_offsets, Sc01.col_indices,
-            jnp.ones_like(Sc01.values), n, n)
+        Sc01 = _compact_coo(rows, cols, jnp.ones_like(vals), strongC, n)
         Sf01 = CsrMatrix.from_scipy_like(
             Fmat.row_offsets, Fmat.col_indices,
             jnp.ones_like(Fmat.values), n, n)
         H = csr_multiply(Sf01, Sc01)
         hr, hc, hv = H.coo()
-        scr, scc, _ = Sc01.coo()
-        chat_keys = np.unique(np.concatenate([
-            np.asarray(scr, np.int64) * n + np.asarray(scc),
-            np.asarray(hr, np.int64)[np.asarray(hv) > 0] * n
-            + np.asarray(hc)[np.asarray(hv) > 0]]))
-        chat_keys_j = jnp.asarray(chat_keys)
+        scr, scc, scv = Sc01.coo()
+        # both COO sets are row-major sorted: membership = binary search
+        # in either (no host unique/merge)
+        keys_sc = scr.astype(jnp.int64) * n + scc.astype(jnp.int64)
+        keys_h = hr.astype(jnp.int64) * n + hc.astype(jnp.int64)
 
         def member(ri, cj):
-            key = ri.astype(jnp.int64) * n + cj.astype(jnp.int64)
-            pos = jnp.clip(jnp.searchsorted(chat_keys_j, key), 0,
-                           max(len(chat_keys) - 1, 0))
-            if len(chat_keys) == 0:
-                return jnp.zeros(key.shape, bool)
-            return chat_keys_j[pos] == key
+            return (_coo_member(keys_sc, scv, ri, cj, n)
+                    | _coo_member(keys_h, hv, ri, cj, n))
 
         # two-hop triples (i -k-> m)
         t_rows, t_m, src_f, src_b = _expand(Fmat, Abar)
@@ -207,20 +222,6 @@ class Distance1Interpolator(Interpolator):
         return _truncate(P, self.trunc_factor, self.max_elements)
 
 
-def _filtered_csr(n, rows, cols, vals, mask) -> CsrMatrix:
-    """CSR keeping only masked COO entries (host-side compress; runs once
-    per setup)."""
-    m = np.asarray(mask)
-    r = np.asarray(rows)[m]
-    c = np.asarray(cols)[m]
-    v = np.asarray(vals)[m]
-    counts = np.bincount(r, minlength=n)
-    ro = np.zeros(n + 1, np.int32)
-    np.cumsum(counts, out=ro[1:])
-    return CsrMatrix.from_scipy_like(ro, c.astype(np.int32),
-                                     jnp.asarray(v), n, n)
-
-
 @registry.interpolators.register("MULTIPASS")
 class MultipassInterpolator(Interpolator):
     """Multipass interpolation for aggressive coarsening
@@ -270,15 +271,14 @@ class MultipassInterpolator(Interpolator):
             if bool(jnp.all(new == pnum)):
                 break
             pnum = new
-        pnp = np.asarray(pnum)
-        reachable = pnp < BIG
-        max_pass = int(pnp[reachable].max()) if reachable.any() else 0
+        max_pass = int(jnp.max(jnp.where(pnum < BIG, pnum, 0)))
 
         # accumulate P rows pass by pass (C rows: injection)
-        c_rows = np.where(np.asarray(is_C))[0].astype(np.int32)
-        p_rows = [jnp.asarray(c_rows)]
-        p_cols = [jnp.asarray(np.asarray(cidx)[c_rows])]
-        p_vals = [jnp.ones((len(c_rows),), vals.dtype)]
+        nc_i = int(jnp.sum(is_C))
+        c_rows = jnp.nonzero(is_C, size=max(nc_i, 1))[0].astype(jnp.int32)
+        p_rows = [c_rows[:nc_i]]
+        p_cols = [cidx[c_rows[:nc_i]]]
+        p_vals = [jnp.ones((nc_i,), vals.dtype)]
 
         for p in range(1, max_pass + 1):
             in_pass = pnum == p
@@ -290,17 +290,18 @@ class MultipassInterpolator(Interpolator):
                               sum_neg / jnp.where(denom == 0, 1.0, denom),
                               0.0)
             scale = -alpha / jnp.where(dmod == 0, 1.0, dmod)
-            Ap = _filtered_csr(n, rows, cols, vals, emask)
+            Ap = _compact_coo(rows, cols, vals, emask, n)
             # current P (global-column space n x nc)
             P_cur = CsrMatrix.from_coo(
                 jnp.concatenate(p_rows), jnp.concatenate(p_cols),
                 jnp.concatenate(p_vals), n, nc)
             raw = csr_multiply(Ap, P_cur)
             rr, rc, rv = raw.coo()
-            keep = rv != 0
-            p_rows.append(rr[keep])
-            p_cols.append(rc[keep])
-            p_vals.append((rv * scale[rr])[keep])
+            u = int(jnp.sum(rv != 0))            # one sync per pass
+            idx = jnp.nonzero(rv != 0, size=max(u, 1))[0]
+            p_rows.append(rr[idx][:u])
+            p_cols.append(rc[idx][:u])
+            p_vals.append((rv * scale[rr])[idx][:u])
 
         P = CsrMatrix.from_coo(
             jnp.concatenate(p_rows), jnp.concatenate(p_cols),
@@ -323,18 +324,16 @@ def _truncate(P: CsrMatrix, factor: float, max_elements: int) -> CsrMatrix:
         keep &= absv >= factor * rmax[rows]
     if max_elements > 0:
         # keep only the max_elements largest |entries| per row: rank by
-        # (row, -|v|) and drop ranks beyond the cap (host-side; the
-        # entry count is per-level-small and this runs once at setup)
-        rnp = np.asarray(rows)
-        ordn = np.lexsort((-np.asarray(absv), rnp))
-        _, first = np.unique(rnp[ordn], return_index=True)
-        grp = np.zeros(len(ordn), np.int64)
-        grp[first] = 1
-        gid = np.cumsum(grp) - 1
-        within = np.arange(len(ordn)) - first[gid]
-        keep_np = np.array(keep)        # copy: jax buffers are read-only
-        keep_np[ordn] &= within < max_elements
-        keep = jnp.asarray(keep_np)
+        # (row, -|v|) via two stable device argsorts (the int32 lexsort
+        # idiom), then cap the within-row rank
+        e = rows.shape[0]
+        order1 = jnp.argsort(-absv, stable=True)
+        order2 = jnp.argsort(rows[order1], stable=True)
+        ordn = order1[order2]                    # grouped by row, desc |v|
+        pos = jnp.arange(e, dtype=jnp.int32)
+        first = jax.ops.segment_min(pos, rows[ordn], num_segments=n)
+        within = pos - first[rows[ordn]]
+        keep = keep.at[ordn].set(keep[ordn] & (within < max_elements))
     # rescale kept entries to preserve row sums
     rowsum = jax.ops.segment_sum(vals, rows, num_segments=n,
                                  indices_are_sorted=True)
@@ -342,8 +341,5 @@ def _truncate(P: CsrMatrix, factor: float, max_elements: int) -> CsrMatrix:
                                   num_segments=n, indices_are_sorted=True)
     scale = rowsum / jnp.where(keptsum == 0, 1.0, keptsum)
     scale = jnp.where(keptsum == 0, 1.0, scale)
-    kn = np.asarray(keep)
-    rows_k = np.asarray(rows)[kn]
-    cols_k = np.asarray(cols)[kn]
-    vals_k = np.asarray(vals * scale[rows])[kn]
-    return CsrMatrix.from_coo(rows_k, cols_k, vals_k, n, P.num_cols)
+    return _compact_coo(rows, cols, vals * scale[rows], keep, P.num_rows,
+                        num_cols=P.num_cols)
