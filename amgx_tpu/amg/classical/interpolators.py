@@ -154,13 +154,15 @@ class Distance2Interpolator(Interpolator):
         e_rows = t_i[m_is_entry]
         e_cols = t_m[m_is_entry]
         e_vals = contrib[m_is_entry]
-        # direct part: a_ij for neighbors j in C-hat
-        dmask = offd & is_C[cols] & member(rows, cols)
+        # direct part: a_ij for neighbors j in C-hat (evaluated once,
+        # shared with the weak-lumping mask below)
+        in_chat = member(rows, cols)
+        dmask = offd & is_C[cols] & in_chat
         # diagonal D_i: weak lumping + the "+i" feedback terms
         fb = jax.ops.segment_sum(
             jnp.where(keep & (t_m == t_i), contrib, 0.0), t_i,
             num_segments=n)
-        lump_mask = offd & ~member(rows, cols) & ~strongF
+        lump_mask = offd & ~in_chat & ~strongF
         lump = jax.ops.segment_sum(jnp.where(lump_mask, vals, 0.0), rows,
                                    num_segments=n, indices_are_sorted=True)
         # strong-F neighbors whose denominator collapsed: lump them too

@@ -45,9 +45,10 @@ FLAGSHIP = (
     " preconditioner(in)=FGMRES, in:max_iters=60, in:monitor_residual=1,"
     " in:tolerance=1e-6, in:gmres_n_restart=10, in:convergence=RELATIVE_INI,"
     " in:norm=L2, in:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION,"
-    " amg:selector=GEO, amg:smoother=BLOCK_JACOBI, amg:relaxation_factor=0.75,"
-    " amg:presweeps=0, amg:postsweeps=3, amg:max_iters=1, amg:cycle=V,"
-    " amg:max_levels=50, amg:min_coarse_rows=32")
+    " amg:selector=GEO, amg:smoother=CHEBYSHEV_POLY,"
+    " amg:chebyshev_polynomial_order=2, amg:presweeps=1, amg:postsweeps=1,"
+    " amg:max_iters=1, amg:cycle=V, amg:max_levels=50,"
+    " amg:min_coarse_rows=32")
 
 
 def bench_spmv(n: int = 128, reps: int = 50):
@@ -149,7 +150,8 @@ def main():
             "flagship_128^3_outer_iters": iters,
             "flagship_128^3_converged": conv,
             "flagship_128^3_true_rel_residual": rel,
-            "flagship_config": "REFINEMENT[f64] -> FGMRES+GEO-AggAMG[f32]",
+            "flagship_config":
+                "REFINEMENT[f64] -> FGMRES+GEO-AggAMG[f32]+Cheb2",
         })
         value = solve_s
         metric = "poisson7pt_128^3 refined FGMRES+AggAMG solve to 1e-8 (f64)"
@@ -193,13 +195,19 @@ def main():
         except Exception as e:  # pragma: no cover - bench robustness
             extra["northstar_error"] = str(e)[:200]
 
+    # single line by contract (an unknown driver parser may json.loads
+    # the whole stdout). Residual risk accepted: a native-XLA hang in
+    # the gated 256^3 phase that SIGALRM cannot interrupt would lose the
+    # line - but such a hang would have already killed the identical
+    # 128^3 phase, and inter-dispatch stalls (the observed failure mode
+    # on tunneled rigs) are covered by the alarm.
     print(json.dumps({
         "metric": metric,
         "value": value,
         "unit": unit,
         "vs_baseline": round(spmv_gbps / A100_HBM_GBPS, 4),
         "extra": extra,
-    }))
+    }), flush=True)
 
 
 if __name__ == "__main__":
