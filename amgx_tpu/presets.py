@@ -1,0 +1,19 @@
+"""Named solver presets (side-effect-free; safe to import anywhere).
+
+FLAGSHIP is the configuration the benchmarks and the driver entry use:
+full f64 accuracy via defect correction (REFINEMENT) around an f32
+FGMRES + GEO-aggregation AMG V-cycle with Chebyshev-polynomial
+smoothing — the TPU-optimal shape for structured (stencil) systems.
+See README.md "TPU-first design" for why each piece is chosen.
+"""
+
+FLAGSHIP = (
+    "solver=REFINEMENT, max_iters=20, monitor_residual=1, tolerance=1e-8,"
+    " convergence=RELATIVE_INI, norm=L2,"
+    " preconditioner(in)=FGMRES, in:max_iters=60, in:monitor_residual=1,"
+    " in:tolerance=1e-6, in:gmres_n_restart=10, in:convergence=RELATIVE_INI,"
+    " in:norm=L2, in:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION,"
+    " amg:selector=GEO, amg:smoother=CHEBYSHEV_POLY,"
+    " amg:chebyshev_polynomial_order=2, amg:presweeps=1, amg:postsweeps=1,"
+    " amg:max_iters=1, amg:cycle=V, amg:max_levels=50,"
+    " amg:min_coarse_rows=32")

@@ -39,16 +39,7 @@ from amgx_tpu.config import Config  # noqa: E402
 
 A100_HBM_GBPS = 1555.0  # A2 SXM A100-40GB peak memory bandwidth
 
-FLAGSHIP = (
-    "solver=REFINEMENT, max_iters=20, monitor_residual=1, tolerance=1e-8,"
-    " convergence=RELATIVE_INI, norm=L2,"
-    " preconditioner(in)=FGMRES, in:max_iters=60, in:monitor_residual=1,"
-    " in:tolerance=1e-6, in:gmres_n_restart=10, in:convergence=RELATIVE_INI,"
-    " in:norm=L2, in:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION,"
-    " amg:selector=GEO, amg:smoother=CHEBYSHEV_POLY,"
-    " amg:chebyshev_polynomial_order=2, amg:presweeps=1, amg:postsweeps=1,"
-    " amg:max_iters=1, amg:cycle=V, amg:max_levels=50,"
-    " amg:min_coarse_rows=32")
+from amgx_tpu.presets import FLAGSHIP  # noqa: E402
 
 
 def bench_spmv(n: int = 128, reps: int = 50):

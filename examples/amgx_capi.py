@@ -6,9 +6,10 @@ Line-for-line analog of the reference CLI example
 initialize, register a print callback, create config/resources/matrix/
 vectors/solver, read the system, setup, solve, report, destroy.
 
-Usage:
-    python examples/amgx_capi.py -m <matrix.mtx> -c <config.json>
-        [-mode dDDI] [-it <max_iters>]
+Usage (examples/matrix.mtx is the shipped 12-row demo system, the
+analog of the reference's examples/matrix.mtx):
+    python examples/amgx_capi.py -m examples/matrix.mtx \
+        -c configs/FGMRES_AGGREGATION.json [-mode dDDI] [-it <max_iters>]
 """
 import argparse
 import sys
