@@ -68,6 +68,14 @@ def create_solver(cfg: Config, scope: str = "default"):
     return make_solver(name, cfg, child_scope)
 
 
+def create_eigensolver(cfg: Config, scope: str = "default"):
+    """Build an eigensolver from a config (AMG_EigenSolver analog,
+    src/amg_eigensolver.cu; configs/eigen_configs presets)."""
+    initialize()
+    from .eigen import create_eigensolver as _ces
+    return _ces(cfg, scope)
+
+
 __version__ = "0.1.0"
 # API-parity version info (AMGX_get_api_version)
 API_VERSION = (2, 0)

@@ -27,8 +27,12 @@ def apply_coarse_solver(cs, data, bc, xc, coarsest_sweeps: int):
     `coarsest_sweeps` sweeps (reference parameter); direct/Krylov coarse
     solvers use their own apply. Shared with the distributed coarse
     solver so both paths stay in lockstep."""
-    if cs.is_smoother and cs.name not in ("DENSE_LU_SOLVER", "NOSOLVER",
-                                          "DUMMY"):
+    if cs.name in ("NOSOLVER", "DUMMY"):
+        # Dummy_Solver zero-fills x (dummy_solver.cu:22-31): NOSOLVER as
+        # coarse solver means *no coarse correction*, not identity —
+        # injecting the raw coarse residual destabilizes the cycle
+        return xc
+    if cs.is_smoother and cs.name != "DENSE_LU_SOLVER":
         return cs.smooth(data, bc, xc, coarsest_sweeps)
     return cs.apply(data, bc)
 

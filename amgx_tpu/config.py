@@ -152,7 +152,7 @@ def _register_default_parameters():
     R("kpz_mu", int, "KPZ polynomial mu", 4)
     R("kpz_order", int, "KPZ polynomial order", 3)
     R("chebyshev_polynomial_order", int, "Chebyshev smoother order", 5)
-    R("chebyshev_lambda_estimate_mode", int, "eigenvalue estimation mode", 0, None, 0, 2)
+    R("chebyshev_lambda_estimate_mode", int, "eigenvalue estimation mode", 0, None, 0, 3)
     R("cheby_max_lambda", float, "max-eigenvalue guess", 1.0, None, 0.0, 1.0e20)
     R("cheby_min_lambda", float, "min-eigenvalue guess", 0.125, None, 0.0, 1.0e20)
     R("kaczmarz_coloring_needed", int, "multicolor Kaczmarz", 1)
@@ -174,6 +174,9 @@ def _register_default_parameters():
     R("coarsest_sweeps", int, "smoothing iterations at coarsest level", 2)
     R("cycle_iters", int, "CG-cycle inner iterations", 2)
     R("structure_reuse_levels", int, "hierarchy reuse depth on resetup", 0)
+    R("distributed_setup_mode", str, "distributed AMG hierarchy build: "
+      "per-shard (sharded), controller-global (global), or best "
+      "available (auto)", "auto", {"auto", "sharded", "global"})
     R("amg_precision", str, "precision of the stored hierarchy + cycle "
       "(TPU-native mixed-precision preconditioning, the dDFI-mode analog: "
       "a float32/bfloat16 cycle inside an f64 flexible Krylov solver)",
@@ -349,6 +352,8 @@ class Config:
                 continue
             if item.startswith("config_version"):
                 continue
+            if item.split("=", 1)[0].strip().endswith(":config_version"):
+                continue  # scoped spelling (eigen_configs/JACOBI_DAVIDSON)
             m = _FLAT_RE.match(item)
             if not m:
                 raise BadConfigurationError(f"cannot parse config entry {item!r}")

@@ -84,9 +84,12 @@ class DistributedSolver:
         self.shard_A = shard_matrix_from_partition(part, self.axis)
         self.part = part
         # wire the solver chain: A views + per-shard Jacobi data. AMG
-        # members build their hierarchy on the GLOBAL matrix (setup is a
-        # once-per-structure controller phase), then every level is
-        # sharded for SPMD cycles (distributed/amg.py).
+        # members build their hierarchy SHARDED when the config supports
+        # it (distributed/setup.py — per-rank level build, no global
+        # coarse operator); otherwise the hierarchy is built on the
+        # GLOBAL matrix on the controller, then every level is sharded
+        # (distributed/amg.py — the round-2 fallback path).
+        self._sharded_amg = {}
         s = self.solver
         while s is not None:
             if s.name == "AMG":
@@ -97,13 +100,39 @@ class DistributedSolver:
                         "distributed AMG: scalar matrices only "
                         "(distributed Krylov + block-Jacobi supports "
                         "block systems)")
-                s.amg.setup(A)
+                data = self._try_sharded_setup(s)
+                if data is not None:
+                    self._sharded_amg[id(s)] = data
+                else:
+                    s.amg.setup(A)
             s.A = self.shard_A           # duck-typed operator view
             s = s.preconditioner
         self._data = self._build_data()
         self._fn = None
         self.setup_time = time.perf_counter() - t0
         return self
+
+    def _try_sharded_setup(self, s):
+        """Run the per-shard hierarchy build when the config supports it
+        (distributed_setup_mode=auto|sharded). Returns the stacked AMG
+        solve-data, or None to fall back to the global-setup path."""
+        from .setup import build_sharded_hierarchy, sharded_eligible
+        mode = str(self.cfg.get("distributed_setup_mode", s.amg.scope))
+        if mode == "global":
+            return None
+        reason = sharded_eligible(s.amg, self.shard_A)
+        if reason is not None:
+            if mode == "sharded":
+                raise BadParametersError(
+                    f"distributed_setup_mode=sharded: {reason}")
+            return None
+        data = build_sharded_hierarchy(s.amg, self.shard_A, self.mesh,
+                                       self.axis)
+        if data is None and mode == "sharded":
+            raise BadParametersError(
+                "distributed_setup_mode=sharded: problem too small for "
+                "one sharded level (fits a single shard's budget)")
+        return data
 
     def _build_data(self):
         """Hand-build the solve-data pytree (stacked arrays); per-shard
@@ -125,8 +154,11 @@ class DistributedSolver:
                         "use BLOCK_JACOBI for block systems")
                 d["dinv"] = _dinv_l1(self.part)
             elif s.name == "AMG":
-                from .amg import shard_amg
-                d["amg"] = shard_amg(s.amg, self.n_ranks, self.axis)
+                if id(s) in self._sharded_amg:
+                    d["amg"] = self._sharded_amg[id(s)]
+                else:
+                    from .amg import shard_amg
+                    d["amg"] = shard_amg(s.amg, self.n_ranks, self.axis)
             if s.preconditioner is not None:
                 d["precond"] = chain_data(s.preconditioner)
             return d

@@ -161,9 +161,13 @@ class PBiCGStabSolver(_KrylovBase):
 
 @registry.solvers.register("CHEBYSHEV")
 class ChebyshevSolver(_KrylovBase):
-    """Chebyshev iteration (cheb_solver.cu) with eigenvalue-estimation
-    modes: 0 = user guesses (cheby_max_lambda/cheby_min_lambda), 1/2 =
-    power iteration on D^{-1}A at setup."""
+    """Chebyshev iteration (cheb_solver.cu:150-216) with eigenvalue-
+    estimation modes: 0/1 = power iteration on the (preconditioned)
+    operator at setup (mode 0's separate lmin eigensolve collapses to the
+    lmax/8 smoothing interval here — one power sweep, documented
+    deviation); 2 = Gershgorin max row sum (0.9 under a preconditioner);
+    3 = user cheby_max_lambda/cheby_min_lambda under a preconditioner,
+    Gershgorin otherwise."""
 
     uses_preconditioner = True
     is_smoother = True
@@ -176,14 +180,30 @@ class ChebyshevSolver(_KrylovBase):
         self.lmin = float(cfg.get("cheby_min_lambda", scope))
 
     def solver_setup(self):
-        if self.estimate_mode > 0:
+        mode = self.estimate_mode
+        has_precond = self.preconditioner is not None
+        if mode in (0, 1):
             precond_apply = None
-            if self.preconditioner is not None:
+            if has_precond:
                 pdata = self.preconditioner.solve_data()
                 precond_apply = lambda v: self.preconditioner.apply(pdata, v)
             lmax = _power_lambda_max(self.A, precond_apply)
             self.lmax = float(lmax) * 1.05
             self.lmin = self.lmax / 8.0  # standard smoothing interval
+        elif mode == 2:
+            if has_precond:
+                # reference assumption: preconditioner compresses the
+                # spectrum to ~1 (cheb_solver.cu:193-196)
+                self.lmax = 0.9
+            else:
+                self.lmax = float(_gershgorin_lambda_max(self.A))
+            self.lmin = self.lmax * 0.125
+        elif mode == 3:
+            if has_precond:
+                pass  # user-provided cheby_max_lambda / cheby_min_lambda
+            else:
+                self.lmax = float(_gershgorin_lambda_max(self.A))
+                self.lmin = self.lmax * 0.125
         self._d = (self.lmax + self.lmin) / 2.0
         self._c = (self.lmax - self.lmin) / 2.0
 
@@ -209,6 +229,19 @@ class ChebyshevSolver(_KrylovBase):
                       rho_new * rho * p + (2.0 * rho_new / c) * z)
         x = x + p
         return {**st, "x": x, "p": p, "rho": rho_new, "k": k + 1}
+
+
+def _gershgorin_lambda_max(A):
+    """Max diag-scaled absolute row sum — the reference's
+    compute_eigenmax_estimate (cheb_solver.cu:46-74) lambda bound."""
+    absA = A.with_values(jnp.abs(A.values),
+                         jnp.abs(A.diag) if A.has_external_diag else None)
+    n = A.num_rows * A.block_dimx
+    row_abs = spmv(absA, jnp.ones(n, dtype=A.dtype))
+    d = A.diagonal()
+    if d.ndim == 3:  # block diagonal -> per-unknown diagonal entries
+        d = jnp.diagonal(d, axis1=1, axis2=2).reshape(-1)
+    return jnp.max(row_abs / jnp.abs(d))
 
 
 def _power_lambda_max(A, precond_apply=None, iters: int = 20, seed: int = 0):

@@ -42,22 +42,39 @@ A100_HBM_GBPS = 1555.0  # A2 SXM A100-40GB peak memory bandwidth
 from amgx_tpu.presets import FLAGSHIP  # noqa: E402
 
 
-def bench_spmv(n: int = 128, reps: int = 50):
+def bench_spmv_vs_ceiling(n: int = 128, reps: int = 50, samples: int = 5):
     """SpMV GB/s on 7-pt Poisson n^3 (DIA layout, float32: the
-    bandwidth-bound regime the reference's csrmv lives in)."""
+    bandwidth-bound regime the reference's csrmv lives in), measured
+    against the plain-XLA streaming ceiling of the same rig in the SAME
+    pass: the tunnel's effective bandwidth fluctuates 2x run to run, so
+    the two loops are timed interleaved, best-of-N each, and the ratio —
+    not either absolute number — is the stable efficiency metric."""
     A = amgx.gallery.poisson("7pt", n, n, n, dtype=np.float32).init()
     x = jnp.ones(A.num_rows, jnp.float32)
 
     @jax.jit
-    def loop(x):
+    def spmv_loop(x):
         def body(_, x):
             return amgx.ops.spmv(A, x) * (1.0 / 6.0)
         return jax.lax.fori_loop(0, reps, body, x)
 
-    loop(x).block_until_ready()              # compile
-    t0 = time.perf_counter()
-    loop(x).block_until_ready()
-    dt = (time.perf_counter() - t0) / reps
+    rows = 256 * 1024 * 1024 // (128 * 4)
+    v = jnp.ones((rows, 128), jnp.float32)
+
+    @jax.jit
+    def stream_loop(v):
+        return jax.lax.fori_loop(0, 10, lambda _, x: x * 1.000001, v)
+
+    spmv_loop(x).block_until_ready()         # compile
+    stream_loop(v).block_until_ready()
+    spmv_dt, stream_dt = float("inf"), float("inf")
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        spmv_loop(x).block_until_ready()
+        spmv_dt = min(spmv_dt, (time.perf_counter() - t0) / reps)
+        t0 = time.perf_counter()
+        stream_loop(v).block_until_ready()
+        stream_dt = min(stream_dt, (time.perf_counter() - t0) / 10)
     # honest bytes model: each value read once, x read once, y written
     # once (the Pallas DIA kernel achieves exactly this traffic)
     n_rows = A.num_rows
@@ -66,25 +83,9 @@ def bench_spmv(n: int = 128, reps: int = 50):
         bytes_moved = (k * n_rows + 2 * n_rows) * 4
     else:
         bytes_moved = A.ell_cols.size * (4 + 4) + A.num_rows * 4 * 2
-    return bytes_moved / dt / 1e9, dt
-
-
-def bench_stream_ceiling():
-    """Measured streaming ceiling of this rig (read+write of a 256 MB
-    array inside one compiled loop) — the honest denominator for SpMV
-    efficiency when the chip sits behind a bandwidth-limited tunnel."""
-    rows = 256 * 1024 * 1024 // (128 * 4)
-    v = jnp.ones((rows, 128), jnp.float32)
-
-    @jax.jit
-    def loop(v):
-        return jax.lax.fori_loop(0, 10, lambda _, x: x * 1.000001, v)
-
-    loop(v).block_until_ready()
-    t0 = time.perf_counter()
-    loop(v).block_until_ready()
-    dt = (time.perf_counter() - t0) / 10
-    return 2 * rows * 128 * 4 / dt / 1e9
+    spmv_gbps = bytes_moved / spmv_dt / 1e9
+    ceiling_gbps = 2 * rows * 128 * 4 / stream_dt / 1e9
+    return spmv_gbps, spmv_dt, ceiling_gbps
 
 
 def bench_flagship(n: int = 128, tolerance: str = "1e-8", reps: int = 3):
@@ -123,15 +124,15 @@ def main():
     t_start = time.perf_counter()
     amgx.initialize()
     extra = {}
-    spmv_gbps, spmv_s = bench_spmv()
-    extra["spmv_7pt_128^3_f32_gbps"] = round(spmv_gbps, 2)
-    extra["spmv_7pt_128^3_f32_ms"] = round(spmv_s * 1e3, 4)
+    spmv_gbps, spmv_s = 0.0, 1.0
     try:
-        ceiling = bench_stream_ceiling()
+        spmv_gbps, spmv_s, ceiling = bench_spmv_vs_ceiling()
+        extra["spmv_7pt_128^3_f32_gbps"] = round(spmv_gbps, 2)
+        extra["spmv_7pt_128^3_f32_ms"] = round(spmv_s * 1e3, 4)
         extra["stream_ceiling_gbps"] = round(ceiling, 2)
         extra["spmv_vs_ceiling"] = round(spmv_gbps / max(ceiling, 1e-9), 3)
     except Exception as e:  # pragma: no cover - bench robustness
-        extra["stream_ceiling_error"] = str(e)[:120]
+        extra["spmv_error"] = str(e)[:120]
     try:
         (setup_cold, setup_s, solve_s, iters, conv, rel) = bench_flagship()
         extra.update({
