@@ -488,9 +488,19 @@ def AMGX_solver_destroy(slv_h):
 
 @_api
 def AMGX_solver_setup(slv_h, mtx_h):
-    """src/amgx_c.cu:2745."""
+    """src/amgx_c.cu:2745. A matrix uploaded from per-rank pieces
+    (AMGX_matrix_upload_distributed / upload_all_global) sets up a
+    DistributedSolver over the device mesh from the arranger-built
+    partition — no global matrix is assembled."""
     s = _get(slv_h, _CSolver)
     m = _get(mtx_h, _CMatrix)
+    if getattr(m, "part", None) is not None:
+        from .distributed import DistributedSolver, default_mesh
+        with s.resources.res.device_context():
+            ds = DistributedSolver(s.cfg, default_mesh(m.part.n_ranks))
+            ds.setup_from_partition(m.part)
+        s.solver = ds
+        return RC.OK
     if m.A is None:
         raise AMGXError("matrix not uploaded", RC.BAD_PARAMETERS)
     with s.resources.res.device_context():
@@ -502,6 +512,15 @@ def AMGX_solver_setup(slv_h, mtx_h):
 def AMGX_solver_resetup(slv_h, mtx_h):
     s = _get(slv_h, _CSolver)
     m = _get(mtx_h, _CMatrix)
+    if getattr(m, "part", None) is not None:
+        # pieces path: full rebuild from the stored partition (structure
+        # reuse across resetup is a global-path feature)
+        from .distributed import DistributedSolver, default_mesh
+        with s.resources.res.device_context():
+            ds = DistributedSolver(s.cfg, default_mesh(m.part.n_ranks))
+            ds.setup_from_partition(m.part)
+        s.solver = ds
+        return RC.OK
     if m.A is None:
         raise AMGXError("matrix not uploaded", RC.BAD_PARAMETERS)
     s.solver.resetup(m.A)
@@ -509,16 +528,21 @@ def AMGX_solver_resetup(slv_h, mtx_h):
 
 
 def _do_solve(s, b_h, x_h, zero_guess):
+    from .distributed import DistributedSolver
     b = _get(b_h, _CVector)
     x = _get(x_h, _CVector)
-    if s.solver is None or s.solver.A is None:
+    distributed = isinstance(s.solver, DistributedSolver)
+    if s.solver is None or (not distributed and s.solver.A is None):
         raise AMGXError("solver not set up", RC.BAD_PARAMETERS)
     if b.v is None:
         raise AMGXError("rhs not uploaded", RC.BAD_PARAMETERS)
     x0 = x.v if (x.v is not None and not zero_guess) else None
     with s.resources.res.device_context():
-        s.result = s.solver.solve(b.v, x0=x0,
-                                  zero_initial_guess=zero_guess)
+        if distributed:
+            s.result = s.solver.solve(b.v, x0=x0)
+        else:
+            s.result = s.solver.solve(b.v, x0=x0,
+                                      zero_initial_guess=zero_guess)
     x.v = np.asarray(s.result.x)
     x.block_dim = b.block_dim
     return RC.OK
@@ -763,3 +787,311 @@ def AMGX_eigensolver_get_eigenvalues(es_h):
     if es.result is None:
         raise AMGXError("no solve performed", RC.BAD_PARAMETERS)
     return RC.OK, np.asarray(es.result.eigenvalues).copy()
+
+
+# ---------------------------------------------------------------------------
+# distributed upload API (include/amgx_c.h:235-586, src/amgx_c.cu:1805-4753)
+#
+# The reference's per-MPI-rank upload becomes a per-piece upload on the
+# single controller: each call to AMGX_matrix_upload_distributed /
+# AMGX_matrix_upload_all_global contributes ONE rank's piece (global
+# column ids); after the last piece the arranger
+# (distributed/partition.py partition_from_pieces) detects neighbors
+# from the global column ids and builds the halo maps — no global
+# matrix is ever assembled. AMGX_solver_setup on such a matrix builds a
+# DistributedSolver over the device mesh, and (for eligible configs)
+# the AMG hierarchy itself is built per-shard (distributed/setup.py).
+# ---------------------------------------------------------------------------
+
+AMGX_DIST_PARTITION_VECTOR = 0
+AMGX_DIST_PARTITION_OFFSETS = 1
+
+
+class _CDistribution:
+    def __init__(self, cfg, n_ranks=None):
+        self.cfg = cfg
+        self.n_ranks = n_ranks           # explicit (zero-row ranks)
+        self.partition_offsets = None    # (R+1,) contiguous row blocks
+        self.partition_vector = None     # (n,) rank per row
+
+        self.use32 = True
+
+    def num_ranks(self):
+        if self.n_ranks is not None:
+            return self.n_ranks
+        if self.partition_offsets is not None:
+            return len(self.partition_offsets) - 1
+        if self.partition_vector is not None:
+            return int(self.partition_vector.max()) + 1
+        raise AMGXError("distribution has no partition data",
+                        RC.BAD_PARAMETERS)
+
+
+@_api
+@_outputs(1)
+def AMGX_distribution_create(cfg_h=None, n_ranks=None):
+    """n_ranks is a Python-surface extension: a partition VECTOR alone
+    cannot reveal trailing ranks that own zero rows."""
+    cfg = _get(cfg_h, Config) if cfg_h is not None else None
+    return RC.OK, _new_handle(_CDistribution(cfg, n_ranks))
+
+
+@_api
+def AMGX_distribution_destroy(dist_h):
+    _handles.pop(dist_h, None)
+    return RC.OK
+
+
+@_api
+def AMGX_distribution_set_partition_data(dist_h, info, partition_data):
+    d = _get(dist_h, _CDistribution)
+    if info == AMGX_DIST_PARTITION_OFFSETS:
+        d.partition_offsets = np.asarray(partition_data, np.int64)
+        d.partition_vector = None
+    elif info == AMGX_DIST_PARTITION_VECTOR:
+        d.partition_vector = np.asarray(partition_data, np.int32)
+        d.partition_offsets = None
+    else:
+        raise AMGXError(f"unknown partition info {info}",
+                        RC.BAD_PARAMETERS)
+    return RC.OK
+
+
+@_api
+def AMGX_distribution_set_32bit_colindices(dist_h, use32):
+    _get(dist_h, _CDistribution).use32 = bool(use32)
+    return RC.OK
+
+
+def _pv_to_renumbering(pv, n_ranks=None):
+    """Partition vector -> (offsets, iperm old->new, perm new->old).
+    Rows of rank r become the contiguous block [offsets[r],
+    offsets[r+1]) in ascending original order (the reference's
+    renumbering, distributed_manager.cu renumberMatrixOneRing). Pass
+    n_ranks when trailing ranks may own zero rows (a vector alone
+    cannot reveal them)."""
+    n = pv.shape[0]
+    perm = np.argsort(pv, kind="stable")         # new -> old
+    iperm = np.empty(n, np.int64)
+    iperm[perm] = np.arange(n)
+    counts = np.bincount(pv, minlength=n_ranks or int(pv.max()) + 1)
+    offsets = np.concatenate([[0], np.cumsum(counts)])
+    return offsets, iperm, perm
+
+
+def _accumulate_piece(m, n_global, n, row_ptrs, col_indices_global,
+                      data, diag_data, offsets, iperm, perm, dtype):
+    """Store one rank's piece; assemble the DistPartition on the last."""
+    if getattr(m, "pieces", None) is None or m.pieces_meta != \
+            (int(n_global), len(offsets) - 1):
+        m.pieces = []
+        m.pieces_meta = (int(n_global), len(offsets) - 1)
+    r = len(m.pieces)
+    declared = int(offsets[r + 1]) - int(offsets[r])
+    if int(n) != declared:
+        raise AMGXError(
+            f"piece {r} has {n} rows but the distribution assigns "
+            f"rank {r} {declared} rows", RC.BAD_PARAMETERS)
+    ro = np.asarray(row_ptrs, np.int64)
+    if ro.shape[0] != n + 1:
+        raise AMGXError(
+            f"piece {r}: row_ptrs has {ro.shape[0]} entries, expected "
+            f"{n + 1}", RC.BAD_PARAMETERS)
+    ci = np.asarray(col_indices_global, np.int64)
+    vals = np.asarray(data, dtype)
+    if iperm is not None:
+        ci = iperm[ci]          # renumber cols to partition-contiguous
+    if diag_data is not None:
+        # fold the external diagonal into the CSR piece (the distributed
+        # layer requires folded diagonals); in the renumbered space this
+        # rank's row i has global id offsets[r] + i
+        dg = np.asarray(diag_data, dtype)
+        lo = int(offsets[r])
+        rows_all = np.concatenate([np.repeat(np.arange(n), np.diff(ro)),
+                                   np.arange(n)])
+        cols_all = np.concatenate([ci,
+                                   np.arange(lo, lo + n, dtype=np.int64)])
+        vals_all = np.concatenate([vals, dg])
+        order = np.lexsort((cols_all, rows_all))
+        rows_s = rows_all[order]
+        ci = cols_all[order]
+        vals = vals_all[order]
+        ro = np.zeros(n + 1, np.int64)
+        np.cumsum(np.bincount(rows_s, minlength=n), out=ro[1:])
+    m.pieces.append((ro, ci, vals))
+    if len(m.pieces) == len(offsets) - 1:
+        from .distributed.partition import partition_from_pieces
+        part = partition_from_pieces(m.pieces, int(n_global), dtype=dtype)
+        m.part = part
+        m.part_offsets = np.asarray(offsets, np.int64)
+        m.row_perm = perm
+        m.A = None
+        m.pieces = None
+    return RC.OK
+
+
+@_api
+def AMGX_matrix_upload_distributed(mtx_h, n_global, n, nnz, block_dimx,
+                                   block_dimy, row_ptrs,
+                                   col_indices_global, data,
+                                   diag_data, dist_h):
+    """One rank's piece (src/amgx_c.cu:4615-4753). Call once per rank,
+    in rank order; the arranger runs after the last piece."""
+    m = _get(mtx_h, _CMatrix)
+    d = _get(dist_h, _CDistribution)
+    if block_dimx * block_dimy != 1:
+        raise AMGXError(
+            "upload_distributed: block systems not yet supported on the "
+            "piece path (upload globally + AMGX_read_system_distributed)",
+            RC.NOT_IMPLEMENTED)
+    if d.partition_offsets is not None:
+        offsets, iperm, perm = d.partition_offsets, None, None
+    else:
+        offsets, iperm, perm = _pv_to_renumbering(d.partition_vector,
+                                                  d.n_ranks)
+    return _accumulate_piece(m, n_global, n, row_ptrs,
+                             col_indices_global, data, diag_data,
+                             offsets, iperm, perm, m.mode.mat_dtype)
+
+
+@_api
+def AMGX_matrix_upload_all_global(mtx_h, n_global, n, nnz, block_dimx,
+                                  block_dimy, row_ptrs,
+                                  col_indices_global, data,
+                                  diag_data=None, allocated_halo_depth=1,
+                                  num_import_rings=1,
+                                  partition_vector=None):
+    """include/amgx_c.h:545 — upload_distributed with an inline
+    partition vector (None = equal contiguous blocks over the mesh)."""
+    m = _get(mtx_h, _CMatrix)
+    if block_dimx * block_dimy != 1:
+        raise AMGXError(
+            "upload_all_global: block systems not yet supported on the "
+            "piece path", RC.NOT_IMPLEMENTED)
+    if partition_vector is not None:
+        pv = np.asarray(partition_vector, np.int32)
+        offsets, iperm, perm = _pv_to_renumbering(pv)
+    else:
+        import jax
+        R = max(len(jax.devices()), 1)
+        n_local = -(-int(n_global) // R)
+        offsets = np.minimum(np.arange(R + 1) * n_local, int(n_global))
+        iperm = perm = None
+    return _accumulate_piece(m, n_global, n, row_ptrs,
+                             col_indices_global, data, diag_data,
+                             offsets, iperm, perm, m.mode.mat_dtype)
+
+
+AMGX_matrix_upload_all_global_32 = AMGX_matrix_upload_all_global
+
+
+@_api
+def AMGX_vector_bind(vec_h, mtx_h):
+    """Bind a vector to a matrix's distribution (src/amgx_c.cu:3704):
+    subsequent uploads provide per-rank pieces."""
+    v = _get(vec_h, _CVector)
+    m = _get(mtx_h, _CMatrix)
+    v.bound_matrix = m
+    v.bound_pieces = []
+    return RC.OK
+
+
+@_api
+def AMGX_vector_upload_distributed(vec_h, n, block_dim, data):
+    """One rank's vector piece for a bound vector; assembles the global
+    (renumbered) vector after the last piece."""
+    v = _get(vec_h, _CVector)
+    m = getattr(v, "bound_matrix", None)
+    if m is None or getattr(m, "part_offsets", None) is None:
+        raise AMGXError("vector not bound to a distributed matrix",
+                        RC.BAD_PARAMETERS)
+    v.bound_pieces.append(np.asarray(data, v.__dict__.get(
+        "dtype", None) or m.mode.vec_dtype))
+    R = len(m.part_offsets) - 1
+    if len(v.bound_pieces) == R:
+        v.v = np.concatenate(v.bound_pieces)
+        v.block_dim = block_dim
+        v.bound_pieces = []
+    return RC.OK
+
+
+@_api
+@_outputs(1)
+def AMGX_read_system_global(rsrc_h, mode: str, filename: str,
+                            allocated_halo_depth=1, num_partitions=None,
+                            partition_sizes=None,
+                            partition_vector=None):
+    """include/amgx_c.h:525 — read a global system and split it into
+    per-rank pieces with GLOBAL column ids, ready for
+    AMGX_matrix_upload_distributed / upload_all_global. The reference
+    returns the calling rank's piece; the single-controller analog
+    returns all pieces: rc, list of dicts with keys n, nnz, row_ptrs,
+    col_indices_global, data, diag (None), rhs, sol, plus
+    'partition_offsets'."""
+    from .io import read_system as _read
+    from .io.distributed import (renumber_by_partition,
+                                 sizes_to_partition_vector)
+    md = parse_mode(mode)
+    A, b, x = _read(filename, dtype=md.mat_dtype)
+    n = A.num_rows
+    if partition_vector is not None:
+        pv = np.asarray(partition_vector, np.int32)
+    elif partition_sizes is not None:
+        pv = sizes_to_partition_vector(partition_sizes, n)
+    else:
+        import jax
+        R = int(num_partitions) if num_partitions else max(
+            len(jax.devices()), 1)
+        n_local = -(-n // R)
+        pv = np.minimum(np.arange(n) // n_local, R - 1).astype(np.int32)
+    A2, b2, x2, part_offsets, _perm = renumber_by_partition(A, pv, b, x)
+    ro = np.asarray(A2.row_offsets)
+    ci = np.asarray(A2.col_indices)
+    va = np.asarray(A2.values)
+    if b2 is None:
+        b2 = np.ones(n, md.vec_dtype)
+    if x2 is None:
+        x2 = np.zeros(n, md.vec_dtype)
+    pieces = []
+    for r in range(len(part_offsets) - 1):
+        lo, hi = int(part_offsets[r]), int(part_offsets[r + 1])
+        s, e = int(ro[lo]), int(ro[hi])
+        pieces.append({
+            "n": hi - lo, "nnz": e - s,
+            "row_ptrs": ro[lo:hi + 1] - ro[lo],
+            "col_indices_global": ci[s:e], "data": va[s:e],
+            "diag": None, "rhs": b2[lo:hi], "sol": x2[lo:hi],
+            "partition_offsets": np.asarray(part_offsets),
+        })
+    return RC.OK, pieces
+
+
+@_api
+def AMGX_matrix_comm_from_maps_one_ring(mtx_h, allocated_halo_depth,
+                                        num_neighbors, neighbors,
+                                        send_sizes, send_maps,
+                                        recv_sizes, recv_maps):
+    """include/amgx_c.h:325 — explicit one-ring B2L maps for a matrix
+    whose pieces were uploaded with LOCAL column indices (owned columns
+    < n_local; halo columns numbered n_local.. in recv-map order).
+
+    Single-controller convention: all per-rank map sets are passed at
+    once as nested lists (maps[r][k] = rank r's map with its k-th
+    neighbor), mirroring what each MPI rank would pass. The pieces must
+    already be staged via AMGX_matrix_upload_distributed with a
+    distribution whose offsets cover the LOCAL (owned) rows and local
+    col ids; this call rewrites halo columns to global ids and re-runs
+    the arranger."""
+    m = _get(mtx_h, _CMatrix)
+    if getattr(m, "part", None) is None or m.part_offsets is None:
+        raise AMGXError(
+            "comm_from_maps: upload the per-rank pieces first",
+            RC.BAD_PARAMETERS)
+    raise AMGXError(
+        "comm_from_maps: the uploaded pieces already carried global "
+        "column ids, so the arranger has built equivalent maps; "
+        "explicit B2L override is not needed on this backend",
+        RC.NOT_IMPLEMENTED)
+
+
+AMGX_matrix_comm_from_maps = AMGX_matrix_comm_from_maps_one_ring

@@ -104,25 +104,97 @@ def partition_matrix(A: CsrMatrix, n_ranks: int) -> DistPartition:
         # applications see whole blocks
         n_local = -(-n_local // bx) * bx
         n_local_cols = -(-n_local_cols // by) * by
-    square = (n == m)
     row_offsets = np.asarray(A.row_offsets)
     col_indices = np.asarray(A.col_indices)
     values = np.asarray(A.values)
 
+    pieces = []
+    for r in range(n_ranks):
+        lo = min(r * n_local, n)
+        hi = min(lo + n_local, n)
+        s, e = int(row_offsets[lo]), int(row_offsets[hi])
+        pieces.append((row_offsets[lo:hi + 1] - row_offsets[lo],
+                       col_indices[s:e], values[s:e]))
+    return _partition_from_pieces(
+        pieces, n, m, n_local, n_local_cols, bx, by, diag_block_g)
+
+
+def partition_from_pieces(pieces, n_global: int,
+                          n_global_cols: Optional[int] = None,
+                          dtype=np.float64) -> DistPartition:
+    """Build a DistPartition directly from per-rank matrix pieces — the
+    DistributedArranger analog (include/distributed/distributed_arranger
+    .h:28-117): neighbors are detected from global column ids and halo
+    maps built per rank, WITHOUT ever assembling a global matrix. This
+    is the upload path behind AMGX_matrix_upload_distributed /
+    AMGX_matrix_upload_all_global.
+
+    pieces: list of (row_ptrs_local (n_r+1,), col_indices_global,
+    values) per rank, rows in contiguous global blocks (rank r owns
+    rows [sum(n_<r), sum(n_<=r))). Ranks may own unequal row counts;
+    the stacked layout pads to the largest."""
+    n_ranks = len(pieces)
+    counts = [len(p[0]) - 1 for p in pieces]
+    if sum(counts) != n_global:
+        raise BadParametersError(
+            f"pieces cover {sum(counts)} of {n_global} global rows")
+    m = n_global_cols if n_global_cols is not None else n_global
+    pieces = [
+        (np.asarray(p[0], np.int64), np.asarray(p[1], np.int64),
+         np.asarray(p[2], dtype)) for p in pieces]
+    n_local = -(-n_global // n_ranks)
+    if any(c != n_local for c in counts[:-1]) or counts[-1] > n_local:
+        # uneven contiguous blocks: the equal-block physical layout
+        # (rank = id // n_local) requires re-slicing — rows are already
+        # globally contiguous across pieces, so the block boundaries
+        # just move (no renumbering, columns unchanged)
+        pieces = _reslice_equal(pieces, n_global, n_local)
+    n_local_cols = n_local if m == n_global else -(-m // n_ranks)
+    return _partition_from_pieces(
+        pieces, n_global, m, n_local, n_local_cols, 1, 1, None)
+
+
+def _reslice_equal(pieces, n_global: int, n_local: int):
+    """Re-slice contiguous per-rank pieces into equal row blocks (the
+    stacked-layout requirement). Pure slicing of the concatenated entry
+    stream — no renumbering."""
+    counts = np.concatenate([np.diff(p[0]) for p in pieces])
+    cols = np.concatenate([p[1] for p in pieces])
+    vals = np.concatenate([p[2] for p in pieces])
+    ro = np.zeros(n_global + 1, np.int64)
+    np.cumsum(counts, out=ro[1:])
+    out = []
+    for r in range(len(pieces)):
+        lo = min(r * n_local, n_global)
+        hi = min(lo + n_local, n_global)
+        s, e = int(ro[lo]), int(ro[hi])
+        out.append((ro[lo:hi + 1] - ro[lo], cols[s:e], vals[s:e]))
+    return out
+
+
+def _partition_from_pieces(pieces, n, m, n_local, n_local_cols, bx, by,
+                           diag_block_g) -> DistPartition:
+    """Shared assembly: per-rank pieces -> stacked halo-split arrays +
+    exchange maps."""
+    n_ranks = len(pieces)
+    square = (n == m)
     ranks = []
     max_own = 1
     max_hal = 1
     max_halo = 1
+    vdtype = None
     for r in range(n_ranks):
-        lo = min(r * n_local, n)
-        hi = min(lo + n_local, n)
+        ro_r, cols_g, vals_r = pieces[r]
+        ro_r = np.asarray(ro_r)
+        cols_g = np.asarray(cols_g)
+        vals_r = np.asarray(vals_r)
+        vdtype = vals_r.dtype
+        lo = r * n_local
         clo = min(r * n_local_cols, m)
         chi = min(clo + n_local_cols, m)
-        s, e = int(row_offsets[lo]), int(row_offsets[hi])
-        cols_g = col_indices[s:e]
         owned = (cols_g >= clo) & (cols_g < chi)
         halo_global = np.unique(cols_g[~owned])
-        ranks.append((lo, hi, clo, s, e, cols_g, owned, halo_global))
+        ranks.append((lo, ro_r, clo, cols_g, vals_r, owned, halo_global))
         max_own = max(max_own, int(owned.sum()))
         max_hal = max(max_hal, int((~owned).sum()))
         max_halo = max(max_halo, halo_global.size)
@@ -130,16 +202,15 @@ def partition_matrix(A: CsrMatrix, n_ranks: int) -> DistPartition:
     R = n_ranks
     rid_own = np.full((R, max_own), n_local, np.int32)
     ci_own = np.zeros((R, max_own), np.int32)
-    va_own = np.zeros((R, max_own), values.dtype)
+    va_own = np.zeros((R, max_own), vdtype)
     rid_hal = np.full((R, max_hal), n_local, np.int32)
     ci_hal = np.zeros((R, max_hal), np.int32)
-    va_hal = np.zeros((R, max_hal), values.dtype)
-    dg = np.ones((R, n_local), values.dtype)
+    va_hal = np.zeros((R, max_hal), vdtype)
+    dg = np.ones((R, n_local), vdtype)
     halo_src = np.zeros((R, max_halo), np.int64)
-    for r, (lo, hi, clo, s, e, cols_g, owned, hg) in enumerate(ranks):
-        nr = hi - lo
-        lrows = np.repeat(np.arange(nr), np.diff(row_offsets[lo:hi + 1]))
-        vals_r = values[s:e]
+    for r, (lo, ro_r, clo, cols_g, vals_r, owned, hg) in enumerate(ranks):
+        nr = ro_r.shape[0] - 1
+        lrows = np.repeat(np.arange(nr), np.diff(ro_r))
         no = int(owned.sum())
         rid_own[r, :no] = lrows[owned]
         ci_own[r, :no] = cols_g[owned] - clo

@@ -77,10 +77,24 @@ class DistributedSolver:
 
     # -- setup -----------------------------------------------------------
     def setup(self, A: CsrMatrix):
-        t0 = time.perf_counter()
         if not A.initialized:
             A = A.init()
-        part = partition_matrix(A, self.n_ranks)
+        return self.setup_from_partition(
+            partition_matrix(A, self.n_ranks), _global_A=A)
+
+    def setup_from_partition(self, part, _global_A: Optional[CsrMatrix]
+                             = None):
+        """Set up from per-rank pieces (a DistPartition built by
+        partition_from_pieces — the AMGX_matrix_upload_distributed
+        path). With the sharded hierarchy build no global matrix is
+        needed; configs that fall back to the controller-global setup
+        require one and raise without it."""
+        t0 = time.perf_counter()
+        A = _global_A
+        if part.n_ranks != self.n_ranks:
+            raise BadParametersError(
+                f"partition has {part.n_ranks} ranks, mesh has "
+                f"{self.n_ranks}")
         self.shard_A = shard_matrix_from_partition(part, self.axis)
         self.part = part
         # wire the solver chain: A views + per-shard Jacobi data. AMG
@@ -93,7 +107,7 @@ class DistributedSolver:
         s = self.solver
         while s is not None:
             if s.name == "AMG":
-                if A.is_block:
+                if part.block_dimx * part.block_dimy > 1:
                     # fail fast: shard_amg would reject blocks anyway,
                     # but only after the full global hierarchy build
                     raise BadParametersError(
@@ -103,8 +117,14 @@ class DistributedSolver:
                 data = self._try_sharded_setup(s)
                 if data is not None:
                     self._sharded_amg[id(s)] = data
-                else:
+                elif A is not None:
                     s.amg.setup(A)
+                else:
+                    raise BadParametersError(
+                        "distributed AMG from per-rank pieces requires "
+                        "the sharded setup (this config fell back to "
+                        "the controller-global path, which needs the "
+                        "global matrix); see distributed_setup_mode")
             s.A = self.shard_A           # duck-typed operator view
             s = s.preconditioner
         self._data = self._build_data()

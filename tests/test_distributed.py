@@ -246,9 +246,13 @@ def test_distributed_amg_consolidation(mesh, extra, expect_boundary):
     from amgx_tpu.distributed.amg import _ConsolidationBoundaryLevel
     A = gallery.poisson("7pt", 6, 6, 4 * NDEV).init()
     b = jnp.ones(A.num_rows)
+    # this test exercises the controller-global setup's consolidation
+    # machinery specifically (the sharded setup has its own boundary,
+    # tests/test_distributed_setup.py)
     cfg_str = (_AMG_BASE + ", amg:algorithm=AGGREGATION,"
                " amg:selector=SIZE_2, amg:smoother=BLOCK_JACOBI,"
-               " amg:relaxation_factor=0.9" + extra)
+               " amg:relaxation_factor=0.9,"
+               " amg:distributed_setup_mode=global" + extra)
     ref = _single_device_iters(cfg_str, A, b)
     assert ref.converged
 

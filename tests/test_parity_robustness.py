@@ -1,11 +1,14 @@
-"""Iteration-parity + robustness harness (VERDICT round-1 item 10).
+"""Iteration-regression + robustness harness (VERDICT round-1 item 10).
 
-Parity: the four shipped configs run on fixed fixtures and must
-reproduce the recorded iteration counts exactly — the BASELINE.md
-correctness bar ("identical iteration counts"), with the recorded
-values acting as the checked-in parity table. A change to any selector,
-smoother, or convergence component that alters convergence behavior
-trips these.
+Regression table: four shipped configs run on fixed fixtures and must
+reproduce the recorded iteration counts exactly. The recorded counts
+are THIS FRAMEWORK'S (captured when the faithful reference preset
+files were adopted) — a self-regression table, NOT verified AmgX
+output: without GPU hardware the reference's counts for these fixtures
+cannot be produced, and its repo publishes none for them (the only
+cross-checked number is the 12-row README sample). What the table
+guards is drift: a change to any selector, smoother, or convergence
+component that alters convergence behavior trips these.
 
 Robustness: NaN rhs, zero diagonal, and zero-row inputs must not hang
 or crash — mirroring src/tests/smoother_nan_random.cu and the
@@ -32,10 +35,13 @@ _CONFIG_DIR = os.path.join(os.path.dirname(__file__), "..", "configs")
 # Regenerate deliberately (and update here) when algorithm changes are
 # intended; see docstring.
 _PARITY = [
-    ("FGMRES_AGGREGATION.json", ("7pt", (16, 16, 16)), 13),
-    ("AMG_CLASSICAL_PMIS.json", ("7pt", (16, 16, 16)), 27),
-    ("PCG_CLASSICAL_V_JACOBI.json", ("7pt", (16, 16, 16)), 12),
-    ("PBICGSTAB_AGGREGATION_W_JACOBI.json", ("7pt", (16, 16, 16)), 7),
+    # counts regenerated when configs/ switched to the verbatim
+    # reference presets (MULTICOLOR_DILU smoother, aggressive levels,
+    # reference tolerances)
+    ("FGMRES_AGGREGATION.json", ("7pt", (16, 16, 16)), 7),
+    ("AMG_CLASSICAL_PMIS.json", ("7pt", (16, 16, 16)), 13),
+    ("PCG_CLASSICAL_V_JACOBI.json", ("7pt", (16, 16, 16)), 14),
+    ("PBICGSTAB_AGGREGATION_W_JACOBI.json", ("7pt", (16, 16, 16)), 6),
 ]
 
 
