@@ -15,7 +15,9 @@ first-occurrence; edge weights ignore w == 0).
 """
 from __future__ import annotations
 
+import contextlib
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -151,6 +153,55 @@ def _pair_sum3(v3, axes, shapes):
     for k, a in enumerate(axes):
         out = pair_sum_axis(out, shapes[k][a], a)
     return out
+
+
+class _DeferredChecks(threading.local):
+    """Per-thread accumulator for the wrap checks of a whole hierarchy
+    build: each level appends its device flag; the owner fetches them
+    in ONE device round trip at the end (a per-level bool() costs a
+    full ~170 ms tunnel round trip on the bench rig). `disable_fast`
+    turns the DIA fast path off during the rare rebuild after a failed
+    deferred check."""
+
+    def __init__(self):
+        self.items = None
+        self.disable_fast = False
+
+
+_deferred = _DeferredChecks()
+
+
+@contextlib.contextmanager
+def deferred_wrap_checks():
+    """Collect wrap-check flags instead of blocking per level. Yields a
+    `flush()` callable returning True when ANY collected check failed
+    (single device fetch)."""
+    prev = _deferred.items
+    _deferred.items = []
+
+    def flush() -> bool:
+        flags = _deferred.items
+        _deferred.items = []
+        if not flags:
+            return False
+        return bool(jnp.any(jnp.stack(flags)))
+
+    try:
+        yield flush
+    finally:
+        _deferred.items = prev
+
+
+@contextlib.contextmanager
+def geo_dia_disabled():
+    """Force the generic relabel Galerkin (rebuild path after a failed
+    deferred wrap check)."""
+    prev = _deferred.disable_fast
+    _deferred.disable_fast = True
+    try:
+        yield
+    finally:
+        _deferred.disable_fast = prev
 
 
 @functools.partial(jax.jit, static_argnames=("shifts", "shape"))
@@ -293,13 +344,20 @@ def geo_coarse_dia(A: CsrMatrix, fine_shape, axes, coarse_shape):
             return None
         decomp[int(d)] = g
 
+    if _deferred.disable_fast:
+        return None
     n = A.num_rows
     vals = A.dia_vals.reshape(len(A.dia_offsets), -1)[:, :n]
-    # wrap check (one device reduction, one scalar sync per level): a
-    # geometric shift must keep every nonzero inside the grid — entries
-    # that cross a grid row boundary would be misclassified
+    # wrap check: a geometric shift must keep every nonzero inside the
+    # grid — entries crossing a grid row boundary would be
+    # misclassified. Inside a hierarchy build the flag is DEFERRED
+    # (batched single fetch, deferred_wrap_checks); standalone calls
+    # block here as before.
     shifts = tuple(decomp[int(d)] for d in A.dia_offsets)
-    if bool(_any_wrapped(vals, shifts, tuple(fine_shape))):
+    wrapped = _any_wrapped(vals, shifts, tuple(fine_shape))
+    if _deferred.items is not None:
+        _deferred.items.append(wrapped)
+    elif bool(wrapped):
         return None
 
     coffsets, contribs = _geo_contrib_table(

@@ -107,9 +107,24 @@ class AMG:
         t0 = time.perf_counter()
         self.levels = []
         Af = A if A.initialized else A.init()
-        self._build_levels(Af, 0)
+        self._build_levels_checked(Af, 0)
         self._finalize_setup(t0)
         return self
+
+    def _build_levels_checked(self, Af: CsrMatrix, lvl: int):
+        """_build_levels with the GEO fast path's wrap checks deferred
+        to ONE batched device fetch (each per-level bool() costs a full
+        tunnel round trip); the rare failure rebuilds without the fast
+        path."""
+        from .aggregation.galerkin import (deferred_wrap_checks,
+                                           geo_dia_disabled)
+        base = list(self.levels)
+        with deferred_wrap_checks() as flush:
+            self._build_levels(Af, lvl)
+            if flush():
+                self.levels = base
+                with geo_dia_disabled():
+                    self._build_levels(Af, lvl)
 
     def resetup(self, A: CsrMatrix):
         """Coefficient-replace re-setup honoring structure_reuse_levels
@@ -125,22 +140,40 @@ class AMG:
         t0 = time.perf_counter()
         k = len(self.levels) if reuse < 0 else min(reuse, len(self.levels))
         old_levels, self.levels = self.levels, []
-        lvl = 0
-        while lvl < k:
-            old = old_levels[lvl]
-            if Af.num_rows != old.A.num_rows:
-                break
-            level = type(old)(Af, self.cfg, self.scope, lvl)
-            level.reuse_structure(old)
-            Ac = level.create_coarse_matrix()
-            self.levels.append(level)
-            Af = Ac.build_spmv_layout() if Ac.initialized else Ac.init()
-            lvl += 1
-        self._build_levels(Af, lvl)
+        from .aggregation.galerkin import (deferred_wrap_checks,
+                                           geo_dia_disabled)
+
+        def reuse_loop(Af):
+            lvl = 0
+            while lvl < k:
+                old = old_levels[lvl]
+                if Af.num_rows != old.A.num_rows:
+                    break
+                level = type(old)(Af, self.cfg, self.scope, lvl)
+                level.reuse_structure(old)
+                Ac = level.create_coarse_matrix()
+                self.levels.append(level)
+                Af = Ac.build_spmv_layout() if Ac.initialized else Ac.init()
+                lvl += 1
+            return Af, lvl
+
+        Af0 = Af
+        with deferred_wrap_checks() as flush:
+            Af, lvl = reuse_loop(Af0)
+            failed = flush()
+        if failed:
+            # rare: the new coefficients break the GEO fast path's
+            # geometric invariant — redo the reuse loop with the generic
+            # relabel Galerkin (same reused aggregates, one extra pass)
+            self.levels = []
+            with geo_dia_disabled():
+                Af, lvl = reuse_loop(Af0)
+        self._build_levels_checked(Af, lvl)
         self._finalize_setup(t0)
         return self
 
     def _build_levels(self, Af: CsrMatrix, lvl: int):
+        from ..profiling import trace_region
         level_cls = registry.amg_levels.get(self.algorithm)
         while True:
             n = Af.num_rows
@@ -151,15 +184,18 @@ class AMG:
             if stop:
                 break
             level = level_cls(Af, self.cfg, self.scope, lvl)
-            level.create_coarse_vertices()
+            with trace_region(f"amg.L{lvl}.selector"):
+                level.create_coarse_vertices()
             nc = level.coarse_size
             # stalling coarsening -> stop (coarsen_threshold semantics:
             # require the grid to shrink by at least that factor)
             if nc <= 0 or nc >= n or (n / max(nc, 1)) < self.coarsen_threshold:
                 break
-            Ac = level.create_coarse_matrix()
+            with trace_region(f"amg.L{lvl}.galerkin"):
+                Ac = level.create_coarse_matrix()
             self.levels.append(level)
-            Af = Ac.build_spmv_layout() if Ac.initialized else Ac.init()
+            with trace_region(f"amg.L{lvl}.layout"):
+                Af = Ac.build_spmv_layout() if Ac.initialized else Ac.init()
             lvl += 1
         self.coarsest_A = Af
 
@@ -174,6 +210,7 @@ class AMG:
         fs_name, fs_scope = self.cfg.get_solver("fine_smoother", self.scope)
         cs2_name, cs2_scope = self.cfg.get_solver("coarse_smoother",
                                                   self.scope)
+        from ..profiling import trace_region
         for level in self.levels:
             if fine_levels < 0:
                 name, scope = sm_name, sm_scope
@@ -186,12 +223,14 @@ class AMG:
             if getattr(level.smoother, "needs_cf_map", False) and \
                     getattr(level, "cf_map", None) is not None:
                 level.smoother.set_cf_map(level.cf_map)
-            level.smoother.setup(level.A)
+            with trace_region(f"amg.L{level.level_index}.smoother_setup"):
+                level.smoother.setup(level.A)
 
         cs_name, cs_scope = self.cfg.get_solver("coarse_solver", self.scope)
         self.coarse_solver = make_solver(cs_name, self.cfg, cs_scope)
         self.coarse_solver._owns_scaling = False
-        self.coarse_solver.setup(self.coarsest_A)
+        with trace_region("amg.coarse_solver_setup"):
+            self.coarse_solver.setup(self.coarsest_A)
         self.num_levels = len(self.levels) + 1
         self.setup_time = time.perf_counter() - t0
         if self.print_grid_stats:

@@ -194,16 +194,19 @@ class ChebyshevPolySolver(Solver):
         if self.A.is_block:
             raise BadParametersError(
                 "CHEBYSHEV_POLY supports scalar matrices")
-        lam = float(jnp.max(_abs_row_sums(self.A)))   # Gershgorin bound
+        # lambda stays ON DEVICE: a float() fetch here costs a full
+        # tunnel round trip per AMG level (~170 ms each on the bench
+        # rig); taus ships to the solve program as a device array
+        lam = jnp.max(_abs_row_sums(self.A))   # Gershgorin bound
         m = self.order
         beta = np.pi / (4.0 * m + 2.0)
-        taus = [
-            (np.cos(beta) ** 2
-             / (np.cos(beta * (2 * i + 1)) ** 2 - np.sin(beta) ** 2))
-            / lam
+        coeffs = np.asarray([
+            np.cos(beta) ** 2
+            / (np.cos(beta * (2 * i + 1)) ** 2 - np.sin(beta) ** 2)
             for i in range(m)
-        ]
-        self._taus = jnp.asarray(np.array(taus), self.A.dtype)
+        ])
+        self._taus = jnp.asarray(coeffs, self.A.dtype) / \
+            lam.astype(self.A.dtype)
 
     def solve_data(self):
         d = super().solve_data()

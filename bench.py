@@ -92,20 +92,46 @@ def bench_flagship(n: int = 128, tolerance: str = "1e-8", reps: int = 3):
     """REFINEMENT(FGMRES + GEO-aggregation AMG, f32 inner) on 7-pt
     Poisson n^3, f64 system, true relative residual <= tolerance. Setup
     AND solve run entirely on the TPU (jitted static-shape setup)."""
+    from amgx_tpu import profiling
     A = amgx.gallery.poisson("7pt", n, n, n).init()
     b = jnp.ones(A.num_rows)
     flagship = FLAGSHIP.replace("tolerance=1e-8", f"tolerance={tolerance}")
     assert tolerance == "1e-8" or flagship != FLAGSHIP, \
         "FLAGSHIP tolerance literal drifted; fix the replace target"
+    def _settle(s):
+        # setup dispatches asynchronously (the blocking per-level syncs
+        # were deliberately removed); bound the timer by the device
+        # completing all setup products, or the number under-reports
+        jax.block_until_ready(s.solve_data())
+
     slv = amgx.create_solver(Config.from_string(flagship))
     t0 = time.perf_counter()
     slv.setup(A)
+    _settle(slv)
     setup_cold_s = time.perf_counter() - t0
-    # warm setup: what resetup/compile-cached production runs see
+    # warm setup: what resetup/compile-cached production runs see.
+    # setup_breakdown records the per-level per-stage wall clock
+    # (selector / galerkin / layout / smoother_setup) so setup
+    # regressions are attributable.
     slv2 = amgx.create_solver(Config.from_string(flagship))
+    profiling.reset_timers()
     t0 = time.perf_counter()
     slv2.setup(A)
+    _settle(slv2)
     setup_s = time.perf_counter() - t0
+    breakdown = {k: round(v[1], 4) for k, v in profiling.timers().items()
+                 if k.startswith("amg.")}
+    # resetup with the structure-reuse path ON (what production
+    # coefficient-replace cycles use; hierarchy structure kept, Galerkin
+    # products recomputed)
+    slv3 = amgx.create_solver(Config.from_string(
+        flagship + ", amg:structure_reuse_levels=-1"))
+    slv3.setup(A)
+    _settle(slv3)
+    t0 = time.perf_counter()
+    slv3.resetup(A)
+    _settle(slv3)
+    resetup_s = time.perf_counter() - t0
     res = slv2.solve(b)                       # compile
     times = []
     for _ in range(reps):
@@ -116,8 +142,8 @@ def bench_flagship(n: int = 128, tolerance: str = "1e-8", reps: int = 3):
     rel = float(
         np.linalg.norm(np.asarray(amgx.ops.residual(A, res.x, b)))
         / np.linalg.norm(np.asarray(b)))
-    return (setup_cold_s, setup_s, solve_s, int(res.iterations),
-            bool(res.converged), rel)
+    return (setup_cold_s, setup_s, resetup_s, breakdown, solve_s,
+            int(res.iterations), bool(res.converged), rel)
 
 
 def main():
@@ -134,10 +160,13 @@ def main():
     except Exception as e:  # pragma: no cover - bench robustness
         extra["spmv_error"] = str(e)[:120]
     try:
-        (setup_cold, setup_s, solve_s, iters, conv, rel) = bench_flagship()
+        (setup_cold, setup_s, resetup_s, breakdown, solve_s, iters,
+         conv, rel) = bench_flagship()
         extra.update({
             "flagship_128^3_setup_cold_s": round(setup_cold, 2),
             "flagship_128^3_setup_warm_s": round(setup_s, 3),
+            "flagship_128^3_resetup_s": round(resetup_s, 3),
+            "flagship_128^3_setup_breakdown": breakdown,
             "flagship_128^3_solve_s": round(solve_s, 4),
             "flagship_128^3_outer_iters": iters,
             "flagship_128^3_converged": conv,
@@ -175,10 +204,11 @@ def main():
             old = signal.signal(signal.SIGALRM, _on_alarm)
             signal.alarm(420)
             try:
-                (sc, sw, ss, it, cv, rel) = bench_flagship(
+                (sc, sw, srs, _bd, ss, it, cv, rel) = bench_flagship(
                     256, tolerance="1e-10", reps=1)
                 extra.update({
                     "northstar_256^3_setup_warm_s": round(sw, 2),
+                    "northstar_256^3_resetup_s": round(srs, 3),
                     "northstar_256^3_solve_s": round(ss, 3),
                     "northstar_256^3_outer_iters": it,
                     "northstar_256^3_converged": cv,
