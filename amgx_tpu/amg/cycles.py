@@ -125,8 +125,15 @@ def _kcycle(amg, data, lvl: int, b, x, flex: bool):
 
 def spmv_coarsest(amg, data, v):
     """SpMV with the coarsest matrix (its CSR lives in the coarse-solver
-    data only when that solver keeps it; fall back to the stored matrix)."""
+    data only when that solver keeps it; fall back to the stored matrix).
+    Under a DistributedCoarseSolver the coarsest matrix is replicated
+    while v is shard-local: gather, apply, keep the local slice (the
+    K-cycle's coarse-grid matvec, exact_coarse_solve layout)."""
     cd = data["coarse"]
+    cs = amg.coarse_solver
+    from ..distributed.amg import DistributedCoarseSolver
+    if isinstance(cs, DistributedCoarseSolver):
+        return cs.gather_apply_slice(lambda bc: spmv(cd["A"], bc), v)
     return spmv(cd["A"], v)
 
 

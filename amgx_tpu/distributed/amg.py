@@ -42,9 +42,11 @@ from .dist_matrix import ShardMatrix, shard_matrix_from_partition
 from .partition import partition_matrix
 
 # smoother solve-data keys that partition row-wise (leading dim = rows);
-# any other key (nested preconditioners, ILU factors, permutations) marks
-# the smoother as not distribution-aware
-_ROWWISE_KEYS = {"dinv", "Einv", "colors", "is_coarse", "gs_diag"}
+# CsrMatrix-valued entries (the ILU factors) shard like the level
+# operator itself. Any other key (nested preconditioners, global
+# permutations) marks the smoother as not distribution-aware.
+_ROWWISE_KEYS = {"dinv", "Einv", "colors", "is_coarse", "gs_diag",
+                 "u_diag"}
 
 
 def _partition_rowwise(arr, n_ranks: int, n_local: int):
@@ -67,33 +69,77 @@ def _replicate(tree, n_ranks: int):
         lambda a: jnp.broadcast_to(a[None], (n_ranks,) + a.shape), tree)
 
 
+def gather_global(v_local, axis: str, n_global: int):
+    """Shard-local -> replicated global vector (drop padding)."""
+    return jax.lax.all_gather(v_local, axis, tiled=True)[:n_global]
+
+
+def keep_local_slice(v_global, axis: str, n_ranks: int, n_local: int,
+                     n_global: int):
+    """Replicated global vector -> this shard's padded local slice (the
+    single implementation of the replicate-then-keep-local ritual used
+    by the consolidation boundary, the exact coarse solve and the
+    K-cycle's coarsest matvec)."""
+    pad = n_ranks * n_local - n_global
+    vp = jnp.pad(v_global, (0, pad))
+    r = jax.lax.axis_index(axis)
+    return jax.lax.dynamic_slice(vp, (r * n_local,), (n_local,))
+
+
 def _transfer_ops(level):
     """Global P/R of a level. Classical levels carry them; aggregation
     levels materialize P[i, agg[i]] = 1 and R = P^T (the CSR view of the
-    aggregate map, aggregation_amg_level.cu:238)."""
+    aggregate map, aggregation_amg_level.cu:238). Block levels expand
+    P to the scalar unknown space (P (x) I_b), matching the
+    scalar-expanded distributed operators."""
     if hasattr(level, "P"):
         return level.P, level.R
     agg = np.asarray(level.aggregates)
     n, nc = agg.shape[0], level.coarse_size
+    bx = level.A.block_dimx
+    if bx > 1:
+        # block form P_block[i, agg[i]] = I_b: partition_matrix then
+        # scalar-expands P/R with the SAME block-aligned row rounding as
+        # the level operators, keeping per-shard vector layouts aligned
+        eye = np.broadcast_to(np.eye(bx, dtype=level.A.dtype),
+                              (n, bx, bx))
+        P = CsrMatrix.from_scipy_like(
+            np.arange(n + 1, dtype=np.int32), agg.astype(np.int32),
+            jnp.asarray(eye), n, nc, block_dims=(bx, bx))
+        order = np.argsort(agg, kind="stable")
+        counts = np.bincount(agg, minlength=nc)
+        ro = np.zeros(nc + 1, np.int32)
+        np.cumsum(counts, out=ro[1:])
+        Rm = CsrMatrix.from_scipy_like(
+            ro, order.astype(np.int32), jnp.asarray(eye), nc, n,
+            block_dims=(bx, bx))
+        return P, Rm
     P = CsrMatrix.from_scipy_like(
         np.arange(n + 1, dtype=np.int32), agg.astype(np.int32),
         np.ones(n, level.A.dtype), n, nc)
     return P, transpose(P)
 
 
-def _shard_smoother_data(sm, A_sh: ShardMatrix, n_ranks: int):
-    """Partition a smoother's solve-data pytree row-wise."""
+def _shard_smoother_data(sm, A_sh: ShardMatrix, n_ranks: int, axis: str):
+    """Partition a smoother's solve-data pytree row-wise; CsrMatrix
+    entries (triangular ILU factors) become halo-exchanging shards."""
     data = sm.solve_data()
     out = {"A": A_sh}
-    n_local = A_sh.n_local
+    # smoother per-row arrays are per BLOCK row (dinv (nb,bx,by),
+    # colors (nb,)); the shard stores scalar-expanded rows
+    n_local = A_sh.n_local // A_sh.bdimx
     for k, v in data.items():
         if k == "A":
+            continue
+        if isinstance(v, CsrMatrix):
+            out[k] = _shard(v, n_ranks, axis)
             continue
         if k not in _ROWWISE_KEYS:
             raise BadParametersError(
                 f"distributed AMG: smoother {sm.name} is not "
                 f"distribution-aware (data key {k!r}); use BLOCK_JACOBI, "
-                f"JACOBI_L1, MULTICOLOR_GS, MULTICOLOR_DILU or CF_JACOBI")
+                f"JACOBI_L1, MULTICOLOR_GS, MULTICOLOR_DILU, "
+                f"MULTICOLOR_ILU or CF_JACOBI")
         out[k] = _partition_rowwise(v, n_ranks, n_local)
     return out
 
@@ -109,27 +155,28 @@ class _ConsolidationBoundaryLevel:
     the latency-optimal merge target is full replication, which is also
     what its exact_coarse_solve does one level further down."""
 
-    def __init__(self, level, axis: str, n_ranks: int, nc_global: int):
+    def __init__(self, level, axis: str, n_ranks: int, nc_global: int,
+                 bx: int = 1):
         self._level = level
         self._axis = axis
         self._n_ranks = n_ranks
         self._nc_global = nc_global
-        self._nc_local = -(-nc_global // n_ranks)
+        # per-shard slice must match the block-aligned row rounding of
+        # the sharded transfer operators (block rows never split)
+        self._nc_local = -(-(nc_global // bx) // n_ranks) * bx
 
     def __getattr__(self, name):
         return getattr(self._level, name)
 
     def restrict(self, data, r):
         bc_local = self._level.restrict(data, r)[: self._nc_local]
-        bc = jax.lax.all_gather(bc_local, self._axis, tiled=True)
-        return bc[: self._nc_global]
+        return gather_global(bc_local, self._axis,
+                             self._n_ranks * self._nc_local
+                             )[: self._nc_global]
 
     def prolongate(self, data, xc):
-        pad = self._n_ranks * self._nc_local - self._nc_global
-        xp = jnp.pad(xc, (0, pad))
-        rank = jax.lax.axis_index(self._axis)
-        xc_local = jax.lax.dynamic_slice(xp, (rank * self._nc_local,),
-                                         (self._nc_local,))
+        xc_local = keep_local_slice(xc, self._axis, self._n_ranks,
+                                    self._nc_local, self._nc_global)
         return self._level.prolongate(data, xc_local)
 
 
@@ -150,30 +197,26 @@ class DistributedCoarseSolver:
         self.nc_local = nc_local
         self.coarsest_sweeps = coarsest_sweeps
 
+    def gather_apply_slice(self, fn, v):
+        """Replicated apply: gather v, run fn on the global vector on
+        every shard, keep the local slice."""
+        vg = gather_global(v, self.axis, self.nc_global)
+        yg = fn(vg)
+        return keep_local_slice(yg, self.axis, self.n_ranks,
+                                self.nc_local, self.nc_global)
+
     def apply(self, data, rhs):
         from ..amg.cycles import apply_coarse_solver
-        bc = jax.lax.all_gather(rhs, self.axis, tiled=True)[: self.nc_global]
-        xg = apply_coarse_solver(self.inner, data, bc, jnp.zeros_like(bc),
-                                 self.coarsest_sweeps)
-        pad = self.n_ranks * self.nc_local - self.nc_global
-        xp = jnp.pad(xg, (0, pad))
-        r = jax.lax.axis_index(self.axis)
-        return jax.lax.dynamic_slice(xp, (r * self.nc_local,),
-                                     (self.nc_local,))
+        return self.gather_apply_slice(
+            lambda bc: apply_coarse_solver(self.inner, data, bc,
+                                           jnp.zeros_like(bc),
+                                           self.coarsest_sweeps), rhs)
 
 
 def shard_amg(amg, n_ranks: int, axis: str):
     """Convert a set-up (global) AMG hierarchy for SPMD solving: returns
     the stacked solve-data pytree and rewires the hierarchy's coarse
     solver + transfer dispatch for mesh execution."""
-    if amg.cycle_name in ("CG", "CGF"):
-        raise BadParametersError(
-            "distributed AMG: K-cycles (CG/CGF) not yet supported; "
-            "use cycle=V, W or F")
-    if amg.levels and amg.levels[0].A.is_block:
-        raise BadParametersError(
-            "distributed AMG: scalar matrices only (distributed Krylov + "
-            "block-Jacobi supports block systems)")
     if isinstance(amg.coarse_solver, DistributedCoarseSolver) or any(
             isinstance(lv, _ConsolidationBoundaryLevel)
             for lv in amg.levels):
@@ -207,19 +250,24 @@ def shard_amg(amg, n_ranks: int, axis: str):
         }
         if lvl.smoother is not None:
             ld["smoother"] = _shard_smoother_data(lvl.smoother, A_sh,
-                                                  n_ranks)
+                                                  n_ranks, axis)
         levels_data.append(ld)
-    nc = amg.coarsest_A.num_rows
+    # vectors in the sharded cycle are scalar-expanded: size counts are
+    # in scalar unknowns (block rows never split across shards, so the
+    # equal-block slicing stays block-aligned)
+    nc = amg.coarsest_A.num_rows * amg.coarsest_A.block_dimx
     coarse_data = _replicate(amg.coarse_solver.solve_data(), n_ranks)
     if boundary < len(amg.levels):
         # vectors are already global below the boundary: the coarse
         # solver applies directly, and the boundary level's transfers
         # gather/slice across the mesh
-        nb = amg.levels[boundary].A.num_rows
+        Ab = amg.levels[boundary].A
+        nb = Ab.num_rows * Ab.block_dimx
         amg.levels[boundary - 1] = _ConsolidationBoundaryLevel(
-            amg.levels[boundary - 1], axis, n_ranks, nb)
+            amg.levels[boundary - 1], axis, n_ranks, nb, Ab.block_dimx)
     else:
-        nc_local = -(-nc // n_ranks)
+        bx = amg.coarsest_A.block_dimx
+        nc_local = -(-(nc // bx) // n_ranks) * bx
         amg.coarse_solver = DistributedCoarseSolver(
             amg.coarse_solver, axis, n_ranks, nc, nc_local,
             amg.coarsest_sweeps)

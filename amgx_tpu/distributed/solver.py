@@ -107,13 +107,6 @@ class DistributedSolver:
         s = self.solver
         while s is not None:
             if s.name == "AMG":
-                if part.block_dimx * part.block_dimy > 1:
-                    # fail fast: shard_amg would reject blocks anyway,
-                    # but only after the full global hierarchy build
-                    raise BadParametersError(
-                        "distributed AMG: scalar matrices only "
-                        "(distributed Krylov + block-Jacobi supports "
-                        "block systems)")
                 data = self._try_sharded_setup(s)
                 if data is not None:
                     self._sharded_amg[id(s)] = data

@@ -366,14 +366,21 @@ class MulticolorILUSolver(_ColoredSolver):
             u_new = vals - prod
             l = jnp.where(lower, l_new, 0.0)
             u = jnp.where(upper, u_new, 0.0)
-        self._Lp = CsrMatrix.from_coo(rows[lower], cols[lower], l[lower],
-                                      n, n).init(ell="never")
-        self._Up = CsrMatrix.from_coo(rows[upper], cols[upper], u[upper],
-                                      n, n).init(ell="never")
-        self._u_diag = jnp.where(Ap.diag_idx < 0, 0.0,
-                                 u[jnp.maximum(Ap.diag_idx, 0)])
-        self._perm, self._iperm = perm, iperm
-        self._colors_p = colors_p
+        # store the factors in the ORIGINAL row ordering: a proper
+        # coloring has no same-color off-diagonals (validated above), so
+        # the color-masked sweeps are ordering-independent — and
+        # original-order factors are row-partitionable, which makes this
+        # smoother distribution-aware (no global permutation at solve
+        # time)
+        ro, co = perm[rows[lower]], perm[cols[lower]]
+        self._Lp = CsrMatrix.from_coo(ro, co, l[lower], n,
+                                      n).init(ell="never")
+        ro, co = perm[rows[upper]], perm[cols[upper]]
+        self._Up = CsrMatrix.from_coo(ro, co, u[upper], n,
+                                      n).init(ell="never")
+        u_diag_p = jnp.where(Ap.diag_idx < 0, 0.0,
+                             u[jnp.maximum(Ap.diag_idx, 0)])
+        self._u_diag = jnp.zeros_like(u_diag_p).at[perm].set(u_diag_p)
 
     def _extend_pattern(self, Ap: CsrMatrix) -> CsrMatrix:
         """Level-fill pattern extension: union A with the pattern of
@@ -396,29 +403,29 @@ class MulticolorILUSolver(_ColoredSolver):
     def solve_data(self):
         d = super().solve_data()
         d.update(ilu_L=self._Lp, ilu_U=self._Up, u_diag=self._u_diag,
-                 perm=self._perm, iperm=self._iperm, colors_p=self._colors_p)
+                 colors=self.row_colors)
         return d
 
     def solve_iteration(self, data, b, st):
         A = data["A"]
         Lp, Up = data["ilu_L"], data["ilu_U"]
         u_dinv = safe_recip(data["u_diag"])
-        perm, colors_p = data["perm"], data["colors_p"]
+        colors = data["colors"]
         x = st["x"]
-        r = (b - spmv(A, x))[perm]
-        # L y = r (unit diag), colors ascending
+        r = b - spmv(A, x)
+        # L y = r (unit diag), colors ascending (original ordering:
+        # L only connects strictly lower colors)
         y = jnp.zeros_like(r)
         for c in range(self.num_colors):
             s = spmv(Lp, y)
-            y = jnp.where(colors_p == c, r - s, y)
+            y = jnp.where(colors == c, r - s, y)
         # U z = y, colors descending
         z = jnp.zeros_like(r)
         for c in range(self.num_colors - 1, -1, -1):
             s = spmv(Up, z)         # diagonal term is zero pre-assignment
-            z = jnp.where(colors_p == c, u_dinv * (y - s), z)
-        dx = jnp.zeros_like(z).at[perm].set(z)
+            z = jnp.where(colors == c, u_dinv * (y - s), z)
         out = dict(st)
-        out["x"] = x + self.relaxation_factor * dx
+        out["x"] = x + self.relaxation_factor * z
         return out
 
 

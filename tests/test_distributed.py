@@ -200,6 +200,14 @@ def _single_device_iters(cfg_str, A, b):
      " amg:relaxation_factor=0.9"),
     ("AGGREGATION", ", amg:selector=SIZE_2, amg:smoother=MULTICOLOR_DILU,"
      " amg:relaxation_factor=0.9"),
+    ("AGGREGATION", ", amg:selector=SIZE_2, amg:smoother=MULTICOLOR_ILU,"
+     " amg:relaxation_factor=1.0, amg:distributed_setup_mode=global"),
+    ("AGGREGATION", ", amg:selector=SIZE_2, amg:smoother=BLOCK_JACOBI,"
+     " amg:relaxation_factor=0.9, amg:cycle=CG,"
+     " amg:distributed_setup_mode=global"),
+    ("AGGREGATION", ", amg:selector=SIZE_2, amg:smoother=BLOCK_JACOBI,"
+     " amg:relaxation_factor=0.9, amg:cycle=CGF,"
+     " amg:distributed_setup_mode=global"),
     ("CLASSICAL", ", amg:smoother=BLOCK_JACOBI, amg:relaxation_factor=0.9"),
 ])
 def test_distributed_amg_matches_single_device(mesh, algo, extra):
@@ -222,15 +230,20 @@ def test_distributed_amg_matches_single_device(mesh, algo, extra):
     assert np.linalg.norm(r) < 1e-6 * np.linalg.norm(np.asarray(b))
 
 
-def test_distributed_amg_kcycle_rejected(mesh):
-    from amgx_tpu.errors import BadParametersError
+def test_distributed_amg_kcycle_small(mesh):
+    """K-cycle over the mesh on a small system (coarse-grid CG matvecs
+    gather/slice through the replicated coarsest level)."""
     A = gallery.poisson("7pt", 4, 4, 2 * NDEV).init()
-    cfg = Config.from_string(
-        _AMG_BASE.replace("amg:cycle=V", "amg:cycle=CG")
-        + ", amg:algorithm=AGGREGATION, amg:selector=SIZE_2")
-    ds = DistributedSolver(cfg, mesh)
-    with pytest.raises(BadParametersError):
-        ds.setup(A)
+    b = jnp.ones(A.num_rows)
+    cfg_str = (_AMG_BASE.replace("amg:cycle=V", "amg:cycle=CG")
+               + ", amg:algorithm=AGGREGATION, amg:selector=SIZE_2,"
+               " amg:smoother=BLOCK_JACOBI, amg:relaxation_factor=0.9,"
+               " amg:distributed_setup_mode=global")
+    ref = _single_device_iters(cfg_str, A, b)
+    ds = DistributedSolver(Config.from_string(cfg_str), mesh)
+    ds.setup(A)
+    res = ds.solve(np.asarray(b))
+    assert res.converged and res.iterations == ref.iterations
 
 
 @pytest.mark.parametrize("extra,expect_boundary", [
@@ -294,17 +307,28 @@ def test_distributed_block_matrix_krylov(mesh):
     assert np.linalg.norm(r) < 1e-7 * np.linalg.norm(np.asarray(b))
 
 
-def test_distributed_amg_rejects_blocks(mesh):
+def test_distributed_amg_block_matches_single_device(mesh):
+    """Block systems in distributed AMG: levels scalar-expand, the
+    transfers expand P (x) I_b, block-Jacobi smoother data partitions
+    by block rows; iteration counts match single-device."""
     A = gallery.random_matrix(64, max_nnz_per_row=4, seed=3,
                               symmetric=True, diag_dominant=True,
                               block_dims=(2, 2)).init()
-    cfg = Config.from_string(
-        "solver=FGMRES, preconditioner(amg)=AMG,"
-        " amg:algorithm=AGGREGATION, amg:selector=SIZE_2,"
-        " amg:smoother=BLOCK_JACOBI")
-    ds = DistributedSolver(cfg, mesh)
-    with pytest.raises(amgx.errors.AMGXError):
-        ds.setup(A)
+    b = jnp.ones(A.num_rows * 2)
+    cfg_str = (
+        "solver=FGMRES, max_iters=60, monitor_residual=1, tolerance=1e-8,"
+        " gmres_n_restart=30, preconditioner(amg)=AMG, amg:max_iters=1,"
+        " amg:cycle=V, amg:max_levels=4, amg:algorithm=AGGREGATION,"
+        " amg:selector=SIZE_2, amg:smoother=BLOCK_JACOBI,"
+        " amg:relaxation_factor=0.9, amg:min_coarse_rows=8")
+    ref = _single_device_iters(cfg_str, A, b)
+    assert ref.converged
+    ds = DistributedSolver(Config.from_string(cfg_str), mesh)
+    ds.setup(A)
+    res = ds.solve(np.asarray(b))
+    assert res.converged
+    assert res.iterations == ref.iterations, (res.iterations,
+                                              ref.iterations)
 
 
 def test_distributed_block_odd_rounding(mesh):
@@ -327,3 +351,33 @@ def test_distributed_block_odd_rounding(mesh):
     assert res.converged and res.iterations == r_ref.iterations
     r = np.asarray(A.to_dense()) @ np.asarray(res.x) - b
     assert np.linalg.norm(r) < 1e-7 * np.linalg.norm(b)
+
+
+def test_distributed_amg_block_consolidation(mesh):
+    """Blocks + coarse-level consolidation: the boundary wrapper's local
+    slice must use the block-aligned rounding of the sharded transfer
+    operators (iteration parity is the contract)."""
+    A = gallery.random_matrix(501, max_nnz_per_row=4, seed=11,
+                              symmetric=True, diag_dominant=True,
+                              block_dims=(2, 2)).init()
+    b = jnp.ones(A.num_rows * 2)
+    cfg_str = (
+        "solver=FGMRES, max_iters=60, monitor_residual=1, tolerance=1e-8,"
+        " gmres_n_restart=30, preconditioner(amg)=AMG, amg:max_iters=1,"
+        " amg:cycle=V, amg:max_levels=4, amg:algorithm=AGGREGATION,"
+        " amg:selector=SIZE_2, amg:smoother=BLOCK_JACOBI,"
+        " amg:relaxation_factor=0.9, amg:min_coarse_rows=8,"
+        " amg:amg_consolidation_flag=1,"
+        " amg:matrix_consolidation_lower_threshold=100")
+    ref = _single_device_iters(cfg_str, A, b)
+    assert ref.converged
+    ds = DistributedSolver(Config.from_string(cfg_str), mesh)
+    ds.setup(A)
+    from amgx_tpu.distributed.amg import _ConsolidationBoundaryLevel
+    amg_h = ds.solver.preconditioner.amg
+    assert any(isinstance(lv, _ConsolidationBoundaryLevel)
+               for lv in amg_h.levels)
+    res = ds.solve(np.asarray(b))
+    assert res.converged
+    assert res.iterations == ref.iterations, (res.iterations,
+                                              ref.iterations)
