@@ -101,6 +101,136 @@ class Distance2Interpolator(Interpolator):
     with depth)."""
 
     def generate(self, A: CsrMatrix, cf_map, strong) -> CsrMatrix:
+        from ...ops.spgemm import _on_host
+        if _on_host(A):
+            return self._generate_host(A, cf_map, strong)
+        return self._generate_jnp(A, cf_map, strong)
+
+    def _generate_host(self, A: CsrMatrix, cf_map, strong) -> CsrMatrix:
+        """Numpy formulation of the same formula for the host-setup
+        path: eager accelerator-shaped gathers cost ~10 ms each in
+        dispatch on CPU; the identical index math in numpy runs the
+        whole interpolation in tens of milliseconds."""
+        from ... import native
+        n = A.num_rows
+        ro = np.asarray(A.row_offsets)
+        cols = np.asarray(A.col_indices)
+        vals = np.asarray(A.values)
+        rows = np.repeat(np.arange(n, dtype=np.int32), np.diff(ro))
+        cf_map = np.asarray(cf_map)
+        strong = np.asarray(strong)
+        diag = np.asarray(A.diagonal())
+        sgn = np.sign(np.where(diag == 0, 1.0, diag))
+        offd = rows != cols
+        neg = offd & (vals * sgn[rows] < 0)
+        is_C = cf_map == 1
+        cidx = np.cumsum(is_C.astype(np.int64)) - 1
+        cidx = np.where(is_C, cidx, -1)
+        nc = int(is_C.sum())
+        strongC = strong & is_C[cols]
+        strongF = strong & ~is_C[cols] & offd
+
+        def compact_csr(mask):
+            r, c, v = rows[mask], cols[mask], vals[mask]
+            counts = np.bincount(r, minlength=n)
+            rp = np.zeros(n + 1, np.int64)
+            np.cumsum(counts, out=rp[1:])
+            return rp, c, v
+
+        f_ptr, f_col, f_val = compact_csr(strongF)
+        a_ptr, a_col, a_val = compact_csr(neg)
+        sc_ptr, sc_col, sc_val = compact_csr(strongC)
+        # C-hat membership: strong C neighbors + two-hop through F
+        out = native.spgemm_native(
+            n, n, f_ptr.astype(np.int32), f_col,
+            np.ones_like(f_val), sc_ptr.astype(np.int32), sc_col,
+            np.ones_like(sc_val))
+        if out is not None:
+            hp, hc, _hv = out
+            h_rows = np.repeat(np.arange(n, dtype=np.int64),
+                               np.diff(hp))
+            keys_h = h_rows * n + hc.astype(np.int64)
+        else:       # no toolchain: use the accelerator-shaped path
+            return self._generate_jnp(A, cf_map, strong)
+        sc_rows = rows[strongC].astype(np.int64)
+        keys_sc = sc_rows * n + cols[strongC].astype(np.int64)
+
+        def member(ri, cj):
+            key = ri.astype(np.int64) * n + cj.astype(np.int64)
+            out_m = np.zeros(key.shape, bool)
+            for ks in (keys_sc, keys_h):
+                if ks.shape[0]:
+                    pos = np.clip(np.searchsorted(ks, key), 0,
+                                  ks.shape[0] - 1)
+                    out_m |= ks[pos] == key
+            return out_m
+
+        # two-hop triples (i -k-> m): expand F against Abar
+        f_rows = np.repeat(np.arange(n, dtype=np.int32),
+                           np.diff(f_ptr))
+        a_row_nnz = np.diff(a_ptr)
+        counts = a_row_nnz[f_col]
+        src_f = np.repeat(np.arange(f_col.shape[0]), counts)
+        cum = np.zeros(f_col.shape[0] + 1, np.int64)
+        np.cumsum(counts, out=cum[1:])
+        offset_in_row = np.arange(int(cum[-1]), dtype=np.int64) - \
+            cum[src_f]
+        src_b = a_ptr[f_col[src_f]] + offset_in_row
+        t_i = f_rows[src_f]
+        t_m = a_col[src_b]
+        t_aik = f_val[src_f]
+        t_abar = a_val[src_b]
+        keep = member(t_i, t_m) | (t_m == t_i)
+        denom = np.zeros(f_col.shape[0])
+        np.add.at(denom, src_f, np.where(keep, t_abar, 0.0))
+        bad = denom == 0
+        dsafe = np.where(bad, 1.0, denom)
+        contrib = t_aik * t_abar / dsafe[src_f]
+        contrib = np.where(bad[src_f], 0.0, contrib)
+
+        m_is_entry = keep & is_C[t_m] & (t_m != t_i)
+        e_rows = t_i[m_is_entry]
+        e_cols = t_m[m_is_entry]
+        e_vals = contrib[m_is_entry]
+        in_chat = member(rows, cols)
+        dmask = offd & is_C[cols] & in_chat
+        fb = np.zeros(n)
+        np.add.at(fb, t_i, np.where(keep & (t_m == t_i), contrib, 0.0))
+        lump_mask = offd & ~in_chat & ~strongF
+        lump = np.zeros(n)
+        np.add.at(lump, rows, np.where(lump_mask, vals, 0.0))
+        bad_f = np.zeros(n)
+        np.add.at(bad_f, f_rows, np.where(bad, f_val, 0.0))
+        D = diag + lump + fb + bad_f
+
+        all_rows = np.concatenate([rows[dmask], e_rows])
+        all_cols = np.concatenate([cols[dmask], e_cols])
+        all_vals = np.concatenate([vals[dmask], e_vals])
+        f_row = (cf_map == 0)[all_rows]
+        w = -all_vals / np.where(D[all_rows] == 0, 1.0, D[all_rows])
+        c_rows = np.nonzero(cf_map == 1)[0].astype(np.int32)
+        p_rows = np.concatenate([all_rows[f_row], c_rows])
+        p_cols = np.concatenate([cidx[all_cols[f_row]], cidx[c_rows]])
+        p_vals = np.concatenate([w[f_row], np.ones(nc, vals.dtype)])
+        order = np.lexsort((p_cols, p_rows))
+        p_rows, p_cols, p_vals = (p_rows[order], p_cols[order],
+                                  p_vals[order])
+        # coalesce duplicates (from_coo semantics)
+        first = np.concatenate([[True], (p_rows[1:] != p_rows[:-1])
+                                | (p_cols[1:] != p_cols[:-1])])
+        seg = np.cumsum(first) - 1
+        vsum = np.zeros(int(seg[-1]) + 1 if seg.size else 0,
+                        p_vals.dtype)
+        np.add.at(vsum, seg, p_vals)
+        pr, pc = p_rows[first], p_cols[first]
+        counts = np.bincount(pr, minlength=n)
+        pp = np.zeros(n + 1, np.int32)
+        np.cumsum(counts, out=pp[1:])
+        P = CsrMatrix.from_scipy_like(pp, pc.astype(np.int32),
+                                      jnp.asarray(vsum), n, nc)
+        return _truncate(P, self.trunc_factor, self.max_elements)
+
+    def _generate_jnp(self, A: CsrMatrix, cf_map, strong) -> CsrMatrix:
         from ...ops.spgemm import _expand, csr_multiply
         n = A.num_rows
         rows, cols, vals = A.coo()

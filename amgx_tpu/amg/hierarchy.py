@@ -98,15 +98,55 @@ class AMG:
         self.precision = str(cfg.get("amg_precision", scope))
         self.print_grid_stats = bool(cfg.get("print_grid_stats", scope))
         self.intensive_smoothing = bool(cfg.get("intensive_smoothing", scope))
+        self.host_setup = str(cfg.get("amg_host_setup", scope))
         self.levels: List[AMGLevel] = []
         self.coarse_solver = None
         self.setup_time = 0.0
+        self._data_cache = None
+        self._ship_device = None
 
     # -- setup -----------------------------------------------------------
+    def _host_setup_device(self, A: CsrMatrix):
+        """Host-CPU hierarchy construction (the TPU answer to the
+        reference's host-level machinery, src/amg.cu:152-421): the
+        classical/energymin setup is hundreds of small eager index ops,
+        each costing a full device round trip on a remote accelerator —
+        built on the host CPU backend the same code runs in milliseconds,
+        and the finished hierarchy ships to the accelerator once (cached
+        solve-data). mode: auto (host when the default backend is a
+        remote accelerator and the algorithm's setup is index-heavy),
+        always, never."""
+        import jax
+        mode = self.host_setup
+        if mode == "never":
+            return None
+        try:
+            cpu = jax.devices("cpu")[0]
+        except RuntimeError:
+            return None
+        default_platform = jax.devices()[0].platform
+        if default_platform == "cpu":
+            return None          # already on host
+        if mode == "always" or self.algorithm in ("CLASSICAL",
+                                                  "ENERGYMIN"):
+            return cpu
+        return None
+
     def setup(self, A: CsrMatrix):
+        import jax
         t0 = time.perf_counter()
         self.levels = []
+        self._data_cache = None
         Af = A if A.initialized else A.init()
+        host = self._host_setup_device(Af)
+        if host is not None:
+            self._ship_device = jax.devices()[0]
+            with jax.default_device(host):
+                Af = jax.device_put(Af, host)
+                self._build_levels_checked(Af, 0)
+                self._finalize_setup(t0)
+            return self
+        self._ship_device = None
         self._build_levels_checked(Af, 0)
         self._finalize_setup(t0)
         return self
@@ -137,6 +177,16 @@ class AMG:
         if reuse == 0 or not self.levels or \
                 Af.num_rows != self.levels[0].A.num_rows:
             return self.setup(A)
+        self._data_cache = None
+        if self._ship_device is not None:
+            import jax
+            host = jax.devices("cpu")[0]
+            with jax.default_device(host):
+                return self._resetup_impl(jax.device_put(Af, host),
+                                          reuse)
+        return self._resetup_impl(Af, reuse)
+
+    def _resetup_impl(self, Af: CsrMatrix, reuse: int):
         t0 = time.perf_counter()
         k = len(self.levels) if reuse < 0 else min(reuse, len(self.levels))
         old_levels, self.levels = self.levels, []
@@ -241,6 +291,9 @@ class AMG:
     _PRECISIONS = {"double": None, "float": "float32", "bfloat16": "bfloat16"}
 
     def solve_data(self) -> Dict[str, Any]:
+        import jax
+        if self._ship_device is not None and self._data_cache is not None:
+            return self._data_cache
         data = {
             "levels": [lv.level_data() for lv in self.levels],
             "coarse": self.coarse_solver.solve_data(),
@@ -252,7 +305,6 @@ class AMG:
             # and cycle run in reduced precision inside an f64 flexible
             # Krylov outer loop — on TPU this halves (or quarters) HBM
             # traffic and turns on the f32 Pallas SpMV kernels
-            import jax
             import jax.numpy as jnp
 
             def cast(leaf):
@@ -261,6 +313,13 @@ class AMG:
                     return leaf.astype(dt)
                 return leaf
             data = jax.tree.map(cast, data)
+        if self._ship_device is not None:
+            # host-built hierarchy: one transfer to the accelerator,
+            # cached for the life of this setup
+            if self._data_cache is None:
+                self._data_cache = jax.device_put(data,
+                                                  self._ship_device)
+            return self._data_cache
         return data
 
     def _sweeps(self, level_index: int, pre: bool) -> int:

@@ -177,6 +177,12 @@ def _register_default_parameters():
     R("distributed_setup_mode", str, "distributed AMG hierarchy build: "
       "per-shard (sharded), controller-global (global), or best "
       "available (auto)", "auto", {"auto", "sharded", "global"})
+    R("amg_host_setup", str, "build the AMG hierarchy on the host CPU "
+      "backend and ship it to the accelerator once (the host-level "
+      "machinery analog, src/amg.cu:152-421); auto = host when the "
+      "default backend is a remote accelerator and the algorithm's "
+      "setup is index-heavy (CLASSICAL/ENERGYMIN)", "auto",
+      {"auto", "always", "never"})
     R("amg_precision", str, "precision of the stored hierarchy + cycle "
       "(TPU-native mixed-precision preconditioning, the dDFI-mode analog: "
       "a float32/bfloat16 cycle inside an f64 flexible Krylov solver)",

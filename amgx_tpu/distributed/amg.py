@@ -64,9 +64,17 @@ def _shard(A: CsrMatrix, n_ranks: int, axis: str) -> ShardMatrix:
 
 
 def _replicate(tree, n_ranks: int):
-    """Tile every leaf with a leading mesh axis (replicated data)."""
-    return jax.tree.map(
-        lambda a: jnp.broadcast_to(a[None], (n_ranks,) + a.shape), tree)
+    """Tile every leaf with a leading mesh axis (replicated data). A
+    host-built hierarchy (amg_host_setup) holds CPU-committed arrays;
+    normalize to the default device so the shard_mapped solve does not
+    mix committed placements."""
+    def rep(a):
+        # host round trip drops any committed placement (host-built
+        # hierarchies commit to cpu:0, which jit would refuse to mix
+        # with mesh-sharded arguments); replicated levels are small
+        a = jnp.asarray(np.asarray(a))
+        return jnp.broadcast_to(a[None], (n_ranks,) + a.shape)
+    return jax.tree.map(rep, tree)
 
 
 def gather_global(v_local, axis: str, n_global: int):
@@ -170,9 +178,7 @@ class _ConsolidationBoundaryLevel:
 
     def restrict(self, data, r):
         bc_local = self._level.restrict(data, r)[: self._nc_local]
-        return gather_global(bc_local, self._axis,
-                             self._n_ranks * self._nc_local
-                             )[: self._nc_global]
+        return gather_global(bc_local, self._axis, self._nc_global)
 
     def prolongate(self, data, xc):
         xc_local = keep_local_slice(xc, self._axis, self._n_ranks,

@@ -142,3 +142,41 @@ def rs_coarsen_native(n, row_offsets, col_indices, strong):
     if rc != 0:
         return None
     return cf
+
+
+def spgemm_native(n_a, n_b, a_ptr, a_col, a_val, b_ptr, b_col, b_val):
+    """Native Gustavson CSR SpGEMM (csr_multiply.h analog). Returns
+    (c_ptr int64 (n_a+1,), c_col int32, c_val float64) with sorted
+    columns per row, or None when the native library is unavailable."""
+    import numpy as np
+    L = lib()
+    if L is None:
+        return None
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    count = L.amgx_spgemm_count
+    count.restype = ctypes.c_longlong
+    fill = L.amgx_spgemm_fill
+    fill.restype = None
+    ap = np.ascontiguousarray(a_ptr, np.int32)
+    ac = np.ascontiguousarray(a_col, np.int32)
+    av = np.ascontiguousarray(a_val, np.float64)
+    bp = np.ascontiguousarray(b_ptr, np.int32)
+    bc = np.ascontiguousarray(b_col, np.int32)
+    bv = np.ascontiguousarray(b_val, np.float64)
+    cp = np.empty(int(n_a) + 1, np.int64)
+    nnz = count(ctypes.c_int32(int(n_a)), ctypes.c_int32(int(n_b)),
+                ap.ctypes.data_as(i32p), ac.ctypes.data_as(i32p),
+                bp.ctypes.data_as(i32p), bc.ctypes.data_as(i32p),
+                cp.ctypes.data_as(i64p))
+    cc = np.empty(int(nnz), np.int32)
+    cv = np.empty(int(nnz), np.float64)
+    fill(ctypes.c_int32(int(n_a)), ctypes.c_int32(int(n_b)),
+         ap.ctypes.data_as(i32p), ac.ctypes.data_as(i32p),
+         av.ctypes.data_as(f64p),
+         bp.ctypes.data_as(i32p), bc.ctypes.data_as(i32p),
+         bv.ctypes.data_as(f64p),
+         cp.ctypes.data_as(i64p), cc.ctypes.data_as(i32p),
+         cv.ctypes.data_as(f64p))
+    return cp, cc, cv

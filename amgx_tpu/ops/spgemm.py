@@ -56,10 +56,37 @@ def _expand(A: CsrMatrix, B: CsrMatrix):
     return out_rows, out_cols, src_a, src_b
 
 
+def _on_host(A: CsrMatrix) -> bool:
+    try:
+        return next(iter(A.values.devices())).platform == "cpu"
+    except Exception:
+        return False
+
+
 def csr_multiply(A: CsrMatrix, B: CsrMatrix) -> CsrMatrix:
-    """C = A @ B for scalar or block CSR (block: bxb @ bxb -> bxb)."""
+    """C = A @ B for scalar or block CSR (block: bxb @ bxb -> bxb).
+
+    On the host backend the product runs through the native Gustavson
+    sweep (native/src/spgemm.cpp — the csr_multiply.h analog): the
+    sort-based jnp formulation below is shaped for accelerators, where
+    it is the only option, but costs ~1 s per product at 32^3 scale on
+    a single CPU thread."""
     assert A.num_cols == B.num_rows, (A.shape, B.shape)
     A, B = _fold_diag(A), _fold_diag(B)
+    if not A.is_block and _on_host(A) and _on_host(B):
+        from .. import native
+        import numpy as np
+        out = native.spgemm_native(
+            A.num_rows, B.num_cols, np.asarray(A.row_offsets),
+            np.asarray(A.col_indices), np.asarray(A.values),
+            np.asarray(B.row_offsets), np.asarray(B.col_indices),
+            np.asarray(B.values))
+        if out is not None:
+            cp, cc, cv = out
+            return CsrMatrix.from_scipy_like(
+                cp.astype(np.int32), cc,
+                jnp.asarray(cv.astype(np.asarray(A.values).dtype)),
+                A.num_rows, B.num_cols)
     out_rows, out_cols, src_a, src_b = _expand(A, B)
     if A.is_block:
         prods = jnp.einsum("nxk,nky->nxy", A.values[src_a], B.values[src_b])

@@ -184,3 +184,24 @@ class TestClassicalSolve:
         assert res.converged
         rel = float(np.max(res.res_norm)) / float(np.max(res.norm0))
         assert rel <= 1e-6
+
+
+def test_d2_host_and_device_paths_agree():
+    """The numpy host-setup formulation of D2 (interpolators.py
+    _generate_host) and the accelerator-shaped jnp formulation compute
+    the same interpolation operator."""
+    from amgx_tpu import native
+    if native.lib() is None:
+        pytest.skip("native toolchain unavailable: _generate_host "
+                    "falls back to the jnp path (nothing to compare)")
+    A = gallery.poisson("7pt", 8, 8, 8).init()
+    cfg = Config.from_string("strength_threshold=0.25")
+    strong = registry.strength.create("AHAT", cfg,
+                                      "default").strong_mask(A)
+    cf_map = pmis_split(A, strong)
+    interp = Distance2Interpolator(cfg, "default")
+    P1 = interp._generate_host(A, cf_map, strong)
+    P2 = interp._generate_jnp(A, cf_map, strong)
+    d1 = np.asarray(P1.to_dense())
+    d2 = np.asarray(P2.to_dense())
+    np.testing.assert_allclose(d1, d2, rtol=1e-13, atol=1e-14)
