@@ -124,8 +124,8 @@ class AMG:
             cpu = jax.devices("cpu")[0]
         except RuntimeError:
             return None
-        default_platform = jax.devices()[0].platform
-        if default_platform == "cpu":
+        ambient = jax.config.jax_default_device or jax.devices()[0]
+        if ambient.platform == "cpu":
             return None          # already on host
         if mode == "always" or self.algorithm in ("CLASSICAL",
                                                   "ENERGYMIN"):
@@ -137,16 +137,22 @@ class AMG:
         t0 = time.perf_counter()
         self.levels = []
         self._data_cache = None
-        Af = A if A.initialized else A.init()
-        host = self._host_setup_device(Af)
+        host = self._host_setup_device(A)
         if host is not None:
-            self._ship_device = jax.devices()[0]
+            # decide BEFORE init: the SpMV-layout build is itself eager
+            # device work that belongs on the host in this mode; ship to
+            # the device the caller's context selected
+            self._ship_device = (jax.config.jax_default_device
+                                 or jax.devices()[0])
             with jax.default_device(host):
-                Af = jax.device_put(Af, host)
+                Af = jax.device_put(A, host)
+                if not Af.initialized:
+                    Af = Af.init()
                 self._build_levels_checked(Af, 0)
                 self._finalize_setup(t0)
             return self
         self._ship_device = None
+        Af = A if A.initialized else A.init()
         self._build_levels_checked(Af, 0)
         self._finalize_setup(t0)
         return self
@@ -173,17 +179,19 @@ class AMG:
         structure (aggregates / CF-split + transfer operators) and only
         recompute the Galerkin products; deeper levels rebuild fully."""
         reuse = int(self.cfg.get("structure_reuse_levels", self.scope))
-        Af = A if A.initialized else A.init()
         if reuse == 0 or not self.levels or \
-                Af.num_rows != self.levels[0].A.num_rows:
+                A.num_rows != self.levels[0].A.num_rows:
             return self.setup(A)
         self._data_cache = None
         if self._ship_device is not None:
             import jax
             host = jax.devices("cpu")[0]
             with jax.default_device(host):
-                return self._resetup_impl(jax.device_put(Af, host),
-                                          reuse)
+                Af = jax.device_put(A, host)
+                if not Af.initialized:
+                    Af = Af.init()
+                return self._resetup_impl(Af, reuse)
+        Af = A if A.initialized else A.init()
         return self._resetup_impl(Af, reuse)
 
     def _resetup_impl(self, Af: CsrMatrix, reuse: int):

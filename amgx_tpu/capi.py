@@ -24,6 +24,7 @@ import itertools
 import sys
 from typing import Any, Dict, Optional
 
+import jax.numpy as jnp
 import numpy as np
 
 from . import initialize as _initialize_framework
@@ -38,6 +39,7 @@ from .modes import parse_mode
 
 _handles: Dict[int, Any] = {}
 _next_id = itertools.count(1)
+_random_seed = itertools.count(1)    # AMGX_vector_set_random sequence
 
 def _new_handle(obj) -> int:
     h = next(_next_id)
@@ -1095,3 +1097,165 @@ def AMGX_matrix_comm_from_maps_one_ring(mtx_h, allocated_halo_depth,
 
 
 AMGX_matrix_comm_from_maps = AMGX_matrix_comm_from_maps_one_ring
+
+
+# ---------------------------------------------------------------------------
+# C API tail (include/amgx_c.h misc functions)
+# ---------------------------------------------------------------------------
+
+
+@_api
+@_outputs(4)
+def AMGX_matrix_download_all(mtx_h):
+    """include/amgx_c.h:294 — rc, row_ptrs, col_indices, data, diag
+    (diag None: the container folds external diagonals on upload)."""
+    m = _get(mtx_h, _CMatrix)
+    if m.A is None:
+        raise AMGXError("matrix not uploaded", RC.BAD_PARAMETERS)
+    # flat value layout, matching what AMGX_matrix_upload_all accepts
+    return (RC.OK, np.asarray(m.A.row_offsets).copy(),
+            np.asarray(m.A.col_indices).copy(),
+            np.asarray(m.A.values).reshape(-1).copy(),
+            None if not m.A.has_external_diag
+            else np.asarray(m.A.diag).reshape(-1).copy())
+
+
+@_api
+def AMGX_matrix_vector_multiply(mtx_h, x_h, y_h):
+    """include/amgx_c.h:306 — y = A x."""
+    from .ops.spmv import spmv
+    m = _get(mtx_h, _CMatrix)
+    x = _get(x_h, _CVector)
+    y = _get(y_h, _CVector)
+    if m.A is None or x.v is None:
+        raise AMGXError("matrix/vector not uploaded", RC.BAD_PARAMETERS)
+    with m.resources.res.device_context():
+        y.v = np.asarray(spmv(m.A, jnp.asarray(
+            np.asarray(x.v, m.mode.vec_dtype))))
+    y.block_dim = m.A.block_dimx
+    return RC.OK
+
+
+@_api
+@_outputs(1)
+def AMGX_solver_calculate_residual_norm(slv_h, mtx_h, rhs_h, x_h):
+    """include/amgx_c.h:410 — rc, per-block-component norm array (the
+    solver's configured norm over b - A x)."""
+    from .ops.spmv import residual
+    s = _get(slv_h, _CSolver)
+    m = _get(mtx_h, _CMatrix)
+    b = _get(rhs_h, _CVector)
+    x = _get(x_h, _CVector)
+    if m.A is None or b.v is None or x.v is None:
+        raise AMGXError("system not uploaded", RC.BAD_PARAMETERS)
+    dt = m.mode.vec_dtype
+    with m.resources.res.device_context():
+        r = residual(m.A, jnp.asarray(np.asarray(x.v, dt)),
+                     jnp.asarray(np.asarray(b.v, dt)))
+        nrm = s.solver._norm(r) if s.solver is not None else \
+            jnp.linalg.norm(r)
+    return RC.OK, np.atleast_1d(np.asarray(nrm))
+
+
+@_api
+def AMGX_vector_set_random(vec_h, n):
+    """include/amgx_c.h:355 — uniform [0, 1) entries (thrust random
+    analog; deterministic per call counter for reproducibility)."""
+    v = _get(vec_h, _CVector)
+    seed = next(_random_seed)    # call-indexed, independent of handles
+    v.v = np.random.default_rng(seed).random(n).astype(
+        v.mode.vec_dtype)
+    v.block_dim = 1
+    return RC.OK
+
+
+@_api
+@_outputs(2)
+def AMGX_matrix_check_symmetry(mtx_h):
+    """include/amgx_c.h:588 — rc, structurally_symmetric, symmetric."""
+    m = _get(mtx_h, _CMatrix)
+    if m.A is None:
+        raise AMGXError("matrix not uploaded", RC.BAD_PARAMETERS)
+    A = m.A
+    ro = np.asarray(A.row_offsets)
+    ci = np.asarray(A.col_indices)
+    va = np.asarray(A.values)
+    n = A.num_rows
+    rows = np.repeat(np.arange(n), np.diff(ro))
+    if A.is_block:
+        va = va.reshape(va.shape[0], -1)
+    order_f = np.lexsort((ci, rows))
+    order_t = np.lexsort((rows, ci))
+    struct = bool(np.array_equal(rows[order_f], ci[order_t]) and
+                  np.array_equal(ci[order_f], rows[order_t]))
+    sym = False
+    if struct:
+        vt = va[order_t]
+        if A.is_block:
+            bx = A.block_dimx
+            vt = vt.reshape(-1, bx, bx).transpose(0, 2, 1).reshape(
+                vt.shape[0], -1)
+        sym = bool(np.allclose(va[order_f], vt, rtol=1e-12, atol=0))
+    if sym and A.has_external_diag and A.is_block:
+        # non-symmetric external diagonal blocks break value symmetry
+        d = np.asarray(A.diag)
+        sym = bool(np.allclose(d, d.transpose(0, 2, 1), rtol=1e-12,
+                               atol=0))
+    return RC.OK, int(struct), int(sym)
+
+
+@_api
+def AMGX_matrix_attach_coloring(mtx_h, row_coloring, num_rows,
+                                num_colors):
+    """include/amgx_c.h:512 — user-supplied row coloring consumed by the
+    multicolor smoothers instead of a computed scheme."""
+    m = _get(mtx_h, _CMatrix)
+    if m.A is None:
+        raise AMGXError("matrix not uploaded", RC.BAD_PARAMETERS)
+    colors = np.asarray(row_coloring, np.int32)
+    if colors.shape[0] != num_rows or num_rows != m.A.num_rows:
+        raise AMGXError("coloring size mismatch", RC.BAD_PARAMETERS)
+    import dataclasses
+    m.A = dataclasses.replace(m.A, user_colors=jnp.asarray(colors),
+                              user_num_colors=int(num_colors))
+    return RC.OK
+
+
+@_api
+def AMGX_matrix_set_boundary_separation(mtx_h, boundary_separation):
+    """include/amgx_c.h:310 — accepted-inert by design: the latency
+    hiding here is structural (owned/halo entry split,
+    distributed/dist_matrix.py), not a reorder flag."""
+    _get(mtx_h, _CMatrix)
+    return RC.OK
+
+
+def AMGX_abort(rsrc_h=None, err=1):
+    """include/amgx_c.h:173 — hard process abort (no cleanup), the
+    MPI_Abort analog."""
+    import os
+    sys.stderr.write(f"AMGX_abort: err={err}\n")
+    sys.stderr.flush()
+    os._exit(int(err))
+
+
+def AMGX_get_build_info_strings():
+    """include/amgx_c.h:154 — rc, version, build date, build system."""
+    from . import __version__
+    import jax
+    return (RC.OK, f"amgx_tpu {__version__}",
+            f"jax {jax.__version__}",
+            f"backend {jax.devices()[0].platform}")
+
+
+@_api
+@_outputs(1)
+def AMGX_config_get_default_number_of_rings(cfg_h):
+    """include/amgx_c.h:210 — halo-ring requirement of the configured
+    solver stack (2 for classical AMG's distributed RAP, 1 otherwise —
+    the reference's selector-driven rule)."""
+    cfg = _get(cfg_h, Config)
+    classical = any(
+        name == "algorithm" and str(v).upper() == "CLASSICAL"
+        for (scope, name), v in cfg.values.items())
+    return RC.OK, (2 if classical else 1)

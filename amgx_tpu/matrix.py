@@ -50,9 +50,11 @@ def _seg_sum(data, seg_ids, num_segments):
 @functools.partial(
     jax.tree_util.register_dataclass,
     data_fields=["row_offsets", "col_indices", "values", "diag",
-                 "row_ids", "diag_idx", "ell_cols", "ell_vals", "dia_vals"],
+                 "row_ids", "diag_idx", "ell_cols", "ell_vals", "dia_vals",
+                 "user_colors"],
     meta_fields=["num_rows", "num_cols", "block_dimx", "block_dimy",
-                 "initialized", "dia_offsets", "grid_shape"],
+                 "initialized", "dia_offsets", "grid_shape",
+                 "user_num_colors"],
 )
 @dataclasses.dataclass(frozen=True)
 class CsrMatrix:
@@ -81,6 +83,10 @@ class CsrMatrix:
     # gallery generators and propagated by the GEO aggregation path so
     # every coarse level keeps the banded/DIA roofline layout
     grid_shape: Optional[tuple] = None
+    # user-supplied row coloring (AMGX_matrix_attach_coloring): consumed
+    # by color_matrix ahead of any computed scheme
+    user_colors: Optional[Array] = None
+    user_num_colors: int = 0
 
     # ------------------------------------------------------------------
     @property

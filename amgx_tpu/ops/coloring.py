@@ -199,6 +199,10 @@ class SerialGreedyBfsColoring(MatrixColoring):
 
 
 def color_matrix(A: CsrMatrix, cfg, scope: str = "default") -> Coloring:
-    """MatrixColoringFactory entry (src/core.cu:669)."""
+    """MatrixColoringFactory entry (src/core.cu:669). A user-attached
+    coloring (AMGX_matrix_attach_coloring) overrides the configured
+    scheme, matching the reference's attach semantics."""
+    if A.user_colors is not None:
+        return Coloring(A.user_colors, int(A.user_num_colors))
     name = str(cfg.get("matrix_coloring_scheme", scope))
     return registry.matrix_coloring.create(name, cfg, scope).color_matrix(A)

@@ -8,6 +8,7 @@ import numpy as np
 import pytest
 
 from amgx_tpu import capi, gallery
+from amgx_tpu.config import Config
 from amgx_tpu.errors import RC
 from amgx_tpu.io import write_system
 
@@ -225,3 +226,109 @@ def test_cli_example(tmp_path):
         capture_output=True, text=True, env=env, timeout=600)
     assert out.returncode == 0, out.stderr
     assert "status: success" in out.stdout
+
+
+class TestCApiTail:
+    """The misc function tail (include/amgx_c.h): download_all,
+    matrix_vector_multiply, residual norm, set_random, check_symmetry,
+    attach_coloring, build info, default rings."""
+
+    def _system(self):
+        capi.AMGX_initialize()
+        cfg = capi.AMGX_config_create(
+            "config_version=2, solver=PCG, max_iters=50, tolerance=1e-8,"
+            " monitor_residual=1")[1]
+        rs = capi.AMGX_resources_create_simple(cfg)[1]
+        mtx = capi.AMGX_matrix_create(rs, "dDDI")[1]
+        A = gallery.poisson("7pt", 6, 6, 6).init()
+        n = A.num_rows
+        capi.AMGX_matrix_upload_all(
+            mtx, n, A.nnz, 1, 1, np.asarray(A.row_offsets),
+            np.asarray(A.col_indices), np.asarray(A.values))
+        return cfg, rs, mtx, A, n
+
+    def test_download_all_roundtrip(self):
+        _, _, mtx, A, n = self._system()
+        rc, ro, ci, va, diag = capi.AMGX_matrix_download_all(mtx)
+        assert rc == capi.RC.OK
+        assert np.array_equal(ro, np.asarray(A.row_offsets))
+        assert np.array_equal(ci, np.asarray(A.col_indices))
+        assert np.allclose(va, np.asarray(A.values))
+        assert diag is None
+
+    def test_matrix_vector_multiply(self):
+        _, rs, mtx, A, n = self._system()
+        x = capi.AMGX_vector_create(rs, "dDDI")[1]
+        y = capi.AMGX_vector_create(rs, "dDDI")[1]
+        xv = np.random.default_rng(0).standard_normal(n)
+        capi.AMGX_vector_upload(x, n, 1, xv)
+        assert capi.AMGX_matrix_vector_multiply(mtx, x, y) == capi.RC.OK
+        got = capi.AMGX_vector_download(y)[1]
+        ref = np.asarray(A.to_dense()) @ xv
+        np.testing.assert_allclose(got, ref, rtol=1e-12)
+
+    def test_calculate_residual_norm(self):
+        cfg, rs, mtx, A, n = self._system()
+        slv = capi.AMGX_solver_create(rs, "dDDI", cfg)[1]
+        capi.AMGX_solver_setup(slv, mtx)
+        b = capi.AMGX_vector_create(rs, "dDDI")[1]
+        x = capi.AMGX_vector_create(rs, "dDDI")[1]
+        capi.AMGX_vector_upload(b, n, 1, np.ones(n))
+        capi.AMGX_vector_set_zero(x, n, 1)
+        rc, nrm = capi.AMGX_solver_calculate_residual_norm(slv, mtx, b, x)
+        assert rc == capi.RC.OK
+        assert np.allclose(nrm, np.linalg.norm(np.ones(n)))
+
+    def test_vector_set_random(self):
+        _, rs, _, _, n = self._system()
+        v = capi.AMGX_vector_create(rs, "dDDI")[1]
+        assert capi.AMGX_vector_set_random(v, 100) == capi.RC.OK
+        out = capi.AMGX_vector_download(v)[1]
+        assert out.shape == (100,) and (out >= 0).all() and (out < 1).all()
+
+    def test_check_symmetry(self):
+        _, _, mtx, _, _ = self._system()
+        rc, struct, sym = capi.AMGX_matrix_check_symmetry(mtx)
+        assert rc == capi.RC.OK and struct == 1 and sym == 1
+
+    def test_check_symmetry_nonsym(self):
+        capi.AMGX_initialize()
+        cfg = capi.AMGX_config_create("solver=PCG")[1]
+        rs = capi.AMGX_resources_create_simple(cfg)[1]
+        mtx = capi.AMGX_matrix_create(rs, "dDDI")[1]
+        # pattern-symmetric, value-nonsymmetric
+        ro = np.array([0, 2, 4])
+        ci = np.array([0, 1, 0, 1])
+        va = np.array([2.0, -1.0, -0.5, 2.0])
+        capi.AMGX_matrix_upload_all(mtx, 2, 4, 1, 1, ro, ci, va)
+        rc, struct, sym = capi.AMGX_matrix_check_symmetry(mtx)
+        assert rc == capi.RC.OK and struct == 1 and sym == 0
+
+    def test_attach_coloring_overrides_scheme(self):
+        from amgx_tpu.ops.coloring import color_matrix
+        _, _, mtx, _, n = self._system()
+        colors = (np.arange(n) % 3).astype(np.int32)
+        assert capi.AMGX_matrix_attach_coloring(
+            mtx, colors, n, 3) == capi.RC.OK
+        m = capi._get(mtx)
+        cl = color_matrix(m.A, Config.from_string(""), "default")
+        assert np.array_equal(np.asarray(cl.row_colors), colors)
+        assert cl.num_colors == 3
+
+    def test_build_info_and_rings(self):
+        rc, ver, date, system = capi.AMGX_get_build_info_strings()
+        assert rc == capi.RC.OK and ver.startswith("amgx_tpu")
+        cfg = capi.AMGX_config_create(
+            "solver=PCG, preconditioner(amg)=AMG,"
+            " amg:algorithm=CLASSICAL")[1]
+        rc, rings = capi.AMGX_config_get_default_number_of_rings(cfg)
+        assert rc == capi.RC.OK and rings == 2
+        cfg2 = capi.AMGX_config_create(
+            "solver=PCG, preconditioner(amg)=AMG,"
+            " amg:algorithm=AGGREGATION")[1]
+        assert capi.AMGX_config_get_default_number_of_rings(cfg2)[1] == 1
+
+    def test_boundary_separation_accepted(self):
+        _, _, mtx, _, _ = self._system()
+        assert capi.AMGX_matrix_set_boundary_separation(mtx, 1) == \
+            capi.RC.OK
