@@ -57,13 +57,16 @@ def _edge_weights(A: CsrMatrix, formula: int = 0):
     tr = rows[order]
     tc = cols[order]
     match = (tr == cols) & (tc == rows)
-    v_t = jnp.where(match, jnp.abs(v[order]), 0.0)
+    v_t = jnp.where(match, v[order], 0.0)        # signed a_ji
     if formula == 1:
+        # -0.5 (a_ij/a_ii + a_ji/a_jj) — Notay coupling
+        # (common_selector.h:113-119, SIGNED values)
         w = -0.5 * (v / jnp.where(d[rows] == 0, 1.0, d[rows])
                     + v_t / jnp.where(d[cols] == 0, 1.0, d[cols]))
     else:
         denom = jnp.maximum(absd[rows], absd[cols])
-        w = 0.5 * (jnp.abs(v) + v_t) / jnp.where(denom == 0, 1.0, denom)
+        w = 0.5 * (jnp.abs(v) + jnp.abs(v_t)) / \
+            jnp.where(denom == 0, 1.0, denom)
     w = jnp.where(rows == cols, 0.0, w)
     return rows, cols, w
 
@@ -277,11 +280,19 @@ class Size8Selector(_SizeNSelector):
 @registry.aggregation_selectors.register("MULTI_PAIRWISE")
 class MultiPairwiseSelector(_SizeNSelector):
     """Pairwise aggregation repeated `aggregation_passes` times
-    (multi_pairwise.cu analog; Notay-style weights via weight_formula)."""
+    (multi_pairwise.cu analog): each pass matches the weight graph of
+    the previous pass's aggregates — the reference's default
+    full_ghost_level=0 "weight matrix" scheme. notay_weights=1 switches
+    the edge weights to Notay's signed coupling measure
+    (multi_pairwise.cu:816, the weight_formula=1 formula); unmatched
+    vertices merge into their strongest neighbor aggregate
+    (mergeWithExistingAggregates analog = merge_singletons)."""
 
     def __init__(self, cfg, scope):
         super().__init__(cfg, scope)
         self.passes = int(cfg.get("aggregation_passes", scope))
+        if int(cfg.get("notay_weights", scope)):
+            self.weight_formula = 1
 
 
 @registry.aggregation_selectors.register("DUMMY")
@@ -357,3 +368,89 @@ class GeoSelector(AggregationSelector):
         self.pair_axes = axes
         self.coarse_shape = (cnx, cny, cnz)
         return jnp.asarray(agg, jnp.int32), int(cnx * cny * cnz)
+
+
+@registry.aggregation_selectors.register("SERIAL_GREEDY")
+@registry.aggregation_selectors.register("SERIAL_GREEDY_BFS")
+class SerialGreedySelector(AggregationSelector):
+    """Serial greedy BFS aggregation (serial_greedy.cu, 319 LoC). The
+    reference runs this selector on the HOST even in device builds
+    (serial_greedy.cu:62-80 copies the matrix down); this is the same
+    host-serial design: seed at the minimum-degree unaggregated vertex,
+    grow the aggregate by the strongest edge until `aggregate_size`,
+    repeat. Deterministic by construction."""
+
+    def set_aggregates(self, A: CsrMatrix):
+        import numpy as np
+        size = max(int(self.cfg.get("aggregate_size", self.scope)), 2)
+        n = A.num_rows
+        rows_j, cols_j, w_j = _edge_weights(A, self.weight_formula)
+        # _edge_weights returns (row, col)-lexicographically sorted edges
+        rows = np.asarray(rows_j)
+        cols = np.asarray(cols_j)
+        w = np.asarray(w_j)
+        starts = np.searchsorted(rows, np.arange(n + 1))
+        agg = np.full(n, -1, np.int64)
+        deg = np.diff(starts)
+        for seed in np.argsort(deg, kind="stable"):
+            if agg[seed] >= 0:
+                continue
+            agg[seed] = seed
+            members = [seed]
+            while len(members) < size:
+                best_w, best_v = 0.0, -1
+                for m in members:
+                    lo, hi = starts[m], starts[m + 1]
+                    for e in range(lo, hi):
+                        v = cols[e]
+                        if agg[v] < 0 and w[e] > best_w:
+                            best_w, best_v = w[e], v
+                if best_v < 0:
+                    break
+                agg[best_v] = seed
+                members.append(best_v)
+        agg_j, nc = _renumber(jnp.asarray(agg, jnp.int32), n)
+        return agg_j, int(nc)
+
+
+@registry.aggregation_selectors.register("ADAPTIVE")
+class AdaptiveSelector(AggregationSelector):
+    """Adaptive (smoothed-vector binning) aggregation. The reference
+    registers this selector but its setAggregates raises
+    NOT_IMPLEMENTED with the intended algorithm left in comments
+    (adaptive.cu:142-211); this implements that documented algorithm
+    for real: relax a random vector on A x = 0 (so x approaches the
+    algebraically smooth error), then bin the entries into n/4 linear
+    bins — vertices whose smooth-error values agree aggregate
+    together."""
+
+    def set_aggregates(self, A: CsrMatrix):
+        import numpy as np
+        n = A.num_rows
+        ns = n * A.block_dimy          # scalar unknowns (block SpMV)
+        rng = np.random.default_rng(1234 if self.deterministic else None)
+        x = jnp.asarray(rng.uniform(-1.0, 1.0, ns), A.dtype)
+        d = A.diagonal()
+        if d.ndim == 3:
+            d = jnp.diagonal(d, axis1=1, axis2=2).reshape(-1)
+        dinv = jnp.where(d == 0, 0.0, 1.0 / jnp.where(d == 0, 1.0, d))
+
+        from ...ops.spmv import spmv
+
+        def sweep(_, x):
+            return x - 0.66 * dinv * spmv(A, x)    # 15 Jacobi sweeps
+        x = jax.lax.fori_loop(0, 15, sweep, x)
+        if A.block_dimy > 1:
+            # bin per block row by the mean smooth-error component
+            x = x.reshape(n, A.block_dimy).mean(axis=1)
+        lo = jnp.min(x)
+        rng_w = jnp.maximum(jnp.max(x) - lo, 1e-30)
+        n_bins = max(n // 4, 1)
+        bins = jnp.clip(((x - lo) / rng_w * n_bins).astype(jnp.int32),
+                        0, n_bins - 1)
+        # stamp each bin with its first member (root id), then compact
+        first = jnp.full((n_bins,), n, jnp.int32).at[bins].min(
+            jnp.arange(n, dtype=jnp.int32))
+        agg = first[bins]
+        agg_j, nc = _renumber(agg, n)
+        return agg_j, int(nc)

@@ -1,0 +1,75 @@
+"""Per-level cycle convergence analysis.
+
+Analog of src/cycles/convergence_analysis.cu (:222): for the first
+`convergence_analysis` levels, run one instrumented error-propagation
+cycle (b = 0, x = e random, so the cycle acts on pure error) and report
+the residual reduction of each phase — pre-smoothing, coarse-grid
+correction, post-smoothing — per level. The instrumented cycle runs
+eagerly once (a diagnostic, not the production traced cycle).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.spmv import residual
+
+
+def _nrm(v):
+    return float(jnp.linalg.norm(v))
+
+
+def _analyze(amg, data, lvl, b, x, rows):
+    from .cycles import _coarse_solve, _smooth
+    levels = amg.levels
+    if lvl == len(levels):
+        return _coarse_solve(amg, data, b, x)
+    level = levels[lvl]
+    ldata = data["levels"][lvl]
+    instrument = lvl < amg.convergence_analysis
+    rec = {"level": lvl, "n": level.A.num_rows}
+    if instrument:
+        rec["pre_in"] = _nrm(residual(ldata["A"], x, b))
+    x = _smooth(level, ldata, b, x, amg._sweeps(lvl, pre=True))
+    if instrument:
+        rec["pre_out"] = _nrm(residual(ldata["A"], x, b))
+    r = residual(ldata["A"], x, b)
+    bc = level.restrict(ldata, r)
+    xc = jnp.zeros_like(bc)
+    xc = _analyze(amg, data, lvl + 1, bc, xc, rows)
+    x = x + level.prolongate(ldata, xc)
+    if instrument:
+        rec["coarse_out"] = _nrm(residual(ldata["A"], x, b))
+    x = _smooth(level, ldata, b, x, amg._sweeps(lvl, pre=False))
+    if instrument:
+        rec["post_out"] = _nrm(residual(ldata["A"], x, b))
+        rows.append(rec)
+    return x
+
+
+def convergence_analysis(amg, data=None, seed: int = 0) -> str:
+    """Run the instrumented error-propagation cycle and format the
+    per-level phase-reduction report (printConvergenceAnalysis
+    analog)."""
+    if data is None:
+        data = amg.solve_data()
+    n = amg.levels[0].A.num_rows * amg.levels[0].A.block_dimx
+    e = jnp.asarray(np.random.default_rng(seed).standard_normal(n),
+                    amg.levels[0].A.dtype)
+    b = jnp.zeros_like(e)            # b = 0: the cycle acts on x = e
+    rows = []
+    _analyze(amg, data, 0, b, e, rows)
+    out = ["Convergence analysis (error-propagation cycle, b=0):",
+           f"{'level':>5} {'rows':>10} {'presmooth':>10} "
+           f"{'coarse':>10} {'postsmooth':>10} {'total':>10}"]
+
+    def ratio(a, c):
+        return c / a if a > 0 else 0.0
+    for r in sorted(rows, key=lambda r: r["level"]):
+        pre = ratio(r["pre_in"], r["pre_out"])
+        crs = ratio(r["pre_out"], r["coarse_out"])
+        post = ratio(r["coarse_out"], r["post_out"])
+        tot = ratio(r["pre_in"], r["post_out"])
+        out.append(f"{r['level']:>5} {r['n']:>10} {pre:>10.4f} "
+                   f"{crs:>10.4f} {post:>10.4f} {tot:>10.4f}")
+    return "\n".join(out)

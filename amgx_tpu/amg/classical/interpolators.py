@@ -14,8 +14,9 @@ distance2.cu 2274 LoC, multipass.cu). Round-1 surface:
   with COO masks + segment sums (no per-row loops).
 - Truncation (interp_truncation_factor / interp_max_elements) trims P
   and rescales rows to preserve the row sum (truncate analog).
-- MULTIPASS falls back to D1 after aggressive coarsening (full
-  multipass interpolation is a later-round item, tracked in SURVEY §7).
+- MULTIPASS: real Stuben multipass interpolation (multipass.cu analog)
+  via filtered SpGEMM passes — F-points acquire weights pass by pass
+  through already-interpolated neighbors (see MultipassInterpolator).
 """
 from __future__ import annotations
 

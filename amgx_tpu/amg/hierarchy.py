@@ -99,6 +99,8 @@ class AMG:
         self.print_grid_stats = bool(cfg.get("print_grid_stats", scope))
         self.intensive_smoothing = bool(cfg.get("intensive_smoothing", scope))
         self.host_setup = str(cfg.get("amg_host_setup", scope))
+        self.convergence_analysis = int(cfg.get("convergence_analysis",
+                                                scope))
         self.levels: List[AMGLevel] = []
         self.coarse_solver = None
         self.setup_time = 0.0
@@ -294,6 +296,12 @@ class AMG:
         if self.print_grid_stats:
             from ..output import amgx_printf
             amgx_printf(self.grid_stats())
+        if self.convergence_analysis > 0 and self.levels:
+            # convergence_analysis.cu: instrumented error-propagation
+            # cycle over the first `convergence_analysis` levels
+            from ..output import amgx_printf
+            from .analysis import convergence_analysis
+            amgx_printf(convergence_analysis(self) + "\n")
 
     # -- solve-phase data -------------------------------------------------
     _PRECISIONS = {"double": None, "float": "float32", "bfloat16": "bfloat16"}

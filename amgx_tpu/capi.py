@@ -1160,12 +1160,12 @@ def AMGX_solver_calculate_residual_norm(slv_h, mtx_h, rhs_h, x_h):
 @_api
 def AMGX_vector_set_random(vec_h, n):
     """include/amgx_c.h:355 — uniform [0, 1) entries (thrust random
-    analog; deterministic per call counter for reproducibility)."""
+    analog; deterministic per call counter for reproducibility). The
+    vector's block dimension is preserved."""
     v = _get(vec_h, _CVector)
     seed = next(_random_seed)    # call-indexed, independent of handles
     v.v = np.random.default_rng(seed).random(n).astype(
         v.mode.vec_dtype)
-    v.block_dim = 1
     return RC.OK
 
 
@@ -1189,7 +1189,9 @@ def AMGX_matrix_check_symmetry(mtx_h):
     struct = bool(np.array_equal(rows[order_f], ci[order_t]) and
                   np.array_equal(ci[order_f], rows[order_t]))
     sym = False
-    if struct:
+    if struct and A.block_dimx != A.block_dimy:
+        sym = False               # non-square blocks: never symmetric
+    elif struct:
         vt = va[order_t]
         if A.is_block:
             bx = A.block_dimx
@@ -1215,6 +1217,10 @@ def AMGX_matrix_attach_coloring(mtx_h, row_coloring, num_rows,
     colors = np.asarray(row_coloring, np.int32)
     if colors.shape[0] != num_rows or num_rows != m.A.num_rows:
         raise AMGXError("coloring size mismatch", RC.BAD_PARAMETERS)
+    if colors.size and (colors.min() < 0 or colors.max() >= num_colors):
+        raise AMGXError(
+            f"coloring values must lie in [0, {num_colors})",
+            RC.BAD_PARAMETERS)
     import dataclasses
     m.A = dataclasses.replace(m.A, user_colors=jnp.asarray(colors),
                               user_num_colors=int(num_colors))

@@ -160,7 +160,10 @@ def _register_default_parameters():
     # amg level
     R("algorithm", str, "AMG algorithm", "CLASSICAL",
       ("CLASSICAL", "AGGREGATION", "ENERGYMIN"))
-    R("amg_host_levels_rows", int, "rows below which levels run on host (-1 off)", -1)
+    R("amg_host_levels_rows", int, "rows below which levels run on host "
+      "(-1 off). Accepted-inert by design on this backend: XLA owns "
+      "placement during the solve, and the setup-phase host/device "
+      "split is governed by amg_host_setup instead", -1)
     # cycles
     R("cycle", str, "cycle shape", "V", ("V", "W", "F", "CG", "CGF"))
     R("max_levels", int, "max number of levels", 100)

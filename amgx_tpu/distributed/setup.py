@@ -247,10 +247,11 @@ def _sharded_weights(E: _Edges, diag, halo_diag, formula: int):
     d_r = dl[jnp.minimum(E.rows, E.n_local)]
     d_c = E.col_state(diag, halo_diag, 0.0)
     if formula == 1:
-        # single-device formula 1 pairs the signed value with the ABS
-        # transpose value (selectors._edge_weights); |a_ji| = |a_ij|
+        # Notay coupling -0.5 (a_ij/a_ii + a_ji/a_jj)
+        # (common_selector.h:113-119); a_ji = a_ij under the documented
+        # value-symmetry assumption
         w = -0.5 * (E.vals / jnp.where(d_r == 0, 1.0, d_r)
-                    + v / jnp.where(d_c == 0, 1.0, d_c))
+                    + E.vals / jnp.where(d_c == 0, 1.0, d_c))
     else:
         denom = jnp.maximum(jnp.abs(d_r), jnp.abs(d_c))
         w = v / jnp.where(denom == 0, 1.0, denom)

@@ -162,3 +162,47 @@ class TestAMGSolve:
         # scaled matrix: solution should be half
         np.testing.assert_allclose(np.asarray(r2.x), np.asarray(r1.x) / 2.0,
                                    rtol=1e-3, atol=1e-9)
+
+
+class TestSelectorVariants:
+    """serial_greedy.cu / adaptive.cu / multi_pairwise.cu analogs."""
+
+    def _solve(self, sel, extra=""):
+        A = gallery.poisson("7pt", 8, 8, 8).init()
+        b = jnp.ones(A.num_rows)
+        cfg = Config.from_string(
+            "solver(s)=FGMRES, s:max_iters=80, s:tolerance=1e-8,"
+            " s:monitor_residual=1, s:preconditioner(amg)=AMG,"
+            " amg:algorithm=AGGREGATION, amg:smoother=JACOBI_L1,"
+            " amg:max_iters=1, amg:min_coarse_rows=16,"
+            f" amg:selector={sel}" + extra)
+        s = amgx.create_solver(cfg)
+        s.setup(A)
+        r = s.solve(b)
+        tr = np.linalg.norm(
+            np.asarray(b) - np.asarray(ops.spmv(A, r.x)))
+        assert bool(r.converged) and tr < 1e-6 * np.linalg.norm(
+            np.asarray(b))
+        return s.preconditioner.amg
+
+    def test_serial_greedy_respects_aggregate_size(self):
+        amg_h = self._solve("SERIAL_GREEDY", ", amg:aggregate_size=4")
+        n0, n1 = (amg_h.levels[0].A.num_rows,
+                  amg_h.levels[0].coarse_size)
+        # greedy size-4 growth: coarsening ratio between 2x and 4x
+        assert 2.0 <= n0 / n1 <= 4.5
+
+    def test_adaptive_bins_smooth_error(self):
+        amg_h = self._solve("ADAPTIVE")
+        assert amg_h.levels[0].coarse_size <= amg_h.levels[0].A.num_rows // 3
+
+    def test_multi_pairwise_notay_weights(self):
+        # Notay coupling -0.5(a_ij/a_ii + a_ji/a_jj) must produce a
+        # usable pairwise hierarchy (it collapsed to zero weights when
+        # the transpose term was taken in absolute value)
+        amg_h = self._solve("MULTI_PAIRWISE",
+                            ", amg:notay_weights=1,"
+                            " amg:aggregation_passes=2")
+        n0, n1 = (amg_h.levels[0].A.num_rows,
+                  amg_h.levels[0].coarse_size)
+        assert n0 / n1 >= 3.0      # two pairwise passes ~ 4x
