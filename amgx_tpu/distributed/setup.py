@@ -581,8 +581,7 @@ def _phase_b_body(M: ShardMatrix, offsets, agg, w_vals, axis: str,
     counts = jnp.concatenate([
         nc_local[None], n_unique[None], n_own_u[None], n_halo_u[None],
         hlist_cnt[None], n_p_halo[None], n_r_halo[None]])
-    return (slot_s, cj_s, v_s, cid_sem, cid_phys, slot, mcid, mgid,
-            offsets_c, counts)
+    return (slot_s, cj_s, v_s, cid_sem, cid_phys, mcid, mgid, counts)
 
 
 def _count_unique_remote(vals_phys, mask, me, NCL: int):
@@ -591,12 +590,14 @@ def _count_unique_remote(vals_phys, mask, me, NCL: int):
 
 
 def _phase_c_body(M: ShardMatrix, offsets, triples, cid_sem, cid_phys,
-                  slot, agg, mcid, mgid, axis: str, NCL_c: int,
+                  mcid, mgid, offsets_c, axis: str, NCL_c: int,
                   E_own: int, E_halo: int, H_c: int, mp_c: int,
                   H_p: int, mp_p: int, H_r: int, mp_r: int,
                   build_transfers: bool):
     """Assemble the coarse ShardMatrix (+ P and R transfer shards) from
-    phase B's sorted triples, building the coarse halo maps on device."""
+    phase B's sorted triples, building the coarse halo maps on device.
+    Everything row-placement derives from the per-vertex coarse ids
+    (works for both the single-pass and the composed multipass path)."""
     me = jax.lax.axis_index(axis)
     R = offsets.shape[0] - 1
     n = M.n_local
@@ -604,8 +605,7 @@ def _phase_c_body(M: ShardMatrix, offsets, triples, cid_sem, cid_phys,
     Etot = slot_s.shape[0]
     idx_sem = offsets[me] + jnp.arange(n, dtype=jnp.int32)
     active = idx_sem < offsets[me + 1]
-    _, _, nc_local, offsets_c = _coarse_numbering(
-        agg, active, offsets, me, n, axis)
+    nc_local = offsets_c[me + 1] - offsets_c[me]
     valid_s = slot_s < NCL_c
     first = jnp.concatenate(
         [jnp.ones((1,), bool),
@@ -634,13 +634,13 @@ def _phase_c_body(M: ShardMatrix, offsets, triples, cid_sem, cid_phys,
     A_c = dict(rid_own=rid_own, ci_own=ci_own, va_own=va_own,
                rid_halo=rid_halo, ci_halo=ci_halo, va_halo=va_halo,
                diag=diag, halo_src=hlist, a2a_send=send_c,
-               a2a_recv=recv_c, offsets_c=offsets_c)
+               a2a_recv=recv_c)
     if not build_transfers:
         return A_c, None, None
     dt = v_s.dtype
     # P: one entry per active fine row at column cid
     owner_p = jnp.clip(cid_phys // NCL_c, 0, R)
-    own_p = active & (owner_p == me)
+    own_p = active & (cid_phys >= 0) & (owner_p == me)
     halo_p = active & (cid_phys >= 0) & (owner_p != me)
     ar = jnp.arange(n, dtype=jnp.int32)
     plist, pcnt = _unique_remote(cid_phys, active & (cid_phys >= 0),
@@ -662,10 +662,11 @@ def _phase_c_body(M: ShardMatrix, offsets, triples, cid_sem, cid_phys,
                 diag=jnp.ones((n,), dt), halo_src=plist,
                 a2a_send=send_p, a2a_recv=recv_p)
     # R: rows = my coarse slots; columns = fine member vertices
-    owner_root = _owner_of_sem(agg, offsets, R, active & (agg >= 0))
-    local_m = active & (owner_root == me)
-    root_local = jnp.clip(agg - offsets[me], 0, n - 1)
-    r_rid_o = jnp.where(local_m, slot[root_local], NCL_c).astype(jnp.int32)
+    owner_f = _owner_of_sem(cid_sem, offsets_c, R,
+                            active & (cid_sem >= 0))
+    local_m = active & (owner_f == me)
+    r_rid_o = jnp.where(local_m, cid_sem - offsets_c[me], NCL_c
+                        ).astype(jnp.int32)
     r_rid_o, r_ci_o, r_va_o = _sorted_by_rid(
         r_rid_o, ar, jnp.where(local_m, 1.0, 0.0).astype(dt),
         n_sent=NCL_c)
@@ -800,8 +801,10 @@ def _smoother_data(name: str, M: ShardMatrix):
 
 _SHARDED_SMOOTHERS = {"JACOBI", "BLOCK_JACOBI", "JACOBI_L1", "NOSOLVER",
                       "DUMMY"}
+# selector -> matching passes. MULTI_PAIRWISE's entry marks membership
+# only; its real pass count comes from cfg aggregation_passes.
 _SHARDED_SELECTORS = {"SIZE_2": 1, "PARALLEL_GREEDY": 1, "SIZE_4": 2,
-                      "SIZE_8": 3}
+                      "SIZE_8": 3, "MULTI_PAIRWISE": 2}
 
 
 def sharded_eligible(amg, A) -> Optional[str]:
@@ -812,8 +815,6 @@ def sharded_eligible(amg, A) -> Optional[str]:
     sel = str(amg.cfg.get("selector", amg.scope)).upper()
     if sel not in _SHARDED_SELECTORS:
         return f"selector {sel} not sharded (geo/dummy use global setup)"
-    if _SHARDED_SELECTORS[sel] > 1:
-        return f"multi-pass selector {sel} not yet sharded"
     if A.is_block:
         return "block systems use the global setup"
     if amg.cycle_name in ("CG", "CGF"):
@@ -918,6 +919,53 @@ def build_sharded_hierarchy(amg, shard_A: ShardMatrix, mesh, axis: str):
     levels, levels_data, ncl_last = [], [], None
     offsets_last = None
     lvl = 0
+    sel = str(cfg.get("selector", scope)).upper()
+    passes = _SHARDED_SELECTORS.get(sel, 1)
+    if sel == "MULTI_PAIRWISE":
+        passes = max(int(cfg.get("aggregation_passes", scope)), 1)
+        if int(cfg.get("notay_weights", scope)):
+            formula = 1
+
+    def runA(Ms, offs_np, graph):
+        offs = jnp.asarray(offs_np)
+
+        def fa(Mx, _offs=offs, _g=graph):
+            out = _phase_a_body(Mx.local(), _offs, axis, max_it,
+                                formula, merge, _g)
+            return jax.tree.map(lambda a: a[None], out)
+        return _wrap(mesh, axis, Ms, fa)(Ms)
+
+    def runB(Ms, offs_np, agg_s, w_s, NCL, mq, mt, graph_rap):
+        offs = jnp.asarray(offs_np)
+
+        def fb(args, _offs=offs):
+            Mx, a_, w_ = args
+            out = _phase_b_body(Mx.local(), _offs, a_[0], w_[0], axis,
+                                NCL, mq, mt, mq, graph_rap)
+            return jax.tree.map(lambda a: a[None], out)
+        return _wrap(mesh, axis, (Ms, agg_s, w_s), fb)((Ms, agg_s, w_s))
+
+    def runC(Ms, offs_np, offsets_c_np, triples, cid_sem_s, cid_phys_s,
+             mcid_s, mgid_s, sizes, build_transfers):
+        offs = jnp.asarray(offs_np)
+        offs_c = jnp.asarray(offsets_c_np)
+        E_own, E_halo, H_c, H_p, H_r = sizes
+
+        def fc(args, _offs=offs, _offs_c=offs_c):
+            (Mx, s1, c1, v1, cs, cp, mc, mg) = args
+            out = _phase_c_body(
+                Mx.local(), _offs, (s1[0], c1[0], v1[0]), cs[0], cp[0],
+                mc[0], mg[0], _offs_c, axis, _NCL_of(offsets_c_np),
+                E_own, E_halo, H_c, max(H_c, 1), H_p, max(H_p, 1),
+                H_r, max(H_r, 1), build_transfers)
+            return jax.tree.map(
+                lambda a: a[None] if a is not None else None, out)
+        argsC = (Ms, *triples, cid_sem_s, cid_phys_s, mcid_s, mgid_s)
+        return _wrap(mesh, axis, argsC, fc)(argsC)
+
+    def _NCL_of(offsets_c_np):
+        return max(int(np.diff(offsets_c_np).max()), 1)
+
     while True:
         n = int(offsets[-1])
         if (lvl + 1 >= amg.max_levels or n <= max(amg.min_coarse_rows, 1)
@@ -926,62 +974,119 @@ def build_sharded_hierarchy(amg, shard_A: ShardMatrix, mesh, axis: str):
             break
         if lvl > 0 and n <= n_local0:
             break      # tail fits one shard's budget: consolidate
-        offs = jnp.asarray(offsets)
-
-        def fa(Ms, _offs=offs):
-            Ml = Ms.local()
-            agg, paired, w, counts = _phase_a_body(
-                Ml, _offs, axis, max_it, formula, merge, False)
-            return agg[None], paired[None], w[None], counts[None]
-
-        agg, paired, w, countsA = _wrap(mesh, axis, M, fa)(M)
+        # -- pass 1: matching on this level's matrix --------------------
+        agg, paired, w, countsA = runA(M, offsets, False)
         ca = np.asarray(countsA)
         nc_locals = ca[:, 0].astype(np.int64)
         nc_g = int(nc_locals.sum())
-        if nc_g <= 0 or nc_g >= n or (n / max(nc_g, 1)) < \
-                amg.coarsen_threshold:
+        if nc_g <= 0 or nc_g >= n:
+            break
+        if passes == 1 and (n / max(nc_g, 1)) < amg.coarsen_threshold:
+            # multipass selectors apply the threshold to the COMPOSED
+            # ratio below (hierarchy._build_levels semantics)
             break
         NCL_c = max(int(nc_locals.max()), 1)
         maxt = max(int(ca[:, 1:1 + R].max()), 1)
         maxm = max(int(ca[:, 1 + R:1 + 2 * R].max()), 1)
-
-        def fb(args, _offs=offs, _NCL=NCL_c, _mq=maxm, _mt=maxt,
-               _mm=maxm):
-            Ms, agg_s, w_s = args
-            out = _phase_b_body(Ms.local(), _offs, agg_s[0], w_s[0],
-                                axis, _NCL, _mq, _mt, _mm, False)
-            return jax.tree.map(lambda a: a[None], out)
-
-        outB = _wrap(mesh, axis, (M, agg, w), fb)((M, agg, w))
-        (slot_s, cj_s, v_s, cid_sem, cid_phys, slot, mcid, mgid,
-         offsets_c_dev, countsB) = outB
+        outB = runB(M, offsets, agg, w, NCL_c, maxm, maxt,
+                    graph_rap=(passes > 1))
+        (slot_s, cj_s, v_s, cid_sem, cid_phys, mcid, mgid,
+         countsB) = outB
         cb = np.asarray(countsB)
-        E_own = max(int(cb[:, 2].max()), 1)
-        E_halo = max(int(cb[:, 3].max()), 1)
-        H_c = max(int(cb[:, 4].max()), 1)
-        H_p = max(int(cb[:, 5].max()), 1)
-        H_r = max(int(cb[:, 6].max()), 1)
-
-        def fc(args, _offs=offs, _NCL=NCL_c, _Eo=E_own, _Eh=E_halo,
-               _Hc=H_c, _Hp=H_p, _Hr=H_r):
-            (Ms, slot_s_, cj_s_, v_s_, cid_sem_, cid_phys_, slot_,
-             agg_, mcid_, mgid_) = args
-            out = _phase_c_body(
-                Ms.local(), _offs, (slot_s_[0], cj_s_[0], v_s_[0]),
-                cid_sem_[0], cid_phys_[0], slot_[0], agg_[0], mcid_[0],
-                mgid_[0], axis, _NCL, _Eo, _Eh, _Hc, max(_Hc, 1),
-                _Hp, max(_Hp, 1), _Hr, max(_Hr, 1), True)
-            return jax.tree.map(lambda a: a[None], out)
-
-        argsC = (M, slot_s, cj_s, v_s, cid_sem, cid_phys, slot, agg,
-                 mcid, mgid)
-        A_c_f, P_f, R_f = _wrap(mesh, axis, argsC, fc)(argsC)
-        A_c_f.pop("offsets_c", None)
+        sizes = tuple(max(int(cb[:, i].max()), 1) for i in
+                      (2, 3, 4, 5, 6))
         offsets_c = np.concatenate(
             [[0], np.cumsum(nc_locals)]).astype(np.int32)
-        A_c = _mk_shard(A_c_f, R * NCL_c, NCL_c, NCL_c, H_c, R, axis)
-        P_sh = _mk_shard(P_f, n_g0, M.n_local, NCL_c, H_p, R, axis)
-        R_sh = _mk_shard(R_f, R * NCL_c, NCL_c, M.n_local, H_r, R, axis)
+        # -- passes 2..P: matching on the coarse weight graph -----------
+        if passes > 1:
+            G_f, _, _ = runC(M, offsets, offsets_c,
+                             (slot_s, cj_s, v_s), cid_sem, cid_phys,
+                             mcid, mgid, sizes, False)
+            G = _mk_shard(G_f, R * NCL_c, NCL_c, NCL_c, sizes[2], R,
+                          axis)
+            offs_g = offsets_c
+            cid_fine = cid_sem          # per-FINE-vertex coarse id
+            for p in range(2, passes + 1):
+                aggp, pairedp, wp, countsAp = runA(G, offs_g, True)
+                cap = np.asarray(countsAp)
+                ncl_p = cap[:, 0].astype(np.int64)
+                if int(ncl_p.sum()) <= 0:
+                    break               # pass made no progress
+                NCLp = max(int(ncl_p.max()), 1)
+                mtp = max(int(cap[:, 1:1 + R].max()), 1)
+                mmp = max(int(cap[:, 1 + R:1 + 2 * R].max()), 1)
+                outBp = runB(G, offs_g, aggp, wp, NCLp, mmp, mtp,
+                             graph_rap=True)
+                (gs, gc, gv, Tp, _Tphys, _mc, _mg, countsBp) = outBp
+                # compose: fine vertex -> its pass-p coarse id
+                offs_gj = jnp.asarray(offs_g)
+
+                def fcnt(args, _o=offs_gj):
+                    c_, = args
+                    return _compose_counts_body(c_[0], _o, axis)[None]
+                qc = np.asarray(_wrap(mesh, axis, (cid_fine,), fcnt)(
+                    (cid_fine,)))
+                maxq = max(int(qc.max()), 1)
+
+                def fcomp(args, _o=offs_gj, _mq=maxq):
+                    c_, t_ = args
+                    return _compose_body(c_[0], t_[0], _o, axis,
+                                         _mq)[None]
+                cid_fine = _wrap(mesh, axis, (cid_fine, Tp), fcomp)(
+                    (cid_fine, Tp))
+                offsets_c = np.concatenate(
+                    [[0], np.cumsum(ncl_p)]).astype(np.int32)
+                nc_locals = ncl_p
+                if p < passes:
+                    cbp = np.asarray(countsBp)
+                    sizes_p = tuple(max(int(cbp[:, i].max()), 1)
+                                    for i in (2, 3, 4, 5, 6))
+                    G_f, _, _ = runC(G, offs_g, offsets_c,
+                                     (gs, gc, gv), Tp, _Tphys, _mc,
+                                     _mg, sizes_p, False)
+                    G = _mk_shard(G_f, R * NCLp, NCLp, NCLp,
+                                  sizes_p[2], R, axis)
+                offs_g = offsets_c
+            nc_g = int(nc_locals.sum())
+            if nc_g >= n or (n / max(nc_g, 1)) < amg.coarsen_threshold:
+                break
+            NCL_c = max(int(np.diff(offsets_c).max()), 1)  # composed
+            # -- final RAP on the fine matrix with composed cids --------
+            offs_j = jnp.asarray(offsets)
+            offs_cj = jnp.asarray(offsets_c)
+
+            # per-dest budgets for the final routing
+            def ffin(args, _o=offs_j, _oc=offs_cj):
+                Mx, c_ = args
+                return _final_route_counts(Mx.local(), _o, c_[0], _oc,
+                                           axis)[None]
+            fc2 = np.asarray(_wrap(mesh, axis, (M, cid_fine), ffin)(
+                (M, cid_fine)))
+            maxt2 = max(int(fc2[:, :R].max()), 1)
+            maxm2 = max(int(fc2[:, R:].max()), 1)
+
+            def fb2(args, _o=offs_j, _oc=offs_cj, _NCL=NCL_c,
+                    _mt=maxt2, _mm=maxm2):
+                Mx, c_ = args
+                out = _phase_b2_full(Mx.local(), _o, c_[0], _oc, axis,
+                                     _NCL, _mt, _mm)
+                return jax.tree.map(lambda a: a[None], out)
+            outB2 = _wrap(mesh, axis, (M, cid_fine), fb2)((M, cid_fine))
+            (slot_s, cj_s, v_s, cid_phys2, mcid, mgid, countsB2) = outB2
+            cid_sem = cid_fine
+            cid_phys = cid_phys2
+            cb2 = np.asarray(countsB2)
+            sizes = tuple(max(int(cb2[:, i].max()), 1) for i in
+                          (2, 3, 4, 5, 6))
+        A_c_f, P_f, R_f = runC(M, offsets, offsets_c,
+                               (slot_s, cj_s, v_s), cid_sem, cid_phys,
+                               mcid, mgid, sizes, True)
+        NCL_c = max(int(np.diff(offsets_c).max()), 1)  # final numbering
+        A_c = _mk_shard(A_c_f, R * NCL_c, NCL_c, NCL_c, sizes[2], R,
+                        axis)
+        P_sh = _mk_shard(P_f, n_g0, M.n_local, NCL_c, sizes[3], R, axis)
+        R_sh = _mk_shard(R_f, R * NCL_c, NCL_c, M.n_local, sizes[4], R,
+                         axis)
         level = DistAMGLevel(M, lvl)
         levels.append(level)
         levels_data.append({"A": M, "P": P_sh, "R": R_sh})
@@ -1023,3 +1128,117 @@ def build_sharded_hierarchy(amg, shard_A: ShardMatrix, mesh, axis: str):
     amg.levels[boundary - 1] = ShardedConsolidationLevel(
         levels[-1], axis, offsets_last, ncl_last)
     return {"levels": levels_data + tail_data, "coarse": coarse_data}
+
+
+# ---------------------------------------------------------------------------
+# multipass (SIZE_4 / SIZE_8 / MULTI_PAIRWISE) support: matching repeats
+# on the coarse weight graph, composed cids drive one final RAP
+# ---------------------------------------------------------------------------
+
+def _phase_b2_body(M: ShardMatrix, offsets, cid_sem, cid_phys,
+                   offsets_c, axis: str, NCL_c: int, maxt: int,
+                   maxm: int):
+    """RAP + member routing from PRE-COMPOSED per-vertex coarse ids
+    (the multipass path: ids come from matching rounds on coarse weight
+    graphs, not from this level's own aggregate roots)."""
+    me = jax.lax.axis_index(axis)
+    R = offsets.shape[0] - 1
+    n = M.n_local
+    E = _Edges(M, offsets, me)
+    idx_sem = offsets[me] + jnp.arange(n, dtype=jnp.int32)
+    active = idx_sem < offsets[me + 1]
+    owner_final = _owner_of_sem(cid_sem, offsets_c, R,
+                                active & (cid_sem >= 0))
+    slot_s, cj_s, v_s, first, n_unique = _rap_triples(
+        E, cid_sem, cid_phys, owner_final, me, offsets_c, NCL_c, axis,
+        R, maxt)
+    hlist_cnt = _count_unique_remote(cj_s, first, me, NCL_c)
+    owner_cj = jnp.clip(cj_s // NCL_c, 0, R)
+    n_own_u = jnp.sum((first & (owner_cj == me)).astype(jnp.int32))
+    n_halo_u = jnp.sum((first & (owner_cj != me)).astype(jnp.int32))
+    gid_phys = me * n + jnp.arange(n, dtype=jnp.int32)
+    dest_m = jnp.where(owner_final == me, R, owner_final)
+    mcid, mgid = _route((cid_sem, gid_phys), dest_m, me, axis, R, maxm,
+                        (_SENT, _SENT))
+    n_p_halo = _count_unique_remote(cid_phys, active & (cid_phys >= 0),
+                                    me, NCL_c)
+    n_r_halo = _count_unique_remote(mgid, mcid != _SENT, me, n)
+    counts = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32), n_unique[None], n_own_u[None],
+        n_halo_u[None], hlist_cnt[None], n_p_halo[None],
+        n_r_halo[None]])
+    return slot_s, cj_s, v_s, mcid, mgid, counts
+
+
+def _compose_counts_body(cid_sem, offsets_c, axis: str):
+    """Per-peer query counts for the compose lookup (fine vertex ->
+    owner of its current coarse id)."""
+    R = offsets_c.shape[0] - 1
+    me = jax.lax.axis_index(axis)
+    valid = cid_sem >= 0
+    owner = _owner_of_sem(cid_sem, offsets_c, R, valid)
+    remote = jnp.where(owner == me, R, owner)
+    cnt = jnp.zeros((R,), jnp.int32).at[
+        jnp.clip(remote, 0, R - 1)].add((remote < R).astype(jnp.int32))
+    return cnt
+
+
+def _compose_body(cid_sem, table_sem, offsets_c, axis: str,
+                  maxq: int):
+    """cid_new[i] = table[cid_sem[i]] — the pass-composition lookup
+    (table maps this pass's coarse vertices, shard-local, to the next
+    pass's semantic coarse ids)."""
+    R = offsets_c.shape[0] - 1
+    me = jax.lax.axis_index(axis)
+    n_local_c = table_sem.shape[0]
+    valid = cid_sem >= 0
+    owner = _owner_of_sem(cid_sem, offsets_c, R, valid)
+    local_ans = table_sem[jnp.clip(cid_sem - offsets_c[me], 0,
+                                   n_local_c - 1)]
+    remote_owner = jnp.where(owner == me, R, owner)
+    looked = _remote_lookup(table_sem, cid_sem, remote_owner, offsets_c,
+                            me, n_local_c, axis, R, maxq,
+                            jnp.int32(-1))
+    out = jnp.where(owner == me, local_ans, looked)
+    return jnp.where(valid, out, -1).astype(jnp.int32)
+
+
+def _final_route_counts(M: ShardMatrix, offsets, cid_sem, offsets_c,
+                        axis: str):
+    """Per-dest triple + member counts for the final multipass RAP
+    (packed (2R,)): [triples_to_peer*R, members_to_peer*R]."""
+    me = jax.lax.axis_index(axis)
+    R = offsets.shape[0] - 1
+    n = M.n_local
+    E = _Edges(M, offsets, me)
+    idx_sem = offsets[me] + jnp.arange(n, dtype=jnp.int32)
+    active = idx_sem < offsets[me + 1]
+    owner = _owner_of_sem(cid_sem, offsets_c, R, active & (cid_sem >= 0))
+    ol = jnp.concatenate([owner, jnp.full((1,), R, jnp.int32)])
+    dest_e = jnp.where(E.valid, ol[jnp.minimum(E.rows, n)], R)
+    tri = jnp.zeros((R,), jnp.int32).at[
+        jnp.clip(dest_e, 0, R - 1)].add((dest_e < R).astype(jnp.int32))
+    mem_r = jnp.where(owner == me, R, owner)
+    mem = jnp.zeros((R,), jnp.int32).at[
+        jnp.clip(mem_r, 0, R - 1)].add((mem_r < R).astype(jnp.int32))
+    return jnp.concatenate([tri, mem])
+
+
+def _phase_b2_full(M: ShardMatrix, offsets, cid_sem, offsets_c,
+                   axis: str, NCL_c: int, maxt: int, maxm: int):
+    """Final multipass RAP: derive physical ids from the composed
+    semantic cids, route triples and member records, dedup-sum."""
+    me = jax.lax.axis_index(axis)
+    R = offsets.shape[0] - 1
+    n = M.n_local
+    idx_sem = offsets[me] + jnp.arange(n, dtype=jnp.int32)
+    active = idx_sem < offsets[me + 1]
+    valid = active & (cid_sem >= 0)
+    rank_c = _owner_of_sem(cid_sem, offsets_c, R, valid)
+    rr = jnp.clip(rank_c, 0, R - 1)
+    cid_phys = jnp.where(valid, rr * NCL_c + (cid_sem - offsets_c[rr]),
+                         -1).astype(jnp.int32)
+    (slot_s, cj_s, v_s, mcid, mgid, counts) = _phase_b2_body(
+        M, offsets, cid_sem, cid_phys, offsets_c, axis, NCL_c, maxt,
+        maxm)
+    return slot_s, cj_s, v_s, cid_phys, mcid, mgid, counts

@@ -168,3 +168,37 @@ class TestShardedSetupFallback:
         d, r = _solve_dist(A, "auto")
         assert _n_sharded_levels(d) >= 1
         assert bool(r.converged)
+
+
+class TestShardedMultipass:
+    """SIZE_4/SIZE_8/MULTI_PAIRWISE sharded: later matching passes run
+    on the coarse weight graph (its own device-built halo maps), the
+    composed cids drive one final RAP — iteration counts must match the
+    single-device multipass selector exactly."""
+
+    @pytest.mark.parametrize("sel,extra", [
+        ("SIZE_4", ""),
+        ("SIZE_8", ""),
+        ("MULTI_PAIRWISE",
+         ", amg:aggregation_passes=2, amg:notay_weights=1"),
+    ])
+    def test_multipass_parity(self, sel, extra):
+        A = _poisson()
+        sel_extra = extra + f", amg:selector={sel}"
+        base = BASE.replace(", amg:selector=SIZE_2", "")
+        s = amgx.create_solver(Config.from_string(base + sel_extra))
+        s.setup(A)
+        r1 = s.solve(jnp.ones(A.num_rows))
+        mesh = default_mesh(N_DEV)
+        d = DistributedSolver(Config.from_string(
+            base + sel_extra + ", amg:distributed_setup_mode=sharded"),
+            mesh)
+        d.setup(A)
+        r2 = d.solve(np.ones(A.num_rows))
+        assert bool(r2.converged)
+        assert int(r1.iterations) == int(r2.iterations)
+        amg1 = s.preconditioner.amg
+        amg2 = d.solver.preconditioner.amg
+        assert len(amg1.levels) == len(amg2.levels)
+        assert amg1.coarsest_A.num_rows == amg2.coarsest_A.num_rows
+        assert _n_sharded_levels(d) >= 1
