@@ -764,7 +764,7 @@ def _mk_shard(fields: dict, n_global: int, n_local: int,
         n_halo=n_halo, n_ranks=R, axis_name=axis, exchange_mode="a2a")
 
 
-def _smoother_data(name: str, M: ShardMatrix):
+def _smoother_data(name: str, M: ShardMatrix, solver):
     """Row-partitioned smoother solve-data from stacked shard fields
     (JACOBI dinv; JACOBI_L1 dinv with halo-inclusive off-diagonal L1
     sums — solver._dinv_l1 semantics)."""
@@ -778,6 +778,29 @@ def _smoother_data(name: str, M: ShardMatrix):
 
     if name in ("JACOBI", "BLOCK_JACOBI"):
         return {"A": M, "dinv": jax.jit(dinv_of)(d)}
+    if name == "CHEBYSHEV_POLY":
+        # taus need only the global Gershgorin bound: per-shard absolute
+        # row sums (owned + halo entries are all shard-local), global
+        # max across shards (polynomial.py solver_setup semantics)
+        n_local = M.n_local
+
+        @jax.jit
+        def lam_of(vo, ro, vh, rh):
+            def one(vo, ro, vh, rh):
+                s = jax.ops.segment_sum(jnp.abs(vo), ro,
+                                        num_segments=n_local) + \
+                    jax.ops.segment_sum(jnp.abs(vh), rh,
+                                        num_segments=n_local)
+                return jnp.max(s)
+            return jnp.max(jax.vmap(one)(vo, ro, vh, rh))
+
+        from ..solvers.polynomial import chebyshev_poly_coeffs
+        lam = lam_of(M.va_own, M.rid_own, M.va_halo, M.rid_halo)
+        taus = jnp.asarray(chebyshev_poly_coeffs(solver.order),
+                           M.dtype) / lam.astype(M.dtype)
+        R = M.rid_own.shape[0]
+        return {"A": M,
+                "taus": jnp.broadcast_to(taus[None], (R,) + taus.shape)}
     if name == "JACOBI_L1":
         n_local = M.n_local
 
@@ -800,7 +823,7 @@ def _smoother_data(name: str, M: ShardMatrix):
 
 
 _SHARDED_SMOOTHERS = {"JACOBI", "BLOCK_JACOBI", "JACOBI_L1", "NOSOLVER",
-                      "DUMMY"}
+                      "DUMMY", "CHEBYSHEV_POLY"}
 # selector -> matching passes. MULTI_PAIRWISE's entry marks membership
 # only; its real pass count comes from cfg aggregation_passes.
 _SHARDED_SELECTORS = {"SIZE_2": 1, "PARALLEL_GREEDY": 1, "SIZE_4": 2,
@@ -1106,7 +1129,7 @@ def build_sharded_hierarchy(amg, shard_A: ShardMatrix, mesh, axis: str):
         lv.smoother = make_solver(name, cfg, scp)
         lv.smoother._owns_scaling = False
         levels_data[k]["smoother"] = _smoother_data(
-            name.upper(), levels_data[k]["A"])
+            name.upper(), levels_data[k]["A"], lv.smoother)
     tail_data = []
     for k in range(boundary, len(amg.levels)):
         lv = amg.levels[k]

@@ -31,6 +31,18 @@ from ..ops.spmv import spmv
 from .base import Solver
 
 
+def chebyshev_poly_coeffs(m: int):
+    """The 'magic damping' tau numerators (chebyshev_poly.cu damping
+    schedule); divide by the spectral bound to get the taus. Single
+    implementation shared by the single-device and sharded setups."""
+    beta = np.pi / (4.0 * m + 2.0)
+    return np.asarray([
+        np.cos(beta) ** 2
+        / (np.cos(beta * (2 * i + 1)) ** 2 - np.sin(beta) ** 2)
+        for i in range(m)
+    ])
+
+
 def _abs_row_sums(A):
     rows, cols, vals = A.coo()
     s = jax.ops.segment_sum(jnp.abs(vals), rows, num_segments=A.num_rows,
@@ -198,15 +210,8 @@ class ChebyshevPolySolver(Solver):
         # tunnel round trip per AMG level (~170 ms each on the bench
         # rig); taus ships to the solve program as a device array
         lam = jnp.max(_abs_row_sums(self.A))   # Gershgorin bound
-        m = self.order
-        beta = np.pi / (4.0 * m + 2.0)
-        coeffs = np.asarray([
-            np.cos(beta) ** 2
-            / (np.cos(beta * (2 * i + 1)) ** 2 - np.sin(beta) ** 2)
-            for i in range(m)
-        ])
-        self._taus = jnp.asarray(coeffs, self.A.dtype) / \
-            lam.astype(self.A.dtype)
+        self._taus = jnp.asarray(chebyshev_poly_coeffs(self.order),
+                                 self.A.dtype) / lam.astype(self.A.dtype)
 
     def solve_data(self):
         d = super().solve_data()

@@ -202,3 +202,17 @@ class TestShardedMultipass:
         assert len(amg1.levels) == len(amg2.levels)
         assert amg1.coarsest_A.num_rows == amg2.coarsest_A.num_rows
         assert _n_sharded_levels(d) >= 1
+
+
+def test_sharded_chebyshev_poly_smoother():
+    """CHEBYSHEV_POLY in the sharded setup: the taus come from the
+    global (psum'd via stacked max) Gershgorin bound — iteration parity
+    with the single-device hierarchy."""
+    A = _poisson()
+    extra = (", amg:smoother=CHEBYSHEV_POLY,"
+             " amg:chebyshev_polynomial_order=2")
+    s, r1 = _solve_single(A, extra)
+    d, r2 = _solve_dist(A, "sharded", extra)
+    assert bool(r2.converged)
+    assert int(r1.iterations) == int(r2.iterations)
+    assert _n_sharded_levels(d) >= 1
