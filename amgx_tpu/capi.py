@@ -336,8 +336,28 @@ def AMGX_matrix_upload_all(mtx_h, n, nnz, block_dimx, block_dimy,
 @_api
 def AMGX_matrix_replace_coefficients(mtx_h, n, nnz, data, diag_data=None):
     """Keep structure, replace values (src/amgx_c.cu; pairs with
-    AMGX_solver_resetup)."""
+    AMGX_solver_resetup). On the pieces path (a matrix uploaded with
+    AMGX_matrix_upload_distributed), call once per rank with that
+    rank's new values — after the last piece the arranger re-runs
+    against the stored structure."""
     m = _get(mtx_h, _CMatrix)
+    if getattr(m, "part", None) is not None:
+        if diag_data is not None:
+            raise AMGXError(
+                "pieces path: external diagonals were folded at upload; "
+                "pass the folded values", RC.BAD_PARAMETERS)
+        if getattr(m, "new_vals", None) is None:
+            m.new_vals = []
+        m.new_vals.append(np.asarray(data, m.mode.mat_dtype))
+        R = len(m.piece_structure)
+        if len(m.new_vals) == R:
+            from .distributed.partition import partition_from_pieces
+            pieces = [(ro_, ci_, v_) for (ro_, ci_), v_ in
+                      zip(m.piece_structure, m.new_vals)]
+            m.part = partition_from_pieces(
+                pieces, m.piece_nglobal, dtype=m.mode.mat_dtype)
+            m.new_vals = None
+        return RC.OK
     if m.A is None:
         raise AMGXError("matrix not uploaded", RC.BAD_PARAMETERS)
     dt = m.mode.mat_dtype
@@ -928,6 +948,10 @@ def _accumulate_piece(m, n_global, n, row_ptrs, col_indices_global,
         m.part_offsets = np.asarray(offsets, np.int64)
         m.row_perm = perm
         m.A = None
+        # keep the piece structure: AMGX_matrix_replace_coefficients on
+        # the pieces path re-runs the arranger with new values
+        m.piece_structure = [(ro_, ci_) for (ro_, ci_, _v) in m.pieces]
+        m.piece_nglobal = int(n_global)
         m.pieces = None
     return RC.OK
 
