@@ -104,11 +104,17 @@ class _CMatrix:
         self.row_perm = None
 
     def set_matrix(self, A, part_offsets=None, row_perm=None):
-        """Replace the stored matrix; distributed renumbering metadata
-        belongs to a specific matrix, so it is reset together with it."""
+        """Replace the stored matrix; distributed renumbering and
+        pieces-path metadata belong to a specific matrix, so they are
+        reset together with it."""
         self.A = A
         self.part_offsets = part_offsets
         self.row_perm = row_perm
+        self.part = None
+        self.pieces = None
+        self.piece_prefold = None
+        self.piece_structure = None
+        self.new_vals = None
 
 
 class _CVector:
@@ -342,21 +348,38 @@ def AMGX_matrix_replace_coefficients(mtx_h, n, nnz, data, diag_data=None):
     against the stored structure."""
     m = _get(mtx_h, _CMatrix)
     if getattr(m, "part", None) is not None:
-        if diag_data is not None:
-            raise AMGXError(
-                "pieces path: external diagonals were folded at upload; "
-                "pass the folded values", RC.BAD_PARAMETERS)
         if getattr(m, "new_vals", None) is None:
             m.new_vals = []
-        m.new_vals.append(np.asarray(data, m.mode.mat_dtype))
+        r = len(m.new_vals)
+        ro_r, ci_r, had_diag = m.piece_structure[r]
+        vals = np.asarray(data, m.mode.mat_dtype)
+        if vals.shape[0] != ci_r.shape[0]:
+            raise AMGXError(
+                f"piece {r}: {vals.shape[0]} values, structure has "
+                f"{ci_r.shape[0]} entries", RC.BAD_PARAMETERS)
+        if had_diag != (diag_data is not None):
+            raise AMGXError(
+                f"piece {r}: diag_data presence must match the upload",
+                RC.BAD_PARAMETERS)
+        dg = None if diag_data is None else np.asarray(
+            diag_data, m.mode.mat_dtype)
+        m.new_vals.append((vals, dg))
         R = len(m.piece_structure)
         if len(m.new_vals) == R:
+            new_vals, m.new_vals = m.new_vals, None
             from .distributed.partition import partition_from_pieces
-            pieces = [(ro_, ci_, v_) for (ro_, ci_), v_ in
-                      zip(m.piece_structure, m.new_vals)]
+            pieces = []
+            for r2, ((ro_, ci_, hd), (v_, d_)) in enumerate(
+                    zip(m.piece_structure, new_vals)):
+                ro64 = ro_.astype(np.int64)
+                ci64 = ci_.astype(np.int64)
+                if hd:
+                    ro64, ci64, v_ = _fold_piece_diag(
+                        ro64, ci64, v_, d_, len(ro_) - 1,
+                        int(m.part_offsets[r2]))
+                pieces.append((ro64, ci64, v_))
             m.part = partition_from_pieces(
                 pieces, m.piece_nglobal, dtype=m.mode.mat_dtype)
-            m.new_vals = None
         return RC.OK
     if m.A is None:
         raise AMGXError("matrix not uploaded", RC.BAD_PARAMETERS)
@@ -923,23 +946,16 @@ def _accumulate_piece(m, n_global, n, row_ptrs, col_indices_global,
     vals = np.asarray(data, dtype)
     if iperm is not None:
         ci = iperm[ci]          # renumber cols to partition-contiguous
+    if getattr(m, "piece_prefold", None) is None or len(m.pieces) == 0:
+        m.piece_prefold = []
+    m.piece_prefold.append(
+        (ro.astype(np.int32), ci.astype(np.int32),
+         diag_data is not None))
+    pre_fold = m.piece_prefold
     if diag_data is not None:
-        # fold the external diagonal into the CSR piece (the distributed
-        # layer requires folded diagonals); in the renumbered space this
-        # rank's row i has global id offsets[r] + i
-        dg = np.asarray(diag_data, dtype)
-        lo = int(offsets[r])
-        rows_all = np.concatenate([np.repeat(np.arange(n), np.diff(ro)),
-                                   np.arange(n)])
-        cols_all = np.concatenate([ci,
-                                   np.arange(lo, lo + n, dtype=np.int64)])
-        vals_all = np.concatenate([vals, dg])
-        order = np.lexsort((cols_all, rows_all))
-        rows_s = rows_all[order]
-        ci = cols_all[order]
-        vals = vals_all[order]
-        ro = np.zeros(n + 1, np.int64)
-        np.cumsum(np.bincount(rows_s, minlength=n), out=ro[1:])
+        ro, ci, vals = _fold_piece_diag(
+            ro, ci, vals, np.asarray(diag_data, dtype), int(n),
+            int(offsets[r]))
     m.pieces.append((ro, ci, vals))
     if len(m.pieces) == len(offsets) - 1:
         from .distributed.partition import partition_from_pieces
@@ -948,12 +964,29 @@ def _accumulate_piece(m, n_global, n, row_ptrs, col_indices_global,
         m.part_offsets = np.asarray(offsets, np.int64)
         m.row_perm = perm
         m.A = None
-        # keep the piece structure: AMGX_matrix_replace_coefficients on
-        # the pieces path re-runs the arranger with new values
-        m.piece_structure = [(ro_, ci_) for (ro_, ci_, _v) in m.pieces]
+        # keep the PRE-FOLD piece structure (int32 — half the retained
+        # host memory): AMGX_matrix_replace_coefficients re-renumbers
+        # and re-folds new values against it
+        m.piece_structure = pre_fold
         m.piece_nglobal = int(n_global)
+        m.piece_iperm = iperm
         m.pieces = None
     return RC.OK
+
+
+def _fold_piece_diag(ro, ci, vals, dg, n: int, lo: int):
+    """Fold an external diagonal into one rank's CSR piece (the
+    distributed layer requires folded diagonals); in the renumbered
+    space this rank's row i has global id lo + i."""
+    rows_all = np.concatenate([np.repeat(np.arange(n), np.diff(ro)),
+                               np.arange(n)])
+    cols_all = np.concatenate([ci,
+                               np.arange(lo, lo + n, dtype=np.int64)])
+    vals_all = np.concatenate([vals, dg])
+    order = np.lexsort((cols_all, rows_all))
+    ro2 = np.zeros(n + 1, np.int64)
+    np.cumsum(np.bincount(rows_all[order], minlength=n), out=ro2[1:])
+    return ro2, cols_all[order], vals_all[order]
 
 
 @_api

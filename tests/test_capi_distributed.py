@@ -248,3 +248,80 @@ def test_replace_coefficients_pieces_path(system):
     # solution of (2A) x = b
     r2 = b - 2.0 * np.asarray(amgx.ops.spmv(A, jnp.asarray(x)))
     assert np.linalg.norm(r2) / np.linalg.norm(b) < 1e-7
+
+
+def test_replace_coefficients_pieces_with_diag(system):
+    """Pieces uploaded WITH external diag_data: replacement re-folds
+    per rank against the stored pre-fold structure."""
+    A, b = system
+    n = A.num_rows
+    n_local = -(-n // N_DEV)
+    offsets = np.minimum(np.arange(N_DEV + 1) * n_local, n)
+    capi.AMGX_initialize()
+    cfg_h = _safe(*capi.AMGX_config_create(CFG))
+    rs = _safe(*capi.AMGX_resources_create_simple(cfg_h))
+    mtx = _safe(*capi.AMGX_matrix_create(rs, "dDDI"))
+    dist = _safe(*capi.AMGX_distribution_create(cfg_h))
+    _safe(capi.AMGX_distribution_set_partition_data(
+        dist, capi.AMGX_DIST_PARTITION_OFFSETS, offsets))
+    # split each piece into off-diagonal CSR + external diagonal
+    diag_g = np.asarray(A.diagonal())
+    for r, (ro, ci, va) in enumerate(_pieces_of(A, offsets)):
+        lo = int(offsets[r])
+        nr = len(ro) - 1
+        rows_l = np.repeat(np.arange(nr), np.diff(ro))
+        offd = ci != (rows_l + lo)
+        counts = np.bincount(rows_l[offd], minlength=nr)
+        ro2 = np.concatenate([[0], np.cumsum(counts)])
+        _safe(capi.AMGX_matrix_upload_distributed(
+            mtx, n, nr, int(offd.sum()), 1, 1, ro2, ci[offd], va[offd],
+            diag_g[lo:lo + nr], dist))
+    slv = _safe(*capi.AMGX_solver_create(rs, "dDDI", cfg_h))
+    _safe(capi.AMGX_solver_setup(slv, mtx))
+    # replace: scale by 3 (values AND diag)
+    for r, (ro, ci, va) in enumerate(_pieces_of(A, offsets)):
+        lo = int(offsets[r])
+        nr = len(ro) - 1
+        rows_l = np.repeat(np.arange(nr), np.diff(ro))
+        offd = ci != (rows_l + lo)
+        _safe(capi.AMGX_matrix_replace_coefficients(
+            mtx, nr, int(offd.sum()), 3.0 * va[offd],
+            3.0 * diag_g[lo:lo + nr]))
+    _safe(capi.AMGX_solver_resetup(slv, mtx))
+    rhs = _safe(*capi.AMGX_vector_create(rs, "dDDI"))
+    sol = _safe(*capi.AMGX_vector_create(rs, "dDDI"))
+    _safe(capi.AMGX_vector_bind(rhs, mtx))
+    for r in range(N_DEV):
+        lo, hi = int(offsets[r]), int(offsets[r + 1])
+        _safe(capi.AMGX_vector_upload_distributed(
+            rhs, hi - lo, 1, b[lo:hi]))
+    _safe(capi.AMGX_solver_solve_with_0_initial_guess(slv, rhs, sol))
+    x = _safe(*capi.AMGX_vector_download(sol))
+    r3 = b - 3.0 * np.asarray(amgx.ops.spmv(A, jnp.asarray(x)))
+    assert np.linalg.norm(r3) / np.linalg.norm(b) < 1e-7
+
+
+def test_replace_coefficients_bad_length_recovers(system):
+    """A wrong-length replacement fails with BAD_PARAMETERS and does
+    NOT poison the accumulator: a subsequent correct round succeeds."""
+    A, b = system
+    n = A.num_rows
+    n_local = -(-n // N_DEV)
+    offsets = np.minimum(np.arange(N_DEV + 1) * n_local, n)
+    capi.AMGX_initialize()
+    cfg_h = _safe(*capi.AMGX_config_create(CFG))
+    rs = _safe(*capi.AMGX_resources_create_simple(cfg_h))
+    mtx = _safe(*capi.AMGX_matrix_create(rs, "dDDI"))
+    dist = _safe(*capi.AMGX_distribution_create(cfg_h))
+    _safe(capi.AMGX_distribution_set_partition_data(
+        dist, capi.AMGX_DIST_PARTITION_OFFSETS, offsets))
+    for ro, ci, va in _pieces_of(A, offsets):
+        _safe(capi.AMGX_matrix_upload_distributed(
+            mtx, n, len(ro) - 1, len(ci), 1, 1, ro, ci, va, None, dist))
+    rc = capi.AMGX_matrix_replace_coefficients(mtx, 5, 3,
+                                               np.ones(3))
+    assert rc == capi.RC.BAD_PARAMETERS
+    for ro, ci, va in _pieces_of(A, offsets):
+        _safe(capi.AMGX_matrix_replace_coefficients(
+            mtx, len(ro) - 1, len(ci), 2.0 * va))
+    assert capi._get(mtx).new_vals is None  # rebuild completed
