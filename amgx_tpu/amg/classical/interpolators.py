@@ -114,6 +114,22 @@ class Distance2Interpolator(Interpolator):
         whole interpolation in tens of milliseconds."""
         from ... import native
         n = A.num_rows
+        if not A.has_external_diag:
+            # native C++ row sweep (the distance2.cu host analog): same
+            # formula, stamp-array C-hat membership instead of sorted-key
+            # searches — this is the classical-setup hot path
+            out = native.d2_interp_native(
+                n, np.asarray(A.row_offsets), np.asarray(A.col_indices),
+                np.asarray(A.values), np.asarray(strong, np.uint8),
+                np.asarray(cf_map, np.int32))
+            if out is not None:
+                p_ptr, p_col, p_val = out
+                nc = int(np.sum(np.asarray(cf_map) == 1))
+                P = CsrMatrix.from_scipy_like(
+                    p_ptr.astype(np.int32), p_col,
+                    jnp.asarray(p_val.astype(
+                        np.asarray(A.values).dtype)), n, nc)
+                return _truncate(P, self.trunc_factor, self.max_elements)
         ro = np.asarray(A.row_offsets)
         cols = np.asarray(A.col_indices)
         vals = np.asarray(A.values)

@@ -62,6 +62,18 @@ def pmis_split(A: CsrMatrix, strong, max_iters: int = 30, init=None):
     pmis.cu:508): entries in {FINE, COARSE} are kept, UNDECIDED entries
     are resolved by the PMIS sweeps."""
     n = A.num_rows
+    from ...ops.spgemm import _on_host
+    if _on_host(A):
+        # host-setup path: the synchronous fixed point as a native C++
+        # sweep (bit-exact: same weights, same round structure)
+        from ...native import pmis_native
+        cf = pmis_native(
+            n, np.asarray(A.row_offsets), np.asarray(A.col_indices),
+            np.asarray(strong, np.uint8),
+            None if init is None else np.asarray(init, np.int32),
+            max_iters)
+        if cf is not None:
+            return jnp.asarray(cf, jnp.int32)
     rows, cols, _ = A.coo()
     sr, sc = _symmetrize(rows, cols, strong, n)
     deg = jnp.zeros((n,), jnp.float64).at[sr].add(1.0) * 0.5

@@ -32,6 +32,10 @@ class Strength:
 @registry.strength.register("AHAT")
 class AhatStrength(Strength):
     def strong_mask(self, A: CsrMatrix):
+        from ...matrix import host_resident
+        if not A.is_block and host_resident(
+                A.row_offsets, A.col_indices, A.values, A.diag):
+            return self._strong_mask_host(A)
         rows, cols, vals = A.coo()
         n = A.num_rows
         offdiag = rows != cols
@@ -52,6 +56,39 @@ class AhatStrength(Strength):
             if A.has_external_diag:
                 rowsum = rowsum + A.diag
             weak_row = jnp.abs(rowsum) > self.max_row_sum * jnp.abs(diag)
+            strong = strong & ~weak_row[rows]
+        return strong
+
+    def _strong_mask_host(self, A: CsrMatrix):
+        """Numpy form of the same mask for host-resident matrices (the
+        host-setup path; avoids ~20 eager XLA:CPU dispatches/level)."""
+        import numpy as np
+        from ...matrix import _np_row_reduce
+        n = A.num_rows
+        ro = np.asarray(A.row_offsets)
+        cols = np.asarray(A.col_indices)
+        vals = np.asarray(A.values)
+        rows = np.repeat(np.arange(n, dtype=np.int32), np.diff(ro))
+        if A.has_external_diag:
+            diag = np.asarray(A.diag)
+        else:
+            diag = np.zeros(n, vals.dtype)
+            dmask = rows == cols
+            # reverse order so the FIRST diagonal occurrence wins
+            # (padded-duplicate CSR stores the coalesced sum first)
+            diag[rows[dmask][::-1]] = vals[dmask][::-1]
+        sgn = np.where(diag < 0, -1.0, 1.0)
+        offdiag = rows != cols
+        coupling = np.where(offdiag, -vals * sgn[rows], 0.0)
+        row_max = np.maximum(
+            _np_row_reduce(np.maximum, coupling, ro, n, 0.0), 0.0)
+        strong = offdiag & (coupling >= self.theta * row_max[rows]) \
+            & (coupling > 0)
+        if self.max_row_sum < 1.0:
+            rowsum = np.bincount(rows, weights=vals, minlength=n)
+            if A.has_external_diag:
+                rowsum = rowsum + diag
+            weak_row = np.abs(rowsum) > self.max_row_sum * np.abs(diag)
             strong = strong & ~weak_row[rows]
         return strong
 

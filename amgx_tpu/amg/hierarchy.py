@@ -320,21 +320,39 @@ class AMG:
             # include/amgx_config.h:102-131): the whole stored hierarchy
             # and cycle run in reduced precision inside an f64 flexible
             # Krylov outer loop — on TPU this halves (or quarters) HBM
-            # traffic and turns on the f32 Pallas SpMV kernels
+            # traffic and turns on the f32 Pallas SpMV kernels.
+            # Duplicated leaves (each level's A appears in both the level
+            # data and its smoother's data as the same array object) cast
+            # once, preserving identity for the dedup below.
             import jax.numpy as jnp
+            memo = {}
 
             def cast(leaf):
                 if hasattr(leaf, "dtype") and \
                         jnp.issubdtype(leaf.dtype, jnp.inexact):
-                    return leaf.astype(dt)
+                    key = id(leaf)
+                    if key not in memo:
+                        memo[key] = (leaf, leaf.astype(dt))
+                    return memo[key][1]
                 return leaf
             data = jax.tree.map(cast, data)
         if self._ship_device is not None:
-            # host-built hierarchy: one transfer to the accelerator,
-            # cached for the life of this setup
+            # host-built hierarchy: one batched transfer of the UNIQUE
+            # arrays to the accelerator (each level's matrix arrays appear
+            # twice in the tree by object identity; transferring per-leaf
+            # would double both tunnel traffic and HBM), cached for the
+            # life of this setup
             if self._data_cache is None:
-                self._data_cache = jax.device_put(data,
-                                                  self._ship_device)
+                uniq = {}
+                for leaf in jax.tree.leaves(data):
+                    if hasattr(leaf, "dtype"):
+                        uniq.setdefault(id(leaf), leaf)
+                placed = jax.device_put(list(uniq.values()),
+                                        self._ship_device)
+                lookup = dict(zip(uniq.keys(), placed))
+                self._data_cache = jax.tree.map(
+                    lambda leaf: lookup[id(leaf)]
+                    if hasattr(leaf, "dtype") else leaf, data)
             return self._data_cache
         return data
 

@@ -47,6 +47,36 @@ def _seg_sum(data, seg_ids, num_segments):
                                indices_are_sorted=True)
 
 
+def host_resident(*arrays) -> bool:
+    """True when every given array is concrete host-CPU data (numpy or a
+    CPU-backend jax array). Tracers and accelerator arrays return False.
+    Gates the numpy fast paths of the setup-phase index math: on the
+    host-CPU setup path (amg_host_setup) the same math as the jnp form,
+    run synchronously in numpy, avoids hundreds of eager XLA:CPU
+    dispatches per hierarchy build."""
+    for a in arrays:
+        if a is None or isinstance(a, np.ndarray):
+            continue
+        try:
+            if next(iter(a.devices())).platform != "cpu":
+                return False
+        except Exception:
+            return False
+    return True
+
+
+def _np_row_reduce(op, data, ro, n, empty_val):
+    """Per-row reduce over CSR-ordered data via ufunc.reduceat, with
+    empty rows patched to `empty_val` (reduceat's equal-index semantics
+    would otherwise leak the next row's first element)."""
+    if data.shape[0] == 0:
+        return np.full(n, empty_val, data.dtype)
+    starts = ro[:-1].astype(np.int64)
+    nonempty = ro[1:] > ro[:-1]
+    out = op.reduceat(data, np.clip(starts, 0, data.shape[0] - 1))
+    return np.where(nonempty, out, empty_val)
+
+
 @functools.partial(
     jax.tree_util.register_dataclass,
     data_fields=["row_offsets", "col_indices", "values", "diag",
@@ -128,6 +158,9 @@ class CsrMatrix:
           keeps plain CSR+segsum.
         """
         n = self.num_rows
+        if not self.is_block and host_resident(
+                self.row_offsets, self.col_indices, self.values):
+            return self._init_host(ell, ell_max_ratio)
         row_nnz = jnp.diff(self.row_offsets)
         row_ids = jnp.repeat(
             jnp.arange(n, dtype=jnp.int32), row_nnz,
@@ -151,6 +184,68 @@ class CsrMatrix:
             self, row_ids=row_ids, diag_idx=diag_idx,
             ell_cols=ell_cols, ell_vals=ell_vals,
             dia_offsets=dia_offsets, dia_vals=dia_vals, initialized=True)
+
+    def _init_host(self, ell: str, ell_max_ratio: float) -> "CsrMatrix":
+        """Numpy form of init() for host-resident scalar matrices — same
+        auxiliaries, synchronous vectorized C instead of eager XLA:CPU
+        dispatches (the host-setup path builds every hierarchy level
+        through here)."""
+        n = self.num_rows
+        ro = np.asarray(self.row_offsets)
+        ci = np.asarray(self.col_indices)
+        vals = np.asarray(self.values)
+        row_nnz = np.diff(ro)
+        row_ids = np.repeat(np.arange(n, dtype=np.int32), row_nnz)
+        if self.has_external_diag:
+            diag_idx = None
+        else:
+            cand = np.where(ci == row_ids,
+                            np.arange(self.nnz, dtype=np.int64), self.nnz)
+            dmin = _np_row_reduce(np.minimum, cand, ro, n, self.nnz)
+            diag_idx = np.where(dmin >= self.nnz, -1, dmin).astype(np.int32)
+        ell_cols, ell_vals, dia_offsets, dia_vals = self._choose_layout_host(
+            ro, ci, vals, row_ids, row_nnz, ell, ell_max_ratio)
+        return dataclasses.replace(
+            self, row_ids=row_ids, diag_idx=diag_idx,
+            ell_cols=ell_cols, ell_vals=ell_vals,
+            dia_offsets=dia_offsets, dia_vals=dia_vals, initialized=True)
+
+    def _choose_layout_host(self, ro, ci, vals, row_ids, row_nnz, ell: str,
+                            ell_max_ratio: float):
+        n = self.num_rows
+        ell_cols = ell_vals = None
+        dia_offsets = dia_vals = None
+        if n > 0 and self.nnz > 0 and not self.has_external_diag \
+                and ell == "auto":
+            diffs = ci.astype(np.int64) - row_ids
+            offs = np.unique(diffs)
+            k = int(offs.shape[0])
+            if k <= self.DIA_MAX_OFFSETS and \
+                    k * n <= self.DIA_FILL_RATIO * max(self.nnz, 1):
+                from .ops.pallas_spmv import LANES, dia_padded_rows
+                dia_offsets = tuple(int(o) for o in offs)
+                d_idx = np.searchsorted(offs, diffs)
+                rows_pad = dia_padded_rows(k, n)
+                flat = np.bincount(
+                    d_idx * (rows_pad * LANES) + row_ids, weights=vals,
+                    minlength=k * rows_pad * LANES).astype(vals.dtype)
+                dia_vals = flat.reshape(k, rows_pad, LANES)
+        if dia_offsets is None and n > 0 and ell != "never" and self.nnz > 0:
+            max_k = int(row_nnz.max()) if row_nnz.size else 0
+            mean = max(float(self.nnz) / max(n, 1), 1e-30)
+            want_ell = (ell == "always") or (
+                ell == "auto" and max_k > 0 and max_k / mean <= ell_max_ratio)
+            if want_ell and max_k > 0:
+                flat = row_ids.astype(np.int64) * max_k + (
+                    np.arange(self.nnz, dtype=np.int64) -
+                    ro[row_ids].astype(np.int64))
+                ec = np.zeros(n * max_k, np.int32)
+                ec[flat] = ci
+                ev = np.zeros(n * max_k, vals.dtype)
+                ev[flat] = vals
+                ell_cols, ell_vals = ec.reshape(n, max_k), \
+                    ev.reshape(n, max_k)
+        return ell_cols, ell_vals, dia_offsets, dia_vals
 
     def _choose_layout(self, row_ids, row_nnz, ell: str,
                        ell_max_ratio: float):
@@ -181,6 +276,19 @@ class CsrMatrix:
             return self.init(ell=ell, ell_max_ratio=ell_max_ratio)
         if self.dia_vals is not None or self.ell_cols is not None:
             return self
+        if not self.is_block and host_resident(
+                self.row_offsets, self.col_indices, self.values,
+                self.row_ids):
+            ro = np.asarray(self.row_offsets)
+            vals = np.asarray(self.values)
+            ell_cols, ell_vals, dia_offsets, dia_vals = \
+                self._choose_layout_host(
+                    ro, np.asarray(self.col_indices), vals,
+                    np.asarray(self.row_ids), np.diff(ro), ell,
+                    ell_max_ratio)
+            return dataclasses.replace(
+                self, ell_cols=ell_cols, ell_vals=ell_vals,
+                dia_offsets=dia_offsets, dia_vals=dia_vals)
         row_nnz = jnp.diff(self.row_offsets)
         ell_cols, ell_vals, dia_offsets, dia_vals = self._choose_layout(
             self.row_ids, row_nnz, ell, ell_max_ratio)

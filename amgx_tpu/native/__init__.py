@@ -144,6 +144,73 @@ def rs_coarsen_native(n, row_offsets, col_indices, strong):
     return cf
 
 
+def pmis_native(n, row_offsets, col_indices, strong, init=None,
+                max_iters=30):
+    """Native PMIS CF-splitting (bit-exact replica of the jnp fixed
+    point in amg/classical/selectors.py::pmis_split); returns cf (n,)
+    int32 or None when the native library is unavailable."""
+    import numpy as np
+    L = lib()
+    if L is None:
+        return None
+    fn = L.amgx_pmis
+    fn.restype = ctypes.c_int
+    ro = np.ascontiguousarray(row_offsets, np.int32)
+    ci = np.ascontiguousarray(col_indices, np.int32)
+    st = np.ascontiguousarray(strong, np.uint8)
+    cf = np.empty(n, np.int32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    if init is not None:
+        init = np.ascontiguousarray(init, np.int32)
+        init_p = init.ctypes.data_as(i32p)
+    else:
+        init_p = None
+    rc = fn(ctypes.c_int32(int(n)),
+            ro.ctypes.data_as(i32p), ci.ctypes.data_as(i32p),
+            st.ctypes.data_as(u8p), init_p,
+            ctypes.c_int32(int(max_iters)), cf.ctypes.data_as(i32p))
+    if rc != 0:
+        return None
+    return cf
+
+
+def d2_interp_native(n, row_offsets, col_indices, values, strong, cf):
+    """Native distance-two ext+i interpolation (the host analog of
+    src/classical/interpolators/distance2.cu). Returns
+    (p_ptr int64 (n+1,), p_col int32, p_val float64) or None."""
+    import numpy as np
+    L = lib()
+    if L is None:
+        return None
+    build = L.amgx_d2_build
+    build.restype = ctypes.c_longlong
+    fetch = L.amgx_d2_fetch
+    fetch.restype = None
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    ro = np.ascontiguousarray(row_offsets, np.int32)
+    ci = np.ascontiguousarray(col_indices, np.int32)
+    va = np.ascontiguousarray(values, np.float64)
+    st = np.ascontiguousarray(strong, np.uint8)
+    cfm = np.ascontiguousarray(cf, np.int32)
+    handle = ctypes.c_void_p()
+    nnz = build(ctypes.c_int32(int(n)),
+                ro.ctypes.data_as(i32p), ci.ctypes.data_as(i32p),
+                va.ctypes.data_as(f64p), st.ctypes.data_as(u8p),
+                cfm.ctypes.data_as(i32p), ctypes.byref(handle))
+    if nnz < 0 or not handle:
+        return None
+    p_ptr = np.empty(int(n) + 1, np.int64)
+    p_col = np.empty(int(nnz), np.int32)
+    p_val = np.empty(int(nnz), np.float64)
+    fetch(handle, p_ptr.ctypes.data_as(i64p),
+          p_col.ctypes.data_as(i32p), p_val.ctypes.data_as(f64p))
+    return p_ptr, p_col, p_val
+
+
 def spgemm_native(n_a, n_b, a_ptr, a_col, a_val, b_ptr, b_col, b_val):
     """Native Gustavson CSR SpGEMM (csr_multiply.h analog). Returns
     (c_ptr int64 (n_a+1,), c_col int32, c_val float64) with sorted

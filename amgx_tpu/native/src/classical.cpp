@@ -1,0 +1,265 @@
+// Host classical-AMG setup kernels: PMIS CF-splitting and distance-two
+// (extended+i) interpolation.
+//
+// The reference runs these on the GPU with hash-table kernels
+// (src/classical/selectors/pmis.cu, src/classical/interpolators/
+// distance2.cu); on a remote TPU the setup-phase index math is
+// latency-bound, so the host-setup path (amg_host_setup) runs them here
+// as serial sweeps with stamp arrays — the same row-local structure the
+// reference's per-CTA hash tables express, without the hardware hash.
+//
+// amgx_pmis is a bit-exact replica of the synchronous fixed point in
+// amg/classical/selectors.py::pmis_split (same weights — exact halves
+// plus the same integer hash — and the same two-phase round structure),
+// so the CF-splitting is identical with or without the native library.
+//
+// amgx_d2_* implements the formula of amg/classical/interpolators.py::
+// Distance2Interpolator (De Sterck et al. distance-two ext+i) with a
+// handle-based build/fetch pair: the output size is data-dependent, so
+// build computes and stashes the CSR, fetch copies it out and frees.
+#include <algorithm>
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+const int32_t FINE = 0, COARSE = 1, UNDECIDED = -1;
+
+double hash01(uint32_t i) {
+    uint32_t h = i * 2654435761u;
+    h = (h ^ (h >> 16)) * 0x45D9F3Bu;
+    h = h ^ (h >> 16);
+    return static_cast<double>(h & 0xFFFFFu) / 1048576.0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// PMIS fixed point over the symmetrized strength graph. `init` may be
+// null (all points start UNDECIDED) or hold {-1,0,1} seeds (HMIS).
+// Writes cf[n] in {0,1}. Returns 0 on success.
+int amgx_pmis(
+    int32_t n, const int32_t* ro, const int32_t* ci,
+    const uint8_t* strong, const int32_t* init, int32_t max_iters,
+    int32_t* cf) {
+    // symmetrized adjacency S | S^T with duplicates kept (duplicates are
+    // harmless for max/any reductions and keep deg identical to the
+    // segment-sum formulation: deg = 0.5 * (outdeg + indeg))
+    // strong edges only, cols within [0, n) — strength masks can mark
+    // edges to halo/rectangular columns (same guard as rs.cpp)
+    std::vector<int64_t> off(static_cast<size_t>(n) + 2, 0);
+    for (int32_t i = 0; i < n; ++i)
+        for (int32_t e = ro[i]; e < ro[i + 1]; ++e) {
+            const int32_t j = ci[e];
+            if (strong[e] && j >= 0 && j < n) {
+                ++off[static_cast<size_t>(i) + 2];
+                ++off[static_cast<size_t>(j) + 2];
+            }
+        }
+    for (size_t i = 2; i < off.size(); ++i) off[i] += off[i - 1];
+    std::vector<int32_t> adj(static_cast<size_t>(off[off.size() - 1]));
+    for (int32_t i = 0; i < n; ++i)
+        for (int32_t e = ro[i]; e < ro[i + 1]; ++e) {
+            const int32_t j = ci[e];
+            if (strong[e] && j >= 0 && j < n) {
+                adj[static_cast<size_t>(off[static_cast<size_t>(i) + 1]++)] = j;
+                adj[static_cast<size_t>(off[static_cast<size_t>(j) + 1]++)] = i;
+            }
+        }
+
+    std::vector<double> w(static_cast<size_t>(n));
+    std::vector<int32_t> state(static_cast<size_t>(n));
+    for (int32_t i = 0; i < n; ++i) {
+        const int64_t d = off[static_cast<size_t>(i) + 1] -
+                          off[static_cast<size_t>(i)];
+        w[static_cast<size_t>(i)] =
+            0.5 * static_cast<double>(d) + hash01(static_cast<uint32_t>(i));
+        int32_t s = init ? init[i] : UNDECIDED;
+        if (s == UNDECIDED && d == 0) s = COARSE;  // isolated point
+        state[static_cast<size_t>(i)] = s;
+    }
+
+    std::vector<uint8_t> new_c(static_cast<size_t>(n));
+    for (int32_t it = 0; it < max_iters; ++it) {
+        bool any_und = false;
+        // phase 1: undecided local maxima over undecided strong
+        // neighbours become COARSE (synchronous: decided against the
+        // round-entry state)
+        for (int32_t i = 0; i < n; ++i) {
+            new_c[static_cast<size_t>(i)] = 0;
+            if (state[static_cast<size_t>(i)] != UNDECIDED) continue;
+            any_und = true;
+            double nbr_max = -1.0;  // weights are >= 0; -1 == -inf here
+            for (int64_t t = off[static_cast<size_t>(i)];
+                 t < off[static_cast<size_t>(i) + 1]; ++t) {
+                const int32_t j = adj[static_cast<size_t>(t)];
+                if (state[static_cast<size_t>(j)] == UNDECIDED &&
+                    w[static_cast<size_t>(j)] > nbr_max)
+                    nbr_max = w[static_cast<size_t>(j)];
+            }
+            if (w[static_cast<size_t>(i)] > nbr_max)
+                new_c[static_cast<size_t>(i)] = 1;
+        }
+        if (!any_und) break;
+        for (int32_t i = 0; i < n; ++i)
+            if (new_c[static_cast<size_t>(i)])
+                state[static_cast<size_t>(i)] = COARSE;
+        // phase 2: undecided neighbours of (any, including new) COARSE
+        // points become FINE
+        for (int32_t i = 0; i < n; ++i) {
+            if (state[static_cast<size_t>(i)] != UNDECIDED) continue;
+            for (int64_t t = off[static_cast<size_t>(i)];
+                 t < off[static_cast<size_t>(i) + 1]; ++t)
+                if (state[static_cast<size_t>(adj[static_cast<size_t>(t)])] ==
+                    COARSE) {
+                    state[static_cast<size_t>(i)] = FINE;
+                    break;
+                }
+        }
+    }
+    for (int32_t i = 0; i < n; ++i)
+        cf[i] = state[static_cast<size_t>(i)] == COARSE ? COARSE : FINE;
+    return 0;
+}
+
+struct D2Result {
+    std::vector<int64_t> ptr;
+    std::vector<int32_t> col;
+    std::vector<double> val;
+};
+
+// Distance-two ext+i interpolation. Inputs: scalar CSR (diagonal stored
+// in-line), per-entry strength mask, cf map in {0,1}. Returns P's nnz
+// and a handle for amgx_d2_fetch; returns -1 on failure.
+long long amgx_d2_build(
+    int32_t n, const int32_t* ro, const int32_t* ci, const double* vals,
+    const uint8_t* strong, const int32_t* cf, void** out_handle) {
+    *out_handle = nullptr;
+    std::vector<double> diag(static_cast<size_t>(n), 0.0);
+    std::vector<double> sgn(static_cast<size_t>(n), 1.0);
+    std::vector<int32_t> cidx(static_cast<size_t>(n));
+    int32_t nc = 0;
+    for (int32_t i = 0; i < n; ++i) {
+        for (int32_t e = ro[i]; e < ro[i + 1]; ++e)
+            if (ci[e] == i) {  // FIRST occurrence wins (padded-duplicate
+                diag[static_cast<size_t>(i)] = vals[e];  // CSR stores the
+                break;  // coalesced sum first, trailing duplicates zero)
+            }
+        sgn[static_cast<size_t>(i)] =
+            diag[static_cast<size_t>(i)] < 0.0 ? -1.0 : 1.0;
+        cidx[static_cast<size_t>(i)] = nc;
+        if (cf[i] == COARSE) ++nc;
+    }
+
+    auto* res = new D2Result();
+    res->ptr.assign(static_cast<size_t>(n) + 1, 0);
+    // stamp[l] == current row marks l in C-hat_i; acc holds the row's
+    // coalesced interpolatory weights (pre -1/D scaling)
+    std::vector<int32_t> stamp(static_cast<size_t>(n), -1);
+    std::vector<int32_t> tstamp(static_cast<size_t>(n), -1);
+    std::vector<double> acc(static_cast<size_t>(n), 0.0);
+    std::vector<int32_t> touched;
+    touched.reserve(64);
+
+    for (int32_t i = 0; i < n; ++i) {
+        res->ptr[static_cast<size_t>(i)] =
+            static_cast<int64_t>(res->col.size());
+        if (cf[i] == COARSE) {  // injection row
+            res->col.push_back(cidx[static_cast<size_t>(i)]);
+            res->val.push_back(1.0);
+            continue;
+        }
+        // C-hat_i: strong C neighbours + strong-C neighbours of strong-F
+        // neighbours (all members are C points)
+        for (int32_t e = ro[i]; e < ro[i + 1]; ++e) {
+            const int32_t j = ci[e];
+            if (j < 0 || j >= n) continue;  // halo/rectangular column
+            if (strong[e] && cf[j] == COARSE) stamp[static_cast<size_t>(j)] = i;
+        }
+        for (int32_t e = ro[i]; e < ro[i + 1]; ++e) {
+            const int32_t k = ci[e];
+            if (k < 0 || k >= n) continue;
+            if (!(strong[e] && cf[k] == FINE && k != i)) continue;
+            for (int32_t f = ro[k]; f < ro[k + 1]; ++f) {
+                const int32_t l = ci[f];
+                if (l < 0 || l >= n) continue;
+                if (strong[f] && cf[l] == COARSE)
+                    stamp[static_cast<size_t>(l)] = i;
+            }
+        }
+        touched.clear();
+        double D = diag[static_cast<size_t>(i)];
+        auto acc_add = [&](int32_t j, double v) {
+            if (tstamp[static_cast<size_t>(j)] != i) {
+                tstamp[static_cast<size_t>(j)] = i;
+                acc[static_cast<size_t>(j)] = 0.0;
+                touched.push_back(j);
+            }
+            acc[static_cast<size_t>(j)] += v;
+        };
+        // direct entries + weak lumping
+        for (int32_t e = ro[i]; e < ro[i + 1]; ++e) {
+            const int32_t j = ci[e];
+            if (j == i) continue;
+            if (j < 0 || j >= n) {  // out-of-graph column: weak-lump
+                D += vals[e];
+                continue;
+            }
+            const bool in_chat = stamp[static_cast<size_t>(j)] == i;
+            const bool strong_f = strong[e] && cf[j] == FINE;
+            if (in_chat && cf[j] == COARSE) acc_add(j, vals[e]);
+            if (!in_chat && !strong_f) D += vals[e];
+        }
+        // two-hop terms through strong F neighbours
+        for (int32_t e = ro[i]; e < ro[i + 1]; ++e) {
+            const int32_t k = ci[e];
+            if (k < 0 || k >= n) continue;
+            if (!(strong[e] && cf[k] == FINE && k != i)) continue;
+            const double aik = vals[e];
+            const double sk = sgn[static_cast<size_t>(k)];
+            double d = 0.0;
+            for (int32_t f = ro[k]; f < ro[k + 1]; ++f) {
+                const int32_t l = ci[f];
+                if (l < 0 || l >= n) continue;
+                if (l == k || !(vals[f] * sk < 0.0)) continue;
+                if (stamp[static_cast<size_t>(l)] == i || l == i)
+                    d += vals[f];
+            }
+            if (d == 0.0) {  // k distributes nowhere: lump a_ik
+                D += aik;
+                continue;
+            }
+            for (int32_t f = ro[k]; f < ro[k + 1]; ++f) {
+                const int32_t l = ci[f];
+                if (l < 0 || l >= n) continue;
+                if (l == k || !(vals[f] * sk < 0.0)) continue;
+                if (l == i)
+                    D += aik * vals[f] / d;  // "+i" feedback
+                else if (stamp[static_cast<size_t>(l)] == i)
+                    acc_add(l, aik * vals[f] / d);
+            }
+        }
+        std::sort(touched.begin(), touched.end());
+        const double dsafe = D == 0.0 ? 1.0 : D;
+        for (const int32_t j : touched) {
+            res->col.push_back(cidx[static_cast<size_t>(j)]);
+            res->val.push_back(-acc[static_cast<size_t>(j)] / dsafe);
+        }
+    }
+    res->ptr[static_cast<size_t>(n)] = static_cast<int64_t>(res->col.size());
+    *out_handle = res;
+    return static_cast<long long>(res->col.size());
+}
+
+void amgx_d2_fetch(void* handle, int64_t* ptr, int32_t* col, double* val) {
+    auto* res = static_cast<D2Result*>(handle);
+    std::copy(res->ptr.begin(), res->ptr.end(), ptr);
+    std::copy(res->col.begin(), res->col.end(), col);
+    std::copy(res->val.begin(), res->val.end(), val);
+    delete res;
+}
+
+void amgx_d2_free(void* handle) { delete static_cast<D2Result*>(handle); }
+
+}  // extern "C"

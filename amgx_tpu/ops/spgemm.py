@@ -57,6 +57,9 @@ def _expand(A: CsrMatrix, B: CsrMatrix):
 
 
 def _on_host(A: CsrMatrix) -> bool:
+    import numpy as np
+    if isinstance(A.values, np.ndarray):
+        return True
     try:
         return next(iter(A.values.devices())).platform == "cpu"
     except Exception:

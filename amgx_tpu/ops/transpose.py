@@ -7,11 +7,32 @@ works both eagerly at setup time and inside jit.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
-from ..matrix import CsrMatrix
+from ..matrix import CsrMatrix, host_resident
+
+
+def _transpose_host(A: CsrMatrix) -> CsrMatrix:
+    """Numpy form for host-resident scalar matrices (the host-setup
+    path transposes every P; eager XLA:CPU sorts cost more than the
+    whole operation in numpy)."""
+    ro = np.asarray(A.row_offsets)
+    cols = np.asarray(A.col_indices)
+    vals = np.asarray(A.values)
+    row_ids = np.repeat(np.arange(A.num_rows, dtype=np.int32), np.diff(ro))
+    order = np.argsort(cols, kind="stable")
+    counts = np.bincount(cols, minlength=A.num_cols)
+    row_offsets = np.zeros(A.num_cols + 1, np.int32)
+    np.cumsum(counts, out=row_offsets[1:])
+    return CsrMatrix(row_offsets=row_offsets, col_indices=row_ids[order],
+                     values=vals[order], num_rows=A.num_cols,
+                     num_cols=A.num_rows)
 
 
 def transpose(A: CsrMatrix) -> CsrMatrix:
+    if not A.is_block and not A.has_external_diag and host_resident(
+            A.row_offsets, A.col_indices, A.values):
+        return _transpose_host(A)
     row_ids, cols, vals = A.coo()
     order = jnp.argsort(cols, stable=True)
     new_rows = cols[order]

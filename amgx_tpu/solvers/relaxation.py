@@ -42,6 +42,20 @@ def l1_strengthened_diag(A):
     """Scalar diagonal strengthened by the off-diagonal row L1 norm in
     the diagonal's sign (jacobi_l1_solver.cu); zero diagonals stay zero
     (sign 0) so safe_recip keeps them inert."""
+    from ..matrix import host_resident
+    if not A.is_block and host_resident(A.row_offsets, A.col_indices,
+                                        A.values, A.diag):
+        import numpy as np
+        n = A.num_rows
+        ro = np.asarray(A.row_offsets)
+        cols = np.asarray(A.col_indices)
+        vals = np.asarray(A.values)
+        rows = np.repeat(np.arange(n, dtype=np.int32), np.diff(ro))
+        l1 = np.bincount(rows, weights=np.where(rows != cols,
+                                                np.abs(vals), 0.0),
+                         minlength=n).astype(vals.dtype)
+        d = np.asarray(A.diagonal())
+        return jnp.asarray(d + np.sign(d) * l1)
     rows, cols, vals = A.coo()
     offdiag = jnp.where(rows != cols, jnp.abs(vals), 0.0)
     l1 = jax.ops.segment_sum(offdiag, rows, num_segments=A.num_rows,
