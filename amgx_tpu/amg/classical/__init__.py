@@ -78,9 +78,17 @@ class ClassicalAMGLevel(AMGLevel):
         if not self.interpolator_registry.has(interp_name):
             interp_name = self.interpolator_fallback
         interp = self.interpolator_registry.create(interp_name, cfg, scope)
-        self.P = interp.generate(self.A, self.cf_map, self.strong).init(
-            ell="never")
-        self.R = transpose(self.P).init(ell="never")
+        # host path: ell='auto' gives P and R the windowed-ELL (SWELL)
+        # layout, the Pallas gather kernel's storage — transfer operators
+        # are the other half of the unstructured cycle's SpMV traffic.
+        # Device-resident setup keeps ell='never': the auto layout probe
+        # costs blocking device fetches per level and SWELL is host-built.
+        from ...matrix import host_resident
+        P = interp.generate(self.A, self.cf_map, self.strong)
+        ell = "auto" if host_resident(P.row_offsets, P.col_indices,
+                                      P.values) else "never"
+        self.P = P.init(ell=ell)
+        self.R = transpose(self.P).init(ell=ell)
         return galerkin_rap(self.R, self.A, self.P)
 
     def reuse_structure(self, old):
@@ -96,8 +104,10 @@ class ClassicalAMGLevel(AMGLevel):
 
     def level_data(self):
         d = super().level_data()
-        d["P"] = self.P
-        d["R"] = self.R
+        # the cycle only SpMVs against the transfer operators — layout
+        # views keep their CSR payloads out of the solve program's HBM
+        d["P"] = self.P.slim_for_spmv()
+        d["R"] = self.R.slim_for_spmv()
         return d
 
     def restrict(self, data, r):

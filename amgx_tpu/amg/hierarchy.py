@@ -106,6 +106,11 @@ class AMG:
         self.setup_time = 0.0
         self._data_cache = None
         self._ship_device = None
+        # host-setup transfer overlap: id(host leaf) -> (host leaf,
+        # device leaf); filled by _prefetch_level as levels finish
+        # building so the tunnel transfer hides behind the remaining
+        # host compute
+        self._put_cache: Dict[int, tuple] = {}
 
     # -- setup -----------------------------------------------------------
     def _host_setup_device(self, A: CsrMatrix):
@@ -139,6 +144,7 @@ class AMG:
         t0 = time.perf_counter()
         self.levels = []
         self._data_cache = None
+        self._put_cache = {}
         host = self._host_setup_device(A)
         if host is not None:
             # decide BEFORE init: the SpMV-layout build is itself eager
@@ -147,9 +153,8 @@ class AMG:
             self._ship_device = (jax.config.jax_default_device
                                  or jax.devices()[0])
             with jax.default_device(host):
-                Af = jax.device_put(A, host)
-                if not Af.initialized:
-                    Af = Af.init()
+                Af = jax.device_put(self._strip_layouts(A), host)
+                Af = Af.init()
                 self._build_levels_checked(Af, 0)
                 self._finalize_setup(t0)
             return self
@@ -158,6 +163,19 @@ class AMG:
         self._build_levels_checked(Af, 0)
         self._finalize_setup(t0)
         return self
+
+    @staticmethod
+    def _strip_layouts(A: CsrMatrix) -> CsrMatrix:
+        """Drop SpMV auxiliaries before pulling a device matrix to the
+        host: the host setup rebuilds them in numpy anyway, and the
+        accelerator->host transfer of row_ids/ELL/DIA payloads costs
+        multiple seconds through a tunnel."""
+        import dataclasses
+        return dataclasses.replace(
+            A, row_ids=None, diag_idx=None, ell_cols=None, ell_vals=None,
+            dia_offsets=None, dia_vals=None, swell_cols=None,
+            swell_vals=None, swell_c0row=None, swell_nchunk=None,
+            swell_w128=0, initialized=False)
 
     def _build_levels_checked(self, Af: CsrMatrix, lvl: int):
         """_build_levels with the GEO fast path's wrap checks deferred
@@ -171,6 +189,9 @@ class AMG:
             self._build_levels(Af, lvl)
             if flush():
                 self.levels = base
+                # drop transfers prefetched for the abandoned build (they
+                # pin both host and HBM copies of every shipped level)
+                self._put_cache = {}
                 with geo_dia_disabled():
                     self._build_levels(Af, lvl)
 
@@ -189,9 +210,8 @@ class AMG:
             import jax
             host = jax.devices("cpu")[0]
             with jax.default_device(host):
-                Af = jax.device_put(A, host)
-                if not Af.initialized:
-                    Af = Af.init()
+                Af = jax.device_put(self._strip_layouts(A), host)
+                Af = Af.init()
                 return self._resetup_impl(Af, reuse)
         Af = A if A.initialized else A.init()
         return self._resetup_impl(Af, reuse)
@@ -200,6 +220,7 @@ class AMG:
         t0 = time.perf_counter()
         k = len(self.levels) if reuse < 0 else min(reuse, len(self.levels))
         old_levels, self.levels = self.levels, []
+        self._put_cache = {}
         from .aggregation.galerkin import (deferred_wrap_checks,
                                            geo_dia_disabled)
 
@@ -213,6 +234,7 @@ class AMG:
                 level.reuse_structure(old)
                 Ac = level.create_coarse_matrix()
                 self.levels.append(level)
+                self._prefetch_level(level)
                 Af = Ac.build_spmv_layout() if Ac.initialized else Ac.init()
                 lvl += 1
             return Af, lvl
@@ -226,6 +248,7 @@ class AMG:
             # geometric invariant — redo the reuse loop with the generic
             # relabel Galerkin (same reused aggregates, one extra pass)
             self.levels = []
+            self._put_cache = {}
             with geo_dia_disabled():
                 Af, lvl = reuse_loop(Af0)
         self._build_levels_checked(Af, lvl)
@@ -254,6 +277,7 @@ class AMG:
             with trace_region(f"amg.L{lvl}.galerkin"):
                 Ac = level.create_coarse_matrix()
             self.levels.append(level)
+            self._prefetch_level(level)
             with trace_region(f"amg.L{lvl}.layout"):
                 Af = Ac.build_spmv_layout() if Ac.initialized else Ac.init()
             lvl += 1
@@ -306,6 +330,45 @@ class AMG:
     # -- solve-phase data -------------------------------------------------
     _PRECISIONS = {"double": None, "float": "float32", "bfloat16": "bfloat16"}
 
+    def _cast_leaf(self, leaf):
+        """amg_precision cast of one solve-data leaf (identity for
+        structure arrays and full-precision mode)."""
+        import jax.numpy as jnp
+        dt = self._PRECISIONS[self.precision]
+        if dt is not None and hasattr(leaf, "dtype") and \
+                jnp.issubdtype(leaf.dtype, jnp.inexact):
+            return leaf.astype(dt)
+        return leaf
+
+    def _prefetch_leaves(self, tree):
+        """Start async host->device transfers of a solve-data subtree's
+        unique leaves, keyed by the PRE-cast host leaf identity so
+        solve_data can pick them up."""
+        import jax
+        todo = []
+        for leaf in jax.tree.leaves(tree):
+            if hasattr(leaf, "dtype") and id(leaf) not in self._put_cache:
+                todo.append(leaf)
+        if not todo:
+            return
+        placed = jax.device_put([self._cast_leaf(x) for x in todo],
+                                self._ship_device)
+        for src, dev in zip(todo, placed):
+            self._put_cache[id(src)] = (src, dev)
+
+    def _prefetch_level(self, level: AMGLevel):
+        """Ship a finished level's big matrix payloads while the rest of
+        the hierarchy is still building (device_put is async; the
+        transfer rides the tunnel behind the remaining host compute)."""
+        if self._ship_device is None:
+            return
+        pieces = [level.A.slim_for_spmv()]
+        for name in ("P", "R"):
+            op = getattr(level, name, None)
+            if op is not None and op.initialized:
+                pieces.append(op.slim_for_spmv())
+        self._prefetch_leaves(pieces)
+
     def solve_data(self) -> Dict[str, Any]:
         import jax
         if self._ship_device is not None and self._data_cache is not None:
@@ -314,46 +377,35 @@ class AMG:
             "levels": [lv.level_data() for lv in self.levels],
             "coarse": self.coarse_solver.solve_data(),
         }
+        if self._ship_device is not None:
+            # host-built hierarchy: transfer the UNIQUE arrays (each
+            # level's matrix arrays appear twice in the tree by object
+            # identity — level data + smoother data; per-leaf transfer
+            # would double tunnel traffic and HBM). Leaves prefetched by
+            # _prefetch_level during the build are already on (or in
+            # flight to) the accelerator; only the stragglers (smoother
+            # and coarse-solver payloads) transfer here. amg_precision
+            # casting happens host-side before the wire.
+            self._prefetch_leaves(data)
+            self._data_cache = jax.tree.map(
+                lambda leaf: self._put_cache[id(leaf)][1]
+                if hasattr(leaf, "dtype") else leaf, data)
+            return self._data_cache
         dt = self._PRECISIONS[self.precision]
         if dt is not None:
             # mixed-precision preconditioning (the dDFI-mode analog,
             # include/amgx_config.h:102-131): the whole stored hierarchy
             # and cycle run in reduced precision inside an f64 flexible
             # Krylov outer loop — on TPU this halves (or quarters) HBM
-            # traffic and turns on the f32 Pallas SpMV kernels.
-            # Duplicated leaves (each level's A appears in both the level
-            # data and its smoother's data as the same array object) cast
-            # once, preserving identity for the dedup below.
-            import jax.numpy as jnp
+            # traffic and turns on the f32 Pallas SpMV kernels
             memo = {}
 
             def cast(leaf):
-                if hasattr(leaf, "dtype") and \
-                        jnp.issubdtype(leaf.dtype, jnp.inexact):
-                    key = id(leaf)
-                    if key not in memo:
-                        memo[key] = (leaf, leaf.astype(dt))
-                    return memo[key][1]
-                return leaf
+                key = id(leaf)
+                if key not in memo:
+                    memo[key] = (leaf, self._cast_leaf(leaf))
+                return memo[key][1]
             data = jax.tree.map(cast, data)
-        if self._ship_device is not None:
-            # host-built hierarchy: one batched transfer of the UNIQUE
-            # arrays to the accelerator (each level's matrix arrays appear
-            # twice in the tree by object identity; transferring per-leaf
-            # would double both tunnel traffic and HBM), cached for the
-            # life of this setup
-            if self._data_cache is None:
-                uniq = {}
-                for leaf in jax.tree.leaves(data):
-                    if hasattr(leaf, "dtype"):
-                        uniq.setdefault(id(leaf), leaf)
-                placed = jax.device_put(list(uniq.values()),
-                                        self._ship_device)
-                lookup = dict(zip(uniq.keys(), placed))
-                self._data_cache = jax.tree.map(
-                    lambda leaf: lookup[id(leaf)]
-                    if hasattr(leaf, "dtype") else leaf, data)
-            return self._data_cache
         return data
 
     def _sweeps(self, level_index: int, pre: bool) -> int:

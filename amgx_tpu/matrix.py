@@ -81,9 +81,10 @@ def _np_row_reduce(op, data, ro, n, empty_val):
     jax.tree_util.register_dataclass,
     data_fields=["row_offsets", "col_indices", "values", "diag",
                  "row_ids", "diag_idx", "ell_cols", "ell_vals", "dia_vals",
+                 "swell_cols", "swell_vals", "swell_c0row", "swell_nchunk",
                  "user_colors"],
     meta_fields=["num_rows", "num_cols", "block_dimx", "block_dimy",
-                 "initialized", "dia_offsets", "grid_shape",
+                 "initialized", "dia_offsets", "swell_w128", "grid_shape",
                  "user_num_colors"],
 )
 @dataclasses.dataclass(frozen=True)
@@ -104,6 +105,14 @@ class CsrMatrix:
     ell_vals: Optional[Array] = None   # (n, k) | (n, k, bx, by)
     dia_offsets: Optional[tuple] = None  # static tuple of diagonal offsets
     dia_vals: Optional[Array] = None   # (k, rows_pad, 128) tiled diagonals
+    # windowed-ELL (SWELL) layout for unstructured matrices (the Pallas
+    # gather kernel's storage, ops/pallas_swell.py): slot-major
+    # (nb, kpad, 128) blocks + per-block x-window starts/chunk counts
+    swell_cols: Optional[Array] = None   # (nb, kpad, 128) local columns
+    swell_vals: Optional[Array] = None   # (nb, kpad, 128)
+    swell_c0row: Optional[Array] = None  # (nb,) window start, 128-rows
+    swell_nchunk: Optional[Array] = None  # (nb,) populated chunk count
+    swell_w128: int = 0                  # static window width, 128-chunks
     num_rows: int = 0
     num_cols: int = 0
     block_dimx: int = 1
@@ -203,18 +212,22 @@ class CsrMatrix:
                             np.arange(self.nnz, dtype=np.int64), self.nnz)
             dmin = _np_row_reduce(np.minimum, cand, ro, n, self.nnz)
             diag_idx = np.where(dmin >= self.nnz, -1, dmin).astype(np.int32)
-        ell_cols, ell_vals, dia_offsets, dia_vals = self._choose_layout_host(
+        layout = self._choose_layout_host(
             ro, ci, vals, row_ids, row_nnz, ell, ell_max_ratio)
         return dataclasses.replace(
-            self, row_ids=row_ids, diag_idx=diag_idx,
-            ell_cols=ell_cols, ell_vals=ell_vals,
-            dia_offsets=dia_offsets, dia_vals=dia_vals, initialized=True)
+            self, row_ids=row_ids, diag_idx=diag_idx, initialized=True,
+            **layout)
 
     def _choose_layout_host(self, ro, ci, vals, row_ids, row_nnz, ell: str,
-                            ell_max_ratio: float):
+                            ell_max_ratio: float) -> dict:
+        """Host layout choice: DIA if banded, else the windowed-ELL
+        (SWELL) Pallas layout if the block windows fit, else padded ELL
+        if the row lengths are tight. Returns the layout fields as a
+        dict for dataclasses.replace."""
         n = self.num_rows
-        ell_cols = ell_vals = None
-        dia_offsets = dia_vals = None
+        out = dict(ell_cols=None, ell_vals=None, dia_offsets=None,
+                   dia_vals=None, swell_cols=None, swell_vals=None,
+                   swell_c0row=None, swell_nchunk=None, swell_w128=0)
         if n > 0 and self.nnz > 0 and not self.has_external_diag \
                 and ell == "auto":
             diffs = ci.astype(np.int64) - row_ids
@@ -223,14 +236,30 @@ class CsrMatrix:
             if k <= self.DIA_MAX_OFFSETS and \
                     k * n <= self.DIA_FILL_RATIO * max(self.nnz, 1):
                 from .ops.pallas_spmv import LANES, dia_padded_rows
-                dia_offsets = tuple(int(o) for o in offs)
+                out["dia_offsets"] = tuple(int(o) for o in offs)
                 d_idx = np.searchsorted(offs, diffs)
                 rows_pad = dia_padded_rows(k, n)
-                flat = np.bincount(
-                    d_idx * (rows_pad * LANES) + row_ids, weights=vals,
-                    minlength=k * rows_pad * LANES).astype(vals.dtype)
-                dia_vals = flat.reshape(k, rows_pad, LANES)
-        if dia_offsets is None and n > 0 and ell != "never" and self.nnz > 0:
+                slots = d_idx * (rows_pad * LANES) + row_ids
+                size = k * rows_pad * LANES
+                if np.iscomplexobj(vals):
+                    flat = (np.bincount(slots, weights=vals.real,
+                                        minlength=size)
+                            + 1j * np.bincount(slots, weights=vals.imag,
+                                               minlength=size))
+                else:
+                    flat = np.bincount(slots, weights=vals,
+                                       minlength=size)
+                out["dia_vals"] = flat.astype(vals.dtype).reshape(
+                    k, rows_pad, LANES)
+                return out
+        if n > 0 and self.nnz > 0 and ell == "auto":
+            from .ops.pallas_swell import build_swell_host
+            sw = build_swell_host(ro, ci, vals, n, self.num_cols)
+            if sw is not None:
+                (out["swell_cols"], out["swell_vals"], out["swell_c0row"],
+                 out["swell_nchunk"], out["swell_w128"]) = sw
+                return out
+        if n > 0 and ell != "never" and self.nnz > 0:
             max_k = int(row_nnz.max()) if row_nnz.size else 0
             mean = max(float(self.nnz) / max(n, 1), 1e-30)
             want_ell = (ell == "always") or (
@@ -243,9 +272,9 @@ class CsrMatrix:
                 ec[flat] = ci
                 ev = np.zeros(n * max_k, vals.dtype)
                 ev[flat] = vals
-                ell_cols, ell_vals = ec.reshape(n, max_k), \
-                    ev.reshape(n, max_k)
-        return ell_cols, ell_vals, dia_offsets, dia_vals
+                out["ell_cols"], out["ell_vals"] = \
+                    ec.reshape(n, max_k), ev.reshape(n, max_k)
+        return out
 
     def _choose_layout(self, row_ids, row_nnz, ell: str,
                        ell_max_ratio: float):
@@ -274,21 +303,19 @@ class CsrMatrix:
         segment-sum path, which is the slow shape on TPU)."""
         if not self.initialized:
             return self.init(ell=ell, ell_max_ratio=ell_max_ratio)
-        if self.dia_vals is not None or self.ell_cols is not None:
+        if self.dia_vals is not None or self.ell_cols is not None \
+                or self.swell_cols is not None:
             return self
         if not self.is_block and host_resident(
                 self.row_offsets, self.col_indices, self.values,
                 self.row_ids):
             ro = np.asarray(self.row_offsets)
             vals = np.asarray(self.values)
-            ell_cols, ell_vals, dia_offsets, dia_vals = \
-                self._choose_layout_host(
-                    ro, np.asarray(self.col_indices), vals,
-                    np.asarray(self.row_ids), np.diff(ro), ell,
-                    ell_max_ratio)
-            return dataclasses.replace(
-                self, ell_cols=ell_cols, ell_vals=ell_vals,
-                dia_offsets=dia_offsets, dia_vals=dia_vals)
+            layout = self._choose_layout_host(
+                ro, np.asarray(self.col_indices), vals,
+                np.asarray(self.row_ids), np.diff(ro), ell,
+                ell_max_ratio)
+            return dataclasses.replace(self, **layout)
         row_nnz = jnp.diff(self.row_offsets)
         ell_cols, ell_vals, dia_offsets, dia_vals = self._choose_layout(
             self.row_ids, row_nnz, ell, ell_max_ratio)
@@ -418,6 +445,19 @@ class CsrMatrix:
             out = dataclasses.replace(
                 out, dia_vals=out._build_dia_vals(self.dia_offsets,
                                                   self.row_ids))
+        if self.initialized and self.swell_cols is not None:
+            if host_resident(self.row_offsets, values):
+                from .ops.pallas_swell import swell_vals_host
+                out = dataclasses.replace(
+                    out, swell_vals=swell_vals_host(
+                        np.asarray(self.row_offsets), np.asarray(values),
+                        self.num_rows, self.swell_cols.shape[2]))
+            else:
+                # structure kept but values not re-scatterable off-host;
+                # drop the fast-path layout rather than serve stale data
+                out = dataclasses.replace(
+                    out, swell_cols=None, swell_vals=None,
+                    swell_c0row=None, swell_nchunk=None, swell_w128=0)
         return out
 
     def interior_exterior_split(self, num_owned_cols: int):
@@ -514,6 +554,13 @@ class CsrMatrix:
             return dataclasses.replace(
                 self, values=jnp.zeros((1,), self.dtype),
                 col_indices=dummy_i, row_ids=None, diag_idx=None,
+                row_offsets=dummy_i, ell_cols=None, ell_vals=None,
+                swell_cols=None, swell_vals=None, swell_c0row=None,
+                swell_nchunk=None, swell_w128=0)
+        if self.swell_cols is not None:
+            return dataclasses.replace(
+                self, values=jnp.zeros((1,), self.dtype),
+                col_indices=dummy_i, row_ids=None, diag_idx=None,
                 row_offsets=dummy_i, ell_cols=None, ell_vals=None)
         if self.ell_cols is not None:
             return dataclasses.replace(
@@ -533,7 +580,8 @@ class CsrMatrix:
             return a
         return dataclasses.replace(
             self, values=cast(self.values), diag=cast(self.diag),
-            ell_vals=cast(self.ell_vals), dia_vals=cast(self.dia_vals))
+            ell_vals=cast(self.ell_vals), dia_vals=cast(self.dia_vals),
+            swell_vals=cast(self.swell_vals))
 
     def coo(self):
         """Return (row_ids, col_indices, values) COO triplets. Computes

@@ -1,0 +1,273 @@
+"""Pallas TPU SpMV kernel for the windowed-ELL (SWELL) layout —
+unstructured matrices.
+
+The reference's workhorse is a CUDA csrmv over arbitrary CSR
+(src/multiply.cu:74-121); AMG coarse operators and the P/R transfer
+operators are exactly such matrices. On TPU the XLA lowering of the
+gather `x[col_indices]` is catastrophically slow (tens of ms per call at
+level sizes) and Mosaic has no arbitrary-gather primitive — but it DOES
+support `take_along_axis` within a (rows, 128) tile along lanes. This
+kernel builds an SpMV out of that primitive:
+
+- rows are tiled into super-blocks of 1024 (8 sublane groups x 128
+  lanes); each super-block's columns all fall inside a window
+  [c0_b, c0_b + W) of x, where W is the static max block span (AMG and
+  interpolation matrices inherit the fine grid's locality, so
+  W ~ bandwidth << num_cols);
+- per block, the x window is DMA'd HBM->VMEM (double-buffered, like the
+  DIA kernel) as (W/128, 128) chunks;
+- entry slots are stored slot-major as (8, kpad, 128): sublane group =
+  row-group, sublane = ELL slot, lane = row-in-group. Viewed as
+  (8*kpad, 128), the gather decomposes per 128-wide window chunk c:
+  take_along_axis(chunk broadcast, lo, axis=1) selected where the local
+  column's hi bits == c;
+- a fori_loop runs only the block's populated chunk count (nchunk_b,
+  from SMEM), then y = sum over slots of acc * vals.
+
+Traffic per block: 8*kpad*128 values + cols (the ELL-padded minimum)
+plus a W-element window of x. Compute is ~3 VPU ops per (8*kpad, 128)
+tile per chunk — compute-bound relative to HBM, but 50-500x faster than
+the XLA gather form it replaces. float32 only (like the DIA kernel);
+the XLA gather form below covers f64/CPU/batched callers.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+SUBS = 8                      # sublane groups per super-block
+BLOCK_ROWS = SUBS * LANES     # rows per super-block
+SWELL_MAX_W = 64 * 1024       # max window elements (256 KB f32 a buffer)
+SWELL_MAX_K = 256             # max padded slots per row
+_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def build_swell_host(ro, ci, vals, num_rows, num_cols):
+    """Numpy construction of the SWELL layout for a host-resident CSR.
+
+    Returns (cols4, vals4, c0row, nchunk, w128) or None when the layout
+    does not pay (window or slot budget exceeded). cols4/vals4 are
+    (nb, 8, kpad, 128) slot-major super-blocks; c0row is each block's
+    window start in 128-rows of the padded x; nchunk its populated
+    chunk count.
+    """
+    n = int(num_rows)
+    if n == 0 or ci.shape[0] == 0:
+        return None
+    nb = -(-n // BLOCK_ROWS)
+    row_nnz = np.diff(ro)
+    kmax = int(row_nnz.max())
+    if kmax == 0 or kmax > SWELL_MAX_K:
+        return None
+    kpad = -(-kmax // 8) * 8
+    # fill guard (the ELL path's ell_max_ratio analog): one long row
+    # would otherwise inflate the padded layout to n*kpad slots. Small
+    # layouts are exempt — kpad's round-to-8 alone inflates tiny
+    # matrices past any ratio, and a <1M-slot layout cannot blow memory.
+    slots = nb * SUBS * kpad * LANES
+    if slots > 6 * max(ci.shape[0], 1) and slots > (1 << 20):
+        return None
+    # per-row col extents -> per-super-block window
+    starts = ro[:-1].astype(np.int64)
+    nonempty = ro[1:] > ro[:-1]
+    idx = np.clip(starts, 0, ci.shape[0] - 1)
+    big = np.iinfo(np.int32).max
+    rmin = np.where(nonempty, np.minimum.reduceat(ci, idx), big)
+    rmax = np.where(nonempty, np.maximum.reduceat(ci, idx), -1)
+    pad = nb * BLOCK_ROWS - n
+    if pad:
+        rmin = np.concatenate([rmin, np.full(pad, big)])
+        rmax = np.concatenate([rmax, np.full(pad, -1)])
+    bmin = rmin.reshape(nb, BLOCK_ROWS).min(axis=1)
+    bmax = rmax.reshape(nb, BLOCK_ROWS).max(axis=1)
+    empty_b = bmax < 0
+    bmin = np.where(empty_b, 0, bmin)
+    bmax = np.where(empty_b, 0, bmax)
+    c0 = (bmin // LANES) * LANES
+    span = bmax - c0 + 1
+    w = int(-(-int(span.max()) // LANES) * LANES)
+    if w > SWELL_MAX_W:
+        return None
+    nchunk = (-(-span // LANES)).astype(np.int32)
+    # scatter entries into (nb, 8, kpad, 128) slot-major blocks
+    row_ids = np.repeat(np.arange(n, dtype=np.int64), row_nnz)
+    slot = np.arange(ci.shape[0], dtype=np.int64) - \
+        ro[row_ids].astype(np.int64)
+    b = row_ids // BLOCK_ROWS
+    sub = (row_ids % BLOCK_ROWS) // LANES
+    lane = row_ids & (LANES - 1)
+    flat = (((b * SUBS + sub) * kpad) + slot) * LANES + lane
+    cols4 = np.zeros(nb * SUBS * kpad * LANES, np.int32)
+    cols4[flat] = ci - c0[b]
+    vals4 = np.zeros(nb * SUBS * kpad * LANES, vals.dtype)
+    vals4[flat] = vals
+    return (cols4.reshape(nb, SUBS, kpad, LANES),
+            vals4.reshape(nb, SUBS, kpad, LANES),
+            (c0 // LANES).astype(np.int32), nchunk, w // LANES)
+
+
+def swell_vals_host(ro, vals, num_rows, kpad):
+    """Re-scatter new coefficients into an existing SWELL layout
+    (replace_coefficients with structure reuse)."""
+    n = int(num_rows)
+    nb = -(-n // BLOCK_ROWS)
+    row_nnz = np.diff(ro)
+    row_ids = np.repeat(np.arange(n, dtype=np.int64), row_nnz)
+    slot = np.arange(vals.shape[0], dtype=np.int64) - \
+        ro[row_ids].astype(np.int64)
+    b = row_ids // BLOCK_ROWS
+    sub = (row_ids % BLOCK_ROWS) // LANES
+    flat = (((b * SUBS + sub) * kpad) + slot) * LANES + \
+        (row_ids & (LANES - 1))
+    vals4 = np.zeros(nb * SUBS * kpad * LANES, vals.dtype)
+    vals4[flat] = vals
+    return vals4.reshape(nb, SUBS, kpad, LANES)
+
+
+def swell_spmv_supported(A, x_dtype) -> bool:
+    """Trace-time gate for the Pallas path."""
+    if jax.default_backend() != "tpu":
+        return False
+    if A.swell_cols is None or A.swell_vals is None:
+        return False
+    if A.swell_vals.dtype != jnp.float32 or x_dtype != jnp.float32:
+        return False
+    w128 = A.swell_w128
+    kpad = A.swell_vals.shape[2]
+    win_bytes = 2 * w128 * LANES * 4
+    ent_bytes = 2 * SUBS * kpad * LANES * (4 + 4)
+    out_bytes = 2 * SUBS * LANES * 4          # double-buffered y blocks
+    return win_bytes + ent_bytes + out_bytes <= _VMEM_BUDGET
+
+
+def _swell_kernel(w128, kpad, n_blocks):
+    rows = SUBS * kpad
+
+    def kernel(c0_ref, nch_ref, xp_ref, cols_ref, vals_ref, y_ref,
+               xbuf, sems):
+        b = pl.program_id(0)
+        slot = jax.lax.rem(b, jnp.int32(2))
+
+        def dma(s, blk):
+            return pltpu.make_async_copy(
+                xp_ref.at[pl.ds(c0_ref[blk], w128)],
+                xbuf.at[jnp.int32(s)], sems.at[jnp.int32(s)])
+
+        @pl.when(b == 0)
+        def _():
+            dma(0, 0).start()
+
+        @pl.when(b + 1 < n_blocks)
+        def _():
+            dma(jax.lax.rem(b + 1, jnp.int32(2)), b + 1).start()
+
+        dma(slot, b).wait()
+
+        cols = cols_ref[0].reshape(rows, LANES)   # slot-major local cols
+        vals = vals_ref[0].reshape(rows, LANES)
+        hi = jax.lax.shift_right_logical(cols, jnp.int32(7))
+        lo = jax.lax.bitwise_and(cols, jnp.int32(LANES - 1))
+
+        def chunk_step(c, acc):
+            chunk = xbuf[slot, pl.ds(c, 1)]       # (1, 128)
+            src = jnp.broadcast_to(chunk, (rows, LANES))
+            # keep the gather's index math int32 (Mosaic has no i64;
+            # the package-level x64 default would promote)
+            with jax.enable_x64(False):
+                g = jnp.take_along_axis(src, lo, axis=1)
+            return jnp.where(hi == c, g, acc)
+
+        acc = jax.lax.fori_loop(jnp.int32(0), nch_ref[b], chunk_step,
+                                jnp.zeros((rows, LANES), jnp.float32))
+        y_ref[...] = jnp.sum(
+            (acc * vals).reshape(SUBS, kpad, LANES), axis=1)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("w128", "num_rows",
+                                             "interpret"))
+def _swell_spmv_call(cols4, vals4, c0row, nchunk, x, w128, num_rows,
+                     interpret=False):
+    nb, _, kpad, _ = vals4.shape
+    n = num_rows
+    ncols = x.shape[0]
+    # pad x to whole 128-rows plus the window overhang past the end
+    xp_rows = -(-ncols // LANES) + w128
+    xp = jnp.zeros((xp_rows * LANES,), jnp.float32)
+    xp = jax.lax.dynamic_update_slice(xp, x.astype(jnp.float32), (0,))
+    xp = xp.reshape(xp_rows, LANES)
+
+    kernel = _swell_kernel(w128, kpad, nb)
+    y2 = pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=[
+            # explicit shapes + int32 index maps: the default full-array
+            # spec's index map emits i64 constants under the package's
+            # x64 default, which Mosaic cannot legalize
+            pl.BlockSpec((nb,), lambda b: (jnp.int32(0),),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((nb,), lambda b: (jnp.int32(0),),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, SUBS, kpad, LANES),
+                         lambda b: (b, jnp.int32(0), jnp.int32(0),
+                                    jnp.int32(0)),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, SUBS, kpad, LANES),
+                         lambda b: (b, jnp.int32(0), jnp.int32(0),
+                                    jnp.int32(0)),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((SUBS, LANES),
+                               lambda b: (b, jnp.int32(0)),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((nb * SUBS, LANES), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((2, w128, LANES), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * nb * SUBS * kpad * LANES,
+            bytes_accessed=(2 * kpad + 1) * nb * SUBS * LANES * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(c0row, nchunk, xp, cols4, vals4)
+    y = y2.reshape(-1)
+    if y.shape[0] != n:
+        y = y[:n]
+    return y
+
+
+def swell_spmv(A, x, interpret=False):
+    """Fused SWELL SpMV; caller must have checked swell_spmv_supported
+    (`interpret=True` runs the Pallas interpreter — CPU test path)."""
+    return _swell_spmv_call(A.swell_cols, A.swell_vals, A.swell_c0row,
+                            A.swell_nchunk, x, A.swell_w128, A.num_rows,
+                            interpret=interpret)
+
+
+def swell_spmv_xla(A, x):
+    """XLA gather form of the same layout (f64/CPU/batched fallback).
+    Semantically identical to the kernel: absolute column = block window
+    start + stored local column."""
+    nb, _, kpad, _ = A.swell_vals.shape
+    dtype = jnp.promote_types(A.swell_vals.dtype, x.dtype)
+    ncols = A.num_cols
+    xp_len = (-(-ncols // LANES) + A.swell_w128) * LANES
+    xp = jnp.zeros((xp_len,), dtype)
+    xp = jax.lax.dynamic_update_slice(xp, x.astype(dtype), (0,))
+    abscol = (A.swell_c0row.astype(jnp.int32) * LANES)[:, None, None, None] \
+        + A.swell_cols
+    y = (A.swell_vals.astype(dtype) * xp[abscol]).sum(axis=2).reshape(-1)
+    if y.shape[0] != A.num_rows:
+        y = y[: A.num_rows]
+    return y

@@ -104,6 +104,36 @@ def _spmv_dia_pallas_vmap(axis_size, in_batched, A, x):
     return y, True
 
 
+@jax.custom_batching.custom_vmap
+def _spmv_swell_pallas(A: CsrMatrix, x: jax.Array) -> jax.Array:
+    from .pallas_swell import swell_spmv
+    return swell_spmv(A, x)
+
+
+@_spmv_swell_pallas.def_vmap
+def _spmv_swell_pallas_vmap(axis_size, in_batched, A, x):
+    from .pallas_swell import swell_spmv_xla
+    A_b, x_b = in_batched
+    in_axes = (jax.tree_util.tree_map(lambda b: 0 if b else None, A_b),
+               0 if x_b else None)
+    y = jax.vmap(swell_spmv_xla, in_axes=in_axes, axis_size=axis_size)(A, x)
+    return y, True
+
+
+def spmv_swell(A: CsrMatrix, x: jax.Array) -> jax.Array:
+    """y = A @ x in the windowed-ELL (SWELL) layout: the Pallas
+    lane-gather kernel on TPU/f32 (ops/pallas_swell.py — the unstructured
+    analog of the DIA fast path), the XLA gather form elsewhere."""
+    from .pallas_swell import swell_spmv_supported, swell_spmv_xla
+    if swell_spmv_supported(A, x.dtype):
+        y = _spmv_swell_pallas(A, x)
+    else:
+        y = swell_spmv_xla(A, x)
+    if A.has_external_diag:
+        y = y + A.diag * x[: A.num_rows]
+    return y
+
+
 def spmv_dia(A: CsrMatrix, x: jax.Array) -> jax.Array:
     """y = A @ x in DIA (diagonal) storage: for each stored diagonal with
     offset d, y += vals_d * shift(x, d). Pure dense vector multiply-adds
@@ -127,6 +157,8 @@ def spmv(A, x: jax.Array) -> jax.Array:
     _ensure_init(A, x)
     if A.dia_offsets is not None:
         return spmv_dia(A, x)
+    if A.swell_cols is not None:
+        return spmv_swell(A, x)
     if A.ell_cols is not None:
         return spmv_ell(A, x)
     return spmv_csr_segsum(A, x)
