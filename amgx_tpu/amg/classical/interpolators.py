@@ -121,15 +121,19 @@ class Distance2Interpolator(Interpolator):
             out = native.d2_interp_native(
                 n, np.asarray(A.row_offsets), np.asarray(A.col_indices),
                 np.asarray(A.values), np.asarray(strong, np.uint8),
-                np.asarray(cf_map, np.int32))
+                np.asarray(cf_map, np.int32), self.trunc_factor,
+                self.max_elements)
             if out is not None:
+                # truncation is fused into the native sweep; numpy-backed
+                # on purpose: the host hierarchy build stays off the
+                # XLA:CPU array path end to end
                 p_ptr, p_col, p_val = out
                 nc = int(np.sum(np.asarray(cf_map) == 1))
-                P = CsrMatrix.from_scipy_like(
-                    p_ptr.astype(np.int32), p_col,
-                    jnp.asarray(p_val.astype(
-                        np.asarray(A.values).dtype)), n, nc)
-                return _truncate(P, self.trunc_factor, self.max_elements)
+                return CsrMatrix(
+                    row_offsets=p_ptr.astype(np.int32), col_indices=p_col,
+                    values=p_val.astype(np.asarray(A.values).dtype,
+                                        copy=False), num_rows=n,
+                    num_cols=nc)
         ro = np.asarray(A.row_offsets)
         cols = np.asarray(A.col_indices)
         vals = np.asarray(A.values)
@@ -463,6 +467,9 @@ def _truncate(P: CsrMatrix, factor: float, max_elements: int) -> CsrMatrix:
     preserve row sums (src/truncate.cu semantics for P)."""
     if factor > 1.0 and max_elements <= 0:
         return P
+    from ...matrix import host_resident
+    if host_resident(P.row_offsets, P.col_indices, P.values):
+        return _truncate_host(P, factor, max_elements)
     rows, cols, vals = P.coo()
     n = P.num_rows
     absv = jnp.abs(vals)
@@ -492,3 +499,44 @@ def _truncate(P: CsrMatrix, factor: float, max_elements: int) -> CsrMatrix:
     scale = jnp.where(keptsum == 0, 1.0, scale)
     return _compact_coo(rows, cols, vals * scale[rows], keep, P.num_rows,
                         num_cols=P.num_cols)
+
+
+def _truncate_host(P: CsrMatrix, factor: float, max_elements: int
+                   ) -> CsrMatrix:
+    """Numpy form of _truncate for the host-setup path (same semantics;
+    keeps the hierarchy numpy-backed — the truncated P feeds straight
+    into the native RAP/SWELL components)."""
+    n = P.num_rows
+    ro = np.asarray(P.row_offsets)
+    cols = np.asarray(P.col_indices)
+    vals = np.asarray(P.values)
+    rows = np.repeat(np.arange(n, dtype=np.int32), np.diff(ro))
+    absv = np.abs(vals)
+    keep = np.ones(vals.shape[0], bool)
+    from ...matrix import _np_row_reduce
+    if factor <= 1.0:
+        rmax = _np_row_reduce(np.maximum, absv, ro, n, 0.0)
+        keep &= absv >= factor * rmax[rows]
+    if max_elements > 0:
+        # rank entries within each row by descending |v| (stable), cap
+        order1 = np.argsort(-absv, kind="stable")
+        order2 = np.argsort(rows[order1], kind="stable")
+        ordn = order1[order2]
+        pos = np.arange(vals.shape[0], dtype=np.int64)
+        first = np.full(n, vals.shape[0], np.int64)
+        np.minimum.at(first, rows[ordn], pos)
+        within = pos - first[rows[ordn]]
+        keep[ordn] &= within < max_elements
+    rowsum = np.bincount(rows, weights=vals, minlength=n)
+    keptsum = np.bincount(rows, weights=np.where(keep, vals, 0.0),
+                          minlength=n)
+    scale = np.where(keptsum == 0, 1.0,
+                     rowsum / np.where(keptsum == 0, 1.0, keptsum))
+    new_vals = (vals * scale[rows])[keep]
+    new_cols = cols[keep]
+    counts = np.bincount(rows[keep], minlength=n)
+    new_ro = np.zeros(n + 1, np.int32)
+    np.cumsum(counts, out=new_ro[1:])
+    return CsrMatrix(row_offsets=new_ro, col_indices=new_cols,
+                     values=new_vals.astype(vals.dtype, copy=False),
+                     num_rows=n, num_cols=P.num_cols)

@@ -73,7 +73,9 @@ def pmis_split(A: CsrMatrix, strong, max_iters: int = 30, init=None):
             None if init is None else np.asarray(init, np.int32),
             max_iters)
         if cf is not None:
-            return jnp.asarray(cf, jnp.int32)
+            # numpy on purpose: the host hierarchy build stays off jax
+            # CPU arrays (jnp consumers accept numpy transparently)
+            return cf
     rows, cols, _ = A.coo()
     sr, sc = _symmetrize(rows, cols, strong, n)
     deg = jnp.zeros((n,), jnp.float64).at[sr].add(1.0) * 0.5

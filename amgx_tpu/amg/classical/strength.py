@@ -61,10 +61,20 @@ class AhatStrength(Strength):
 
     def _strong_mask_host(self, A: CsrMatrix):
         """Numpy form of the same mask for host-resident matrices (the
-        host-setup path; avoids ~20 eager XLA:CPU dispatches/level)."""
+        host-setup path; avoids ~20 eager XLA:CPU dispatches/level).
+        The in-line-diagonal case runs as ONE native C++ sweep
+        (amgx_strength_ahat) — this is a per-level O(nnz) hot path."""
         import numpy as np
         from ...matrix import _np_row_reduce
         n = A.num_rows
+        if not A.has_external_diag and \
+                np.asarray(A.values).dtype.kind == "f":
+            from ... import native
+            strong = native.strength_ahat_native(
+                n, np.asarray(A.row_offsets), np.asarray(A.col_indices),
+                np.asarray(A.values), self.theta, self.max_row_sum)
+            if strong is not None:
+                return strong
         ro = np.asarray(A.row_offsets)
         cols = np.asarray(A.col_indices)
         vals = np.asarray(A.values)

@@ -16,6 +16,7 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, List, Optional
 
+import jax
 import numpy as np
 
 from .. import registry
@@ -77,6 +78,9 @@ class AMGLevel:
         raise NotImplementedError
 
 
+_PENDING = object()    # _put_cache placeholder: (src, (_PENDING, fut, i))
+
+
 class AMG:
     """Hierarchy owner + setup loop (AMG<>::setup analog, src/amg.cu)."""
 
@@ -111,6 +115,7 @@ class AMG:
         # building so the tunnel transfer hides behind the remaining
         # host compute
         self._put_cache: Dict[int, tuple] = {}
+        self._ship_pool = None
 
     # -- setup -----------------------------------------------------------
     def _host_setup_device(self, A: CsrMatrix):
@@ -145,6 +150,7 @@ class AMG:
         self.levels = []
         self._data_cache = None
         self._put_cache = {}
+        self._l0_seed = None     # dropped unless this setup re-registers
         host = self._host_setup_device(A)
         if host is not None:
             # decide BEFORE init: the SpMV-layout build is itself eager
@@ -152,9 +158,14 @@ class AMG:
             # the device the caller's context selected
             self._ship_device = (jax.config.jax_default_device
                                  or jax.devices()[0])
+            # cast OUTSIDE the host default-device block: orig's arrays
+            # are uncommitted accelerator data, and an astype dispatched
+            # under default_device(cpu) would pull them over the tunnel
+            l0_dev = self._l0_device_cast(A)
             with jax.default_device(host):
-                Af = jax.device_put(self._strip_layouts(A), host)
+                Af = self._pull_numpy(self._strip_layouts(A))
                 Af = Af.init()
+                self._register_device_l0(A, Af, l0_dev)
                 self._build_levels_checked(Af, 0)
                 self._finalize_setup(t0)
             return self
@@ -163,6 +174,53 @@ class AMG:
         self._build_levels_checked(Af, 0)
         self._finalize_setup(t0)
         return self
+
+    def _pull_numpy(self, A: CsrMatrix) -> CsrMatrix:
+        """Pull a (layout-stripped) matrix's arrays to host numpy. The
+        host hierarchy build runs on numpy end to end: every native
+        component (PMIS/D2/RAP/SWELL) consumes and produces numpy, so
+        staying off jax CPU arrays avoids one full copy of every array
+        at every native-call boundary. Arrays uploaded from host data
+        resolve through the retained host mirror (matrix.py
+        _HOST_MIRROR) — no accelerator->host transfer at all."""
+        import dataclasses
+        from ..matrix import host_mirror_asarray as pull
+        return dataclasses.replace(
+            A, row_offsets=pull(A.row_offsets),
+            col_indices=pull(A.col_indices),
+            values=pull(A.values),
+            diag=None if A.diag is None else pull(A.diag))
+
+    def _l0_device_cast(self, orig: CsrMatrix):
+        """Precision-cast of the caller's finest-level DIA payload,
+        dispatched on the caller's device (must run OUTSIDE the host
+        default-device block — see setup())."""
+        if orig is not None and orig.initialized \
+                and orig.dia_vals is not None:
+            return self._cast_leaf(orig.dia_vals)
+        return None
+
+    def _register_device_l0(self, orig: CsrMatrix, Af_host: CsrMatrix,
+                            dev_cast):
+        """The caller's device matrix already holds the finest level's
+        SpMV layout; pre-seeding the transfer cache with its (precision-
+        cast, cast ON device) DIA payload makes the ship skip the one
+        payload that is both the largest and already resident — the
+        host-rebuilt L0 layout never crosses the wire."""
+        if not (dev_cast is not None
+                and Af_host.dia_offsets == orig.dia_offsets
+                and isinstance(Af_host.dia_vals, np.ndarray)):
+            self._l0_seed = None
+            return
+        self._l0_seed = (Af_host.dia_vals, dev_cast)
+        self._seed_put_cache()
+
+    def _seed_put_cache(self):
+        """(Re)apply the L0 device-payload seed after any _put_cache
+        reset (resetup, abandoned GEO builds)."""
+        if getattr(self, "_l0_seed", None) is not None:
+            src, dev = self._l0_seed
+            self._put_cache[id(src)] = (src, dev)
 
     @staticmethod
     def _strip_layouts(A: CsrMatrix) -> CsrMatrix:
@@ -192,6 +250,7 @@ class AMG:
                 # drop transfers prefetched for the abandoned build (they
                 # pin both host and HBM copies of every shipped level)
                 self._put_cache = {}
+                self._seed_put_cache()
                 with geo_dia_disabled():
                     self._build_levels(Af, lvl)
 
@@ -207,11 +266,16 @@ class AMG:
             return self.setup(A)
         self._data_cache = None
         if self._ship_device is not None:
-            import jax
             host = jax.devices("cpu")[0]
+            l0_dev = self._l0_device_cast(A)        # see setup()
             with jax.default_device(host):
-                Af = jax.device_put(self._strip_layouts(A), host)
+                Af = self._pull_numpy(self._strip_layouts(A))
                 Af = Af.init()
+                # refresh the L0 seed: the rebuilt host hierarchy has a
+                # NEW dia array (a stale seed would both miss the ship
+                # skip and pin the previous payload for the object's
+                # lifetime)
+                self._register_device_l0(A, Af, l0_dev)
                 return self._resetup_impl(Af, reuse)
         Af = A if A.initialized else A.init()
         return self._resetup_impl(Af, reuse)
@@ -221,6 +285,7 @@ class AMG:
         k = len(self.levels) if reuse < 0 else min(reuse, len(self.levels))
         old_levels, self.levels = self.levels, []
         self._put_cache = {}
+        self._seed_put_cache()
         from .aggregation.galerkin import (deferred_wrap_checks,
                                            geo_dia_disabled)
 
@@ -249,6 +314,7 @@ class AMG:
             # relabel Galerkin (same reused aggregates, one extra pass)
             self.levels = []
             self._put_cache = {}
+            self._seed_put_cache()
             with geo_dia_disabled():
                 Af, lvl = reuse_loop(Af0)
         self._build_levels_checked(Af, lvl)
@@ -341,9 +407,14 @@ class AMG:
         return leaf
 
     def _prefetch_leaves(self, tree):
-        """Start async host->device transfers of a solve-data subtree's
-        unique leaves, keyed by the PRE-cast host leaf identity so
-        solve_data can pick them up."""
+        """Start host->device transfers of a solve-data subtree's unique
+        leaves, keyed by the PRE-cast host leaf identity so solve_data
+        can pick them up. The cast + device_put run on a single worker
+        thread: device_put to a tunneled accelerator blocks for the
+        wire time, while the build thread spends its time inside
+        GIL-releasing native sweeps — threading the ship overlaps the
+        two (the reference gets the same overlap from CUDA async memcpy,
+        e.g. matrix_upload's streamed transfers)."""
         import jax
         todo = []
         for leaf in jax.tree.leaves(tree):
@@ -351,10 +422,31 @@ class AMG:
                 todo.append(leaf)
         if not todo:
             return
-        placed = jax.device_put([self._cast_leaf(x) for x in todo],
-                                self._ship_device)
-        for src, dev in zip(todo, placed):
-            self._put_cache[id(src)] = (src, dev)
+        if self._ship_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._ship_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="amgx-ship")
+        dev = self._ship_device
+
+        def _ship(leaves=todo):
+            # leaves are numpy on the native host path, so the casts are
+            # host-side regardless of this thread's default device; the
+            # rare no-toolchain fallback can leave jnp-backed leaves
+            # that transfer uncast (full precision) — acceptable for a
+            # path that is already warning-slow
+            return jax.device_put([self._cast_leaf(x) for x in leaves],
+                                  dev)
+
+        fut = self._ship_pool.submit(_ship)
+        for i, src in enumerate(todo):
+            self._put_cache[id(src)] = (src, (_PENDING, fut, i))
+
+    def _resolve_put_cache(self):
+        """Wait for in-flight ship futures and replace placeholders with
+        device arrays."""
+        for key, (src, dev) in list(self._put_cache.items()):
+            if isinstance(dev, tuple) and dev[0] is _PENDING:
+                self._put_cache[key] = (src, dev[1].result()[dev[2]])
 
     def _prefetch_level(self, level: AMGLevel):
         """Ship a finished level's big matrix payloads while the rest of
@@ -387,6 +479,7 @@ class AMG:
             # and coarse-solver payloads) transfer here. amg_precision
             # casting happens host-side before the wire.
             self._prefetch_leaves(data)
+            self._resolve_put_cache()
             self._data_cache = jax.tree.map(
                 lambda leaf: self._put_cache[id(leaf)][1]
                 if hasattr(leaf, "dtype") else leaf, data)

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import weakref
 from typing import Optional
 
 import jax
@@ -26,6 +27,33 @@ import jax.numpy as jnp
 import numpy as np
 
 from .errors import BadParametersError
+
+# device array -> the host numpy original it was created from. Real AmgX
+# matrices always originate on the host (uploads, readers, gallery); the
+# host-CPU setup path (amg_host_setup) reads them back, and on a
+# tunneled accelerator that pull costs ~10 s at 128^3 — retaining the
+# upload-side original makes it free. Weak keys: the mirror dies with
+# the device array.
+_HOST_MIRROR: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _register_host_mirror(dev_arr, np_arr):
+    try:
+        _HOST_MIRROR[dev_arr] = np_arr
+    except TypeError:  # pragma: no cover - non-weakrefable array type
+        pass
+
+
+def host_mirror_asarray(x):
+    """np.asarray(x), served from the retained host original when x was
+    uploaded from host data (no accelerator->host transfer)."""
+    if isinstance(x, np.ndarray):
+        return x
+    try:
+        m = _HOST_MIRROR.get(x)
+    except TypeError:
+        m = None
+    return m if m is not None else np.asarray(x)
 
 
 def lexsort_rc(rows, cols):
@@ -231,9 +259,18 @@ class CsrMatrix:
         if n > 0 and self.nnz > 0 and not self.has_external_diag \
                 and ell == "auto":
             diffs = ci.astype(np.int64) - row_ids
-            offs = np.unique(diffs)
-            k = int(offs.shape[0])
-            if k <= self.DIA_MAX_OFFSETS and \
+            # cheap rejection before the full O(nnz log nnz) unique:
+            # distinct offsets in any subset lower-bound the full count,
+            # so a >32-offset sample proves the matrix is not banded
+            # (coarse AMG operators hit this every level)
+            if diffs.shape[0] > (1 << 17) and \
+                    np.unique(diffs[: 1 << 17]).shape[0] > \
+                    self.DIA_MAX_OFFSETS:
+                offs = None
+            else:
+                offs = np.unique(diffs)
+            k = 0 if offs is None else int(offs.shape[0])
+            if offs is not None and k <= self.DIA_MAX_OFFSETS and \
                     k * n <= self.DIA_FILL_RATIO * max(self.nnz, 1):
                 from .ops.pallas_spmv import LANES, dia_padded_rows
                 out["dia_offsets"] = tuple(int(o) for o in offs)
@@ -531,11 +568,29 @@ class CsrMatrix:
     @staticmethod
     def from_scipy_like(row_offsets, col_indices, values, num_rows, num_cols,
                         block_dims=(1, 1), diag=None) -> "CsrMatrix":
+        def put(x, dtype=None):
+            if x is None:
+                return None
+            dev = jnp.asarray(x) if dtype is None else jnp.asarray(x, dtype)
+            if isinstance(x, np.ndarray) and not isinstance(dev, np.ndarray):
+                try:
+                    on_accel = next(iter(dev.devices())).platform != "cpu"
+                except Exception:
+                    on_accel = False
+                if on_accel:
+                    # mirror a COPY: x may view caller-owned memory
+                    # (e.g. an upload buffer) that the caller reuses
+                    # after upload — the mirror must stay equal to the
+                    # immutable device array. CPU-resident arrays skip
+                    # the mirror (its only consumer is the host-setup
+                    # pull, which is free on CPU).
+                    _register_host_mirror(dev, np.array(x, dev.dtype))
+            return dev
+
         return CsrMatrix(
-            row_offsets=jnp.asarray(row_offsets, jnp.int32),
-            col_indices=jnp.asarray(col_indices, jnp.int32),
-            values=jnp.asarray(values), diag=None if diag is None
-            else jnp.asarray(diag),
+            row_offsets=put(row_offsets, jnp.int32),
+            col_indices=put(col_indices, jnp.int32),
+            values=put(values), diag=put(diag),
             num_rows=int(num_rows), num_cols=int(num_cols),
             block_dimx=block_dims[0], block_dimy=block_dims[1])
 

@@ -175,10 +175,56 @@ def pmis_native(n, row_offsets, col_indices, strong, init=None,
     return cf
 
 
-def d2_interp_native(n, row_offsets, col_indices, values, strong, cf):
+def strength_ahat_native(n, row_offsets, col_indices, values, theta,
+                         max_row_sum):
+    """Native AHAT strength mask; returns strong (nnz,) bool or None
+    when the native library is unavailable."""
+    import numpy as np
+    L = lib()
+    if L is None:
+        return None
+    fn = L.amgx_strength_ahat
+    fn.restype = None
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    ro = np.ascontiguousarray(row_offsets, np.int32)
+    ci = np.ascontiguousarray(col_indices, np.int32)
+    va = np.ascontiguousarray(values, np.float64)
+    strong = np.empty(ci.shape[0], np.uint8)
+    fn(ctypes.c_int32(int(n)), ro.ctypes.data_as(i32p),
+       ci.ctypes.data_as(i32p), va.ctypes.data_as(f64p),
+       ctypes.c_double(float(theta)), ctypes.c_double(float(max_row_sum)),
+       strong.ctypes.data_as(u8p))
+    return strong.view(np.bool_)
+
+
+def l1_diag_native(n, row_offsets, col_indices, values):
+    """Native L1-strengthened Jacobi diagonal; returns (n,) float64 or
+    None when the native library is unavailable."""
+    import numpy as np
+    L = lib()
+    if L is None:
+        return None
+    fn = L.amgx_l1_diag
+    fn.restype = None
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    ro = np.ascontiguousarray(row_offsets, np.int32)
+    ci = np.ascontiguousarray(col_indices, np.int32)
+    va = np.ascontiguousarray(values, np.float64)
+    out = np.empty(int(n), np.float64)
+    fn(ctypes.c_int32(int(n)), ro.ctypes.data_as(i32p),
+       ci.ctypes.data_as(i32p), va.ctypes.data_as(f64p),
+       out.ctypes.data_as(f64p))
+    return out
+
+
+def d2_interp_native(n, row_offsets, col_indices, values, strong, cf,
+                     trunc_factor=1.1, max_elements=-1):
     """Native distance-two ext+i interpolation (the host analog of
-    src/classical/interpolators/distance2.cu). Returns
-    (p_ptr int64 (n+1,), p_col int32, p_val float64) or None."""
+    src/classical/interpolators/distance2.cu) with fused truncation.
+    Returns (p_ptr int64 (n+1,), p_col int32, p_val float64) or None."""
     import numpy as np
     L = lib()
     if L is None:
@@ -200,7 +246,9 @@ def d2_interp_native(n, row_offsets, col_indices, values, strong, cf):
     nnz = build(ctypes.c_int32(int(n)),
                 ro.ctypes.data_as(i32p), ci.ctypes.data_as(i32p),
                 va.ctypes.data_as(f64p), st.ctypes.data_as(u8p),
-                cfm.ctypes.data_as(i32p), ctypes.byref(handle))
+                cfm.ctypes.data_as(i32p),
+                ctypes.c_double(float(trunc_factor)),
+                ctypes.c_int32(int(max_elements)), ctypes.byref(handle))
     if nnz < 0 or not handle:
         return None
     p_ptr = np.empty(int(n) + 1, np.int64)
@@ -209,6 +257,127 @@ def d2_interp_native(n, row_offsets, col_indices, values, strong, cf):
     fetch(handle, p_ptr.ctypes.data_as(i64p),
           p_col.ctypes.data_as(i32p), p_val.ctypes.data_as(f64p))
     return p_ptr, p_col, p_val
+
+
+def rap_native(nc, n, ncp, r_ptr, r_col, r_val, a_ptr, a_col, a_val,
+               p_ptr, p_col, p_val):
+    """Fused native Galerkin triple product C = R@A@P (scalar CSR; the
+    csr_galerkin_product analog). Returns (c_ptr int64 (nc+1,), c_col
+    int32, c_val float64) with sorted columns per row, or None when the
+    native library is unavailable."""
+    import numpy as np
+    L = lib()
+    if L is None:
+        return None
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    build = L.amgx_rap_build
+    build.restype = ctypes.c_longlong
+    fetch = L.amgx_rap_fetch
+    fetch.restype = None
+    rp = np.ascontiguousarray(r_ptr, np.int32)
+    rc = np.ascontiguousarray(r_col, np.int32)
+    rv = np.ascontiguousarray(r_val, np.float64)
+    ap = np.ascontiguousarray(a_ptr, np.int32)
+    ac = np.ascontiguousarray(a_col, np.int32)
+    av = np.ascontiguousarray(a_val, np.float64)
+    pp = np.ascontiguousarray(p_ptr, np.int32)
+    pc = np.ascontiguousarray(p_col, np.int32)
+    pv = np.ascontiguousarray(p_val, np.float64)
+    handle = ctypes.c_void_p()
+    nnz = build(ctypes.c_int32(int(nc)), ctypes.c_int32(int(n)),
+                ctypes.c_int32(int(ncp)),
+                rp.ctypes.data_as(i32p), rc.ctypes.data_as(i32p),
+                rv.ctypes.data_as(f64p),
+                ap.ctypes.data_as(i32p), ac.ctypes.data_as(i32p),
+                av.ctypes.data_as(f64p),
+                pp.ctypes.data_as(i32p), pc.ctypes.data_as(i32p),
+                pv.ctypes.data_as(f64p), ctypes.byref(handle))
+    if nnz < 0 or not handle:
+        return None
+    c_ptr = np.empty(int(nc) + 1, np.int64)
+    c_col = np.empty(int(nnz), np.int32)
+    c_val = np.empty(int(nnz), np.float64)
+    fetch(handle, c_ptr.ctypes.data_as(i64p),
+          c_col.ctypes.data_as(i32p), c_val.ctypes.data_as(f64p))
+    return c_ptr, c_col, c_val
+
+
+def swell_build_native(ro, ci, vals, num_rows, max_k, max_w):
+    """Native SWELL layout build (ops/pallas_swell.py layout contract).
+    Returns (cols4, vals4, c0row, nchunk, w128) with cols4/vals4 shaped
+    (nb, 8, kpad, 128), None when the layout does not pay (the
+    `max_k`/`max_w`/fill-guard budgets mirror build_swell_host), or
+    False when the native library is unavailable."""
+    import numpy as np
+    from ..ops.pallas_swell import BLOCK_ROWS, LANES, SUBS
+    L = lib()
+    vals = np.asarray(vals)
+    if L is None or vals.dtype not in (np.float32, np.float64):
+        return False
+    n = int(num_rows)
+    nb = -(-n // BLOCK_ROWS)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    win = L.amgx_swell_windows
+    win.restype = ctypes.c_int32
+    ro = np.ascontiguousarray(ro, np.int32)
+    ci = np.ascontiguousarray(ci, np.int32)
+    c0row = np.empty(nb, np.int32)
+    nchunk = np.empty(nb, np.int32)
+    kmax = ctypes.c_int32()
+    w128 = win(ctypes.c_int32(n), ro.ctypes.data_as(i32p),
+               ci.ctypes.data_as(i32p), c0row.ctypes.data_as(i32p),
+               nchunk.ctypes.data_as(i32p), ctypes.byref(kmax))
+    kmax = int(kmax.value)
+    if kmax == 0 or kmax > max_k or w128 * 128 > max_w:
+        return None
+    kpad = -(-kmax // 8) * 8              # sublane-aligned slot count
+    slots = nb * SUBS * kpad * LANES
+    if slots > 6 * max(ci.shape[0], 1) and slots > (1 << 20):
+        return None                       # fill guard (see caller)
+    vals = np.ascontiguousarray(vals)
+    if vals.dtype == np.float32:
+        fill, fp = L.amgx_swell_fill_f32, ctypes.POINTER(ctypes.c_float)
+    else:
+        vals = np.ascontiguousarray(vals, np.float64)
+        fill, fp = L.amgx_swell_fill_f64, ctypes.POINTER(ctypes.c_double)
+    fill.restype = None
+    cols4 = np.zeros(slots, np.int32)
+    vals4 = np.zeros(slots, vals.dtype)
+    fill(ctypes.c_int32(n), ctypes.c_int32(kpad),
+         ro.ctypes.data_as(i32p), ci.ctypes.data_as(i32p),
+         vals.ctypes.data_as(fp), c0row.ctypes.data_as(i32p),
+         cols4.ctypes.data_as(i32p), vals4.ctypes.data_as(fp))
+    return (cols4.reshape(nb, SUBS, kpad, LANES),
+            vals4.reshape(nb, SUBS, kpad, LANES), c0row, nchunk, w128)
+
+
+def swell_refill_native(ro, vals, num_rows, kpad):
+    """Values-only SWELL re-scatter; returns (nb, 8, kpad, 128) vals4 or
+    None when the native library is unavailable."""
+    import numpy as np
+    from ..ops.pallas_swell import BLOCK_ROWS, LANES, SUBS
+    L = lib()
+    vals = np.asarray(vals)
+    if L is None or vals.dtype not in (np.float32, np.float64):
+        return None
+    n = int(num_rows)
+    nb = -(-n // BLOCK_ROWS)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    ro = np.ascontiguousarray(ro, np.int32)
+    vals = np.ascontiguousarray(vals)
+    if vals.dtype == np.float32:
+        fn, fp = L.amgx_swell_refill_f32, ctypes.POINTER(ctypes.c_float)
+    else:
+        vals = np.ascontiguousarray(vals, np.float64)
+        fn, fp = L.amgx_swell_refill_f64, ctypes.POINTER(ctypes.c_double)
+    fn.restype = None
+    vals4 = np.zeros(nb * SUBS * kpad * LANES, vals.dtype)
+    fn(ctypes.c_int32(n), ctypes.c_int32(kpad),
+       ro.ctypes.data_as(i32p), vals.ctypes.data_as(fp),
+       vals4.ctypes.data_as(fp))
+    return vals4.reshape(nb, SUBS, kpad, LANES)
 
 
 def spgemm_native(n_a, n_b, a_ptr, a_col, a_val, b_ptr, b_col, b_val):

@@ -18,6 +18,7 @@
 // handle-based build/fetch pair: the output size is data-dependent, so
 // build computes and stashes the CSR, fetch copies it out and frees.
 #include <algorithm>
+#include <cmath>
 #include <cstdint>
 #include <vector>
 
@@ -123,6 +124,62 @@ int amgx_pmis(
     return 0;
 }
 
+// AHAT strength-of-connection mask (strength.py _strong_mask_host
+// semantics; src/classical/strength/strength_base.cu analog):
+//   strong_ij = offdiag & (-a_ij * sgn_i >= theta * rowmax_i) & (> 0)
+// with max_row_sum weakening (rows with |rowsum| > mrs*|diag| lose all
+// connections). Diagonal = FIRST in-row occurrence (padded-duplicate
+// CSR convention). Writes strong[nnz] (uint8).
+void amgx_strength_ahat(
+    int32_t n, const int32_t* ro, const int32_t* ci, const double* vals,
+    double theta, double max_row_sum, uint8_t* strong) {
+    for (int32_t i = 0; i < n; ++i) {
+        double diag = 0.0;
+        bool have_diag = false;
+        double rowsum = 0.0;
+        for (int32_t e = ro[i]; e < ro[i + 1]; ++e) {
+            rowsum += vals[e];
+            if (!have_diag && ci[e] == i) { diag = vals[e]; have_diag = true; }
+        }
+        const double sgn = diag < 0.0 ? -1.0 : 1.0;
+        double rowmax = 0.0;
+        for (int32_t e = ro[i]; e < ro[i + 1]; ++e) {
+            if (ci[e] == i) continue;
+            const double c = -vals[e] * sgn;
+            if (c > rowmax) rowmax = c;
+        }
+        const bool weak_row = max_row_sum < 1.0 &&
+            std::abs(rowsum) > max_row_sum * std::abs(diag);
+        for (int32_t e = ro[i]; e < ro[i + 1]; ++e) {
+            if (ci[e] == i || weak_row) { strong[e] = 0; continue; }
+            const double c = -vals[e] * sgn;
+            strong[e] = (c > 0.0 && c >= theta * rowmax) ? 1 : 0;
+        }
+    }
+}
+
+// L1-strengthened Jacobi diagonal (jacobi_l1_solver.cu semantics;
+// relaxation.py l1_strengthened_diag): d_i + sign(d_i) * sum|offdiag|,
+// sign(0) = 0 so zero diagonals stay inert.
+void amgx_l1_diag(
+    int32_t n, const int32_t* ro, const int32_t* ci, const double* vals,
+    double* out) {
+    for (int32_t i = 0; i < n; ++i) {
+        double diag = 0.0;
+        bool have_diag = false;
+        double l1 = 0.0;
+        for (int32_t e = ro[i]; e < ro[i + 1]; ++e) {
+            if (ci[e] == i) {
+                if (!have_diag) { diag = vals[e]; have_diag = true; }
+            } else {
+                l1 += std::abs(vals[e]);
+            }
+        }
+        const double s = diag > 0.0 ? 1.0 : (diag < 0.0 ? -1.0 : 0.0);
+        out[i] = diag + s * l1;
+    }
+}
+
 struct D2Result {
     std::vector<int64_t> ptr;
     std::vector<int32_t> col;
@@ -130,11 +187,16 @@ struct D2Result {
 };
 
 // Distance-two ext+i interpolation. Inputs: scalar CSR (diagonal stored
-// in-line), per-entry strength mask, cf map in {0,1}. Returns P's nnz
-// and a handle for amgx_d2_fetch; returns -1 on failure.
+// in-line), per-entry strength mask, cf map in {0,1}. Truncation
+// (trunc_factor <= 1.0 and/or max_elements > 0; truncate.cu semantics —
+// keep the max_elements largest |w| per row, drop entries below
+// trunc_factor * rowmax, rescale survivors to preserve the row sum) is
+// fused into the per-row emit so the untruncated P never materializes.
+// Returns P's nnz and a handle for amgx_d2_fetch; -1 on failure.
 long long amgx_d2_build(
     int32_t n, const int32_t* ro, const int32_t* ci, const double* vals,
-    const uint8_t* strong, const int32_t* cf, void** out_handle) {
+    const uint8_t* strong, const int32_t* cf, double trunc_factor,
+    int32_t max_elements, void** out_handle) {
     *out_handle = nullptr;
     std::vector<double> diag(static_cast<size_t>(n), 0.0);
     std::vector<double> sgn(static_cast<size_t>(n), 1.0);
@@ -161,6 +223,9 @@ long long amgx_d2_build(
     std::vector<double> acc(static_cast<size_t>(n), 0.0);
     std::vector<int32_t> touched;
     touched.reserve(64);
+    std::vector<double> row_w;             // fused-truncation scratch
+    std::vector<uint8_t> row_keep;
+    std::vector<size_t> row_rank;
 
     for (int32_t i = 0; i < n; ++i) {
         res->ptr[static_cast<size_t>(i)] =
@@ -242,9 +307,50 @@ long long amgx_d2_build(
         }
         std::sort(touched.begin(), touched.end());
         const double dsafe = D == 0.0 ? 1.0 : D;
+        const bool truncate = trunc_factor <= 1.0 || max_elements > 0;
+        if (!truncate) {
+            for (const int32_t j : touched) {
+                res->col.push_back(cidx[static_cast<size_t>(j)]);
+                res->val.push_back(-acc[static_cast<size_t>(j)] / dsafe);
+            }
+            continue;
+        }
+        // fused truncation (matches _truncate_host: stable top-k by
+        // descending |w| with earlier-column tie wins, trunc_factor
+        // drop, row-sum-preserving rescale; sums in column order)
+        row_w.clear();
+        double rowsum = 0.0, wmax = 0.0;
         for (const int32_t j : touched) {
-            res->col.push_back(cidx[static_cast<size_t>(j)]);
-            res->val.push_back(-acc[static_cast<size_t>(j)] / dsafe);
+            const double w = -acc[static_cast<size_t>(j)] / dsafe;
+            row_w.push_back(w);
+            rowsum += w;
+            if (std::abs(w) > wmax) wmax = std::abs(w);
+        }
+        const size_t m = row_w.size();
+        row_keep.assign(m, 1);
+        if (trunc_factor <= 1.0)
+            for (size_t t = 0; t < m; ++t)
+                if (std::abs(row_w[t]) < trunc_factor * wmax)
+                    row_keep[t] = 0;
+        if (max_elements > 0 && m > static_cast<size_t>(max_elements)) {
+            row_rank.resize(m);
+            for (size_t t = 0; t < m; ++t) row_rank[t] = t;
+            std::stable_sort(row_rank.begin(), row_rank.end(),
+                             [&](size_t a, size_t b) {
+                                 return std::abs(row_w[a]) >
+                                        std::abs(row_w[b]);
+                             });
+            for (size_t r = static_cast<size_t>(max_elements); r < m; ++r)
+                row_keep[row_rank[r]] = 0;
+        }
+        double keptsum = 0.0;
+        for (size_t t = 0; t < m; ++t)
+            if (row_keep[t]) keptsum += row_w[t];
+        const double scale = keptsum == 0.0 ? 1.0 : rowsum / keptsum;
+        for (size_t t = 0; t < m; ++t) {
+            if (!row_keep[t]) continue;
+            res->col.push_back(cidx[static_cast<size_t>(touched[t])]);
+            res->val.push_back(row_w[t] * scale);
         }
     }
     res->ptr[static_cast<size_t>(n)] = static_cast<int64_t>(res->col.size());

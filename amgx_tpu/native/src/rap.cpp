@@ -1,0 +1,103 @@
+// Fused host Galerkin triple product C = R * A * P (scalar CSR).
+//
+// The csr_galerkin_product analog (include/csr_multiply.h:96,
+// src/csr_multiply_detail.cu) for the host-setup path: the reference
+// fuses RAP on the GPU with hash tables; here one Gustavson sweep per
+// coarse row chains both products through dense stamp accumulators, so
+// the R*A intermediate never materializes (and never crosses the
+// Python boundary, which is what made two spgemm calls slow).
+//
+// Handle-based build/fetch like amgx_d2_*: output nnz is data-dependent.
+#include <algorithm>
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+struct RapResult {
+    std::vector<int64_t> ptr;
+    std::vector<int32_t> col;
+    std::vector<double> val;
+};
+
+}  // namespace
+
+extern "C" {
+
+// R: nc x n, A: n x n, P: n x ncp. Columns of each output row emitted
+// sorted. Returns C's nnz and a handle for amgx_rap_fetch, -1 on error.
+long long amgx_rap_build(
+    int32_t nc, int32_t n, int32_t ncp,
+    const int32_t* r_ptr, const int32_t* r_col, const double* r_val,
+    const int32_t* a_ptr, const int32_t* a_col, const double* a_val,
+    const int32_t* p_ptr, const int32_t* p_col, const double* p_val,
+    void** out_handle) {
+    *out_handle = nullptr;
+    auto* res = new RapResult();
+    res->ptr.assign(static_cast<size_t>(nc) + 1, 0);
+    std::vector<int32_t> stamp_a(static_cast<size_t>(n), -1);
+    std::vector<double> acc_a(static_cast<size_t>(n), 0.0);
+    std::vector<int32_t> touched_a;
+    touched_a.reserve(256);
+    std::vector<int32_t> stamp_c(static_cast<size_t>(ncp), -1);
+    std::vector<double> acc_c(static_cast<size_t>(ncp), 0.0);
+    std::vector<int32_t> touched_c;
+    touched_c.reserve(256);
+
+    auto fail = [&]() -> long long { delete res; return -1; };
+    for (int32_t i = 0; i < nc; ++i) {
+        res->ptr[static_cast<size_t>(i)] =
+            static_cast<int64_t>(res->col.size());
+        touched_a.clear();
+        for (int32_t e = r_ptr[i]; e < r_ptr[i + 1]; ++e) {
+            const int32_t k = r_col[e];
+            if (k < 0 || k >= n) return fail();
+            const double rv = r_val[e];
+            for (int32_t f = a_ptr[k]; f < a_ptr[k + 1]; ++f) {
+                const int32_t m = a_col[f];
+                if (m < 0 || m >= n) return fail();
+                if (stamp_a[static_cast<size_t>(m)] != i) {
+                    stamp_a[static_cast<size_t>(m)] = i;
+                    acc_a[static_cast<size_t>(m)] = 0.0;
+                    touched_a.push_back(m);
+                }
+                acc_a[static_cast<size_t>(m)] += rv * a_val[f];
+            }
+        }
+        touched_c.clear();
+        for (const int32_t m : touched_a) {
+            const double ra = acc_a[static_cast<size_t>(m)];
+            for (int32_t f = p_ptr[m]; f < p_ptr[m + 1]; ++f) {
+                const int32_t j = p_col[f];
+                if (j < 0 || j >= ncp) return fail();
+                if (stamp_c[static_cast<size_t>(j)] != i) {
+                    stamp_c[static_cast<size_t>(j)] = i;
+                    acc_c[static_cast<size_t>(j)] = 0.0;
+                    touched_c.push_back(j);
+                }
+                acc_c[static_cast<size_t>(j)] += ra * p_val[f];
+            }
+        }
+        std::sort(touched_c.begin(), touched_c.end());
+        for (const int32_t j : touched_c) {
+            res->col.push_back(j);
+            res->val.push_back(acc_c[static_cast<size_t>(j)]);
+        }
+    }
+    res->ptr[static_cast<size_t>(nc)] =
+        static_cast<int64_t>(res->col.size());
+    *out_handle = res;
+    return static_cast<long long>(res->col.size());
+}
+
+void amgx_rap_fetch(void* handle, int64_t* ptr, int32_t* col, double* val) {
+    auto* res = static_cast<RapResult*>(handle);
+    std::copy(res->ptr.begin(), res->ptr.end(), ptr);
+    std::copy(res->col.begin(), res->col.end(), col);
+    std::copy(res->val.begin(), res->val.end(), val);
+    delete res;
+}
+
+void amgx_rap_free(void* handle) { delete static_cast<RapResult*>(handle); }
+
+}  // extern "C"

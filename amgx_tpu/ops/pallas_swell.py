@@ -60,6 +60,11 @@ def build_swell_host(ro, ci, vals, num_rows, num_cols):
     n = int(num_rows)
     if n == 0 or ci.shape[0] == 0:
         return None
+    from .. import native
+    out = native.swell_build_native(ro, ci, vals, n, SWELL_MAX_K,
+                                    SWELL_MAX_W)
+    if out is not False:                  # None = layout doesn't pay
+        return out
     nb = -(-n // BLOCK_ROWS)
     row_nnz = np.diff(ro)
     kmax = int(row_nnz.max())
@@ -116,6 +121,10 @@ def swell_vals_host(ro, vals, num_rows, kpad):
     """Re-scatter new coefficients into an existing SWELL layout
     (replace_coefficients with structure reuse)."""
     n = int(num_rows)
+    from .. import native
+    out = native.swell_refill_native(ro, vals, n, int(kpad))
+    if out is not None:
+        return out
     nb = -(-n // BLOCK_ROWS)
     row_nnz = np.diff(ro)
     row_ids = np.repeat(np.arange(n, dtype=np.int64), row_nnz)

@@ -134,5 +134,30 @@ def csr_add(A: CsrMatrix, B: CsrMatrix) -> CsrMatrix:
 
 def galerkin_rap(R: CsrMatrix, A: CsrMatrix, P: CsrMatrix) -> CsrMatrix:
     """Coarse operator A_c = R @ A @ P (csr_galerkin_product analog,
-    include/csr_multiply.h:96)."""
+    include/csr_multiply.h:96).
+
+    Host path: ONE fused native sweep (native/src/rap.cpp) — the R*A
+    intermediate never materializes or crosses the Python boundary, and
+    the result stays numpy-backed so the rest of the host hierarchy
+    build (amg_host_setup) never round-trips through XLA:CPU arrays."""
+    import numpy as np
+    if not (A.is_block or R.has_external_diag or A.has_external_diag
+            or P.has_external_diag) and _on_host(A) and _on_host(R) \
+            and _on_host(P) and np.asarray(A.values).dtype.kind == "f" \
+            and np.asarray(P.values).dtype.kind == "f":
+        from .. import native
+        out = native.rap_native(
+            R.num_rows, A.num_rows, P.num_cols,
+            np.asarray(R.row_offsets), np.asarray(R.col_indices),
+            np.asarray(R.values),
+            np.asarray(A.row_offsets), np.asarray(A.col_indices),
+            np.asarray(A.values),
+            np.asarray(P.row_offsets), np.asarray(P.col_indices),
+            np.asarray(P.values))
+        if out is not None:
+            cp, cc, cv = out
+            return CsrMatrix(
+                row_offsets=cp.astype(np.int32), col_indices=cc,
+                values=cv.astype(np.asarray(A.values).dtype, copy=False),
+                num_rows=R.num_rows, num_cols=P.num_cols)
     return csr_multiply(csr_multiply(R, A), P)

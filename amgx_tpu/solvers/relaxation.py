@@ -18,9 +18,13 @@ from .base import Solver
 
 
 def safe_recip(d):
-    """Elementwise 1/d with 0 -> 0 (zero-in-diagonal robustness)."""
-    safe = jnp.where(d == 0, 1.0, d)
-    return jnp.where(d == 0, 0.0, 1.0 / safe)
+    """Elementwise 1/d with 0 -> 0 (zero-in-diagonal robustness).
+    Numpy in, numpy out: the host-setup path keeps smoother payloads
+    numpy-backed so the hierarchy ship stays one packed transfer."""
+    import numpy as np
+    xp = np if isinstance(d, np.ndarray) else jnp
+    safe = xp.where(d == 0, 1.0, d)
+    return xp.where(d == 0, 0.0, 1.0 / safe)
 
 
 def _invert_diag(A):
@@ -50,6 +54,12 @@ def l1_strengthened_diag(A):
         ro = np.asarray(A.row_offsets)
         cols = np.asarray(A.col_indices)
         vals = np.asarray(A.values)
+        if not A.has_external_diag and vals.dtype.kind == "f":
+            # one native C++ sweep (per-level smoother-setup hot path)
+            from .. import native
+            out = native.l1_diag_native(n, ro, cols, vals)
+            if out is not None:
+                return out.astype(vals.dtype, copy=False)
         rows = np.repeat(np.arange(n, dtype=np.int32), np.diff(ro))
         l1 = np.bincount(rows, weights=np.where(rows != cols,
                                                 np.abs(vals), 0.0),

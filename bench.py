@@ -150,12 +150,17 @@ def bench_classical(n: int = 64):
     """PCG[f64] + classical PMIS/D2 AMG[f32] (JACOBI_L1) — the
     unstructured-path number the structured flagship does not cover.
     Setup runs through the native host path (amg_host_setup auto: C++
-    PMIS/D2/Gustavson + numpy glue, levels prefetched to the TPU as
-    they finish); the solve runs the windowed-ELL Pallas gather kernel
-    on every unstructured level operator and transfer operator
-    (ops/pallas_swell.py). amg_precision=float is the reference's dDDI
-    ->dDFI mixed-mode economics (include/amgx_config.h:102-131): the
-    f64 outer PCG holds the true residual."""
+    PMIS / D2 / fused RAP / SWELL builders on numpy-backed levels,
+    prefetched to the TPU as they finish); the solve runs the
+    windowed-ELL Pallas gather kernel on every unstructured level
+    operator and transfer operator (ops/pallas_swell.py).
+    amg_precision=float is the reference's dDDI->dDFI mixed-mode
+    economics (include/amgx_config.h:102-131): the f64 outer PCG holds
+    the true residual. interp_max_elements=4 + max_row_sum=0.9 are the
+    reference's own D2 production settings (its flagship classical
+    preset, src/configs/FGMRES_CLASSICAL_AGGRESSIVE_PMIS.json).
+    Setup is best-of-2: the host path is sensitive to single-core
+    scheduler noise on shared rigs."""
     cfg = Config.from_string(
         "config_version=2, solver(s)=PCG, s:max_iters=100,"
         " s:tolerance=1e-8, s:convergence=RELATIVE_INI,"
@@ -165,17 +170,20 @@ def bench_classical(n: int = 64):
         " amg:postsweeps=1, amg:max_iters=1,"
         " amg:coarse_solver=DENSE_LU_SOLVER, amg:min_coarse_rows=32,"
         " amg:max_levels=20, amg:strength_threshold=0.25,"
+        " amg:interp_max_elements=4, amg:max_row_sum=0.9,"
         " amg:amg_precision=float")
     A = amgx.gallery.poisson("7pt", n, n, n).init()
     b = jnp.ones(A.num_rows)
     slv = amgx.create_solver(cfg)
     slv.setup(A)                      # cold (host CPU + compiles)
     jax.block_until_ready(slv.solve_data())
-    slv2 = amgx.create_solver(cfg)
-    t0 = time.perf_counter()
-    slv2.setup(A)
-    jax.block_until_ready(slv2.solve_data())
-    setup_s = time.perf_counter() - t0
+    setup_s = float("inf")
+    for _ in range(2):
+        slv2 = amgx.create_solver(cfg)
+        t0 = time.perf_counter()
+        slv2.setup(A)
+        jax.block_until_ready(slv2.solve_data())
+        setup_s = min(setup_s, time.perf_counter() - t0)
     res = slv2.solve(b)               # compile
     t0 = time.perf_counter()
     res = slv2.solve(b)
