@@ -304,14 +304,14 @@ def rap_native(nc, n, ncp, r_ptr, r_col, r_val, a_ptr, a_col, a_val,
     return c_ptr, c_col, c_val
 
 
-def swell_build_native(ro, ci, vals, num_rows, max_k, max_w):
+def swell_build_native(ro, ci, vals, num_rows):
     """Native SWELL layout build (ops/pallas_swell.py layout contract).
     Returns (cols4, vals4, c0row, nchunk, w128) with cols4/vals4 shaped
-    (nb, 8, kpad, 128), None when the layout does not pay (the
-    `max_k`/`max_w`/fill-guard budgets mirror build_swell_host), or
-    False when the native library is unavailable."""
+    (nb, 8, kpad, 128), None when the layout does not pay (budget
+    decisions delegated to ops/pallas_swell.swell_budget), or False
+    when the native library is unavailable."""
     import numpy as np
-    from ..ops.pallas_swell import BLOCK_ROWS, LANES, SUBS
+    from ..ops.pallas_swell import BLOCK_ROWS, LANES, SUBS, swell_budget
     L = lib()
     vals = np.asarray(vals)
     if L is None or vals.dtype not in (np.float32, np.float64):
@@ -326,16 +326,15 @@ def swell_build_native(ro, ci, vals, num_rows, max_k, max_w):
     c0row = np.empty(nb, np.int32)
     nchunk = np.empty(nb, np.int32)
     kmax = ctypes.c_int32()
-    w128 = win(ctypes.c_int32(n), ro.ctypes.data_as(i32p),
-               ci.ctypes.data_as(i32p), c0row.ctypes.data_as(i32p),
-               nchunk.ctypes.data_as(i32p), ctypes.byref(kmax))
-    kmax = int(kmax.value)
-    if kmax == 0 or kmax > max_k or w128 * 128 > max_w:
+    w128_raw = win(ctypes.c_int32(n), ro.ctypes.data_as(i32p),
+                   ci.ctypes.data_as(i32p), c0row.ctypes.data_as(i32p),
+                   nchunk.ctypes.data_as(i32p), ctypes.byref(kmax))
+    # budget decisions live in ONE place (ops/pallas_swell.swell_budget)
+    budget = swell_budget(int(kmax.value), w128_raw, nb, ci.shape[0])
+    if budget is None:
         return None
-    kpad = -(-kmax // 8) * 8              # sublane-aligned slot count
+    kpad, w128 = budget
     slots = nb * SUBS * kpad * LANES
-    if slots > 6 * max(ci.shape[0], 1) and slots > (1 << 20):
-        return None                       # fill guard (see caller)
     vals = np.ascontiguousarray(vals)
     if vals.dtype == np.float32:
         fill, fp = L.amgx_swell_fill_f32, ctypes.POINTER(ctypes.c_float)

@@ -43,9 +43,37 @@ from jax.experimental.pallas import tpu as pltpu
 LANES = 128
 SUBS = 8                      # sublane groups per super-block
 BLOCK_ROWS = SUBS * LANES     # rows per super-block
-SWELL_MAX_W = 64 * 1024       # max window elements (256 KB f32 a buffer)
+SWELL_MAX_W = 512 * 1024      # max window elements (2 MB f32 a buffer)
 SWELL_MAX_K = 256             # max padded slots per row
 _VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def swell_budget(kmax, w128_raw, nb, nnz):
+    """Single source of the SWELL layout-budget decisions, shared by the
+    numpy builder below and the native-wrapper path
+    (native/__init__.py swell_build_native) — the two drifted once and
+    an un-rounded w128 lets the kernel's slab loop read past the VMEM
+    window. Returns (kpad, w128) or None when the layout does not pay:
+    - kpad: exact for short rows (interpolation operators, kmax 4-5,
+      where round-to-8 inflated HBM and wire bytes ~2x), 8-aligned
+      above (Mosaic relayouts large unaligned slot dims through
+      scoped-VMEM copies);
+    - w128: rounded to whole 8-chunk slabs (kernel slab loop + aligned
+      VMEM scratch);
+    - fill guard: one long row would otherwise inflate the padded
+      layout to n*kpad slots; small layouts are exempt (round-to-8
+      alone inflates tiny matrices past any ratio, and a <1M-slot
+      layout cannot blow memory)."""
+    if kmax == 0 or kmax > SWELL_MAX_K:
+        return None
+    w128 = -(-int(w128_raw) // 8) * 8
+    if w128 * LANES > SWELL_MAX_W:
+        return None
+    kpad = kmax if kmax <= 24 else -(-kmax // 8) * 8
+    slots = nb * SUBS * kpad * LANES
+    if slots > 6 * max(nnz, 1) and slots > (1 << 20):
+        return None
+    return kpad, w128
 
 
 def build_swell_host(ro, ci, vals, num_rows, num_cols):
@@ -61,23 +89,14 @@ def build_swell_host(ro, ci, vals, num_rows, num_cols):
     if n == 0 or ci.shape[0] == 0:
         return None
     from .. import native
-    out = native.swell_build_native(ro, ci, vals, n, SWELL_MAX_K,
-                                    SWELL_MAX_W)
+    out = native.swell_build_native(ro, ci, vals, n)
     if out is not False:                  # None = layout doesn't pay
         return out
     nb = -(-n // BLOCK_ROWS)
     row_nnz = np.diff(ro)
     kmax = int(row_nnz.max())
     if kmax == 0 or kmax > SWELL_MAX_K:
-        return None
-    kpad = -(-kmax // 8) * 8
-    # fill guard (the ELL path's ell_max_ratio analog): one long row
-    # would otherwise inflate the padded layout to n*kpad slots. Small
-    # layouts are exempt — kpad's round-to-8 alone inflates tiny
-    # matrices past any ratio, and a <1M-slot layout cannot blow memory.
-    slots = nb * SUBS * kpad * LANES
-    if slots > 6 * max(ci.shape[0], 1) and slots > (1 << 20):
-        return None
+        return None                        # cheap reject before the scan
     # per-row col extents -> per-super-block window
     starts = ro[:-1].astype(np.int64)
     nonempty = ro[1:] > ro[:-1]
@@ -96,9 +115,12 @@ def build_swell_host(ro, ci, vals, num_rows, num_cols):
     bmax = np.where(empty_b, 0, bmax)
     c0 = (bmin // LANES) * LANES
     span = bmax - c0 + 1
-    w = int(-(-int(span.max()) // LANES) * LANES)
-    if w > SWELL_MAX_W:
+    budget = swell_budget(kmax, -(-int(span.max()) // LANES), nb,
+                          ci.shape[0])
+    if budget is None:
         return None
+    kpad, _w128 = budget
+    w = _w128 * LANES
     nchunk = (-(-span // LANES)).astype(np.int32)
     # scatter entries into (nb, 8, kpad, 128) slot-major blocks
     row_ids = np.repeat(np.arange(n, dtype=np.int64), row_nnz)
@@ -183,16 +205,25 @@ def _swell_kernel(w128, kpad, n_blocks):
         hi = jax.lax.shift_right_logical(cols, jnp.int32(7))
         lo = jax.lax.bitwise_and(cols, jnp.int32(LANES - 1))
 
-        def chunk_step(c, acc):
-            chunk = xbuf[slot, pl.ds(c, 1)]       # (1, 128)
-            src = jnp.broadcast_to(chunk, (rows, LANES))
-            # keep the gather's index math int32 (Mosaic has no i64;
-            # the package-level x64 default would promote)
-            with jax.enable_x64(False):
-                g = jnp.take_along_axis(src, lo, axis=1)
-            return jnp.where(hi == c, g, acc)
+        def slab_step(s, acc):
+            # 8 window chunks per loop iteration: the fori overhead was
+            # a measured ~40% of kernel time on wide-window operators
+            # (AMG restriction matrices reach nchunk ~500); w128 is
+            # 8-aligned by the builders so the last slab stays in range
+            base = s * jnp.int32(8)
+            for j in range(8):
+                c = base + jnp.int32(j)
+                chunk = xbuf[slot, pl.ds(c, 1)]   # (1, 128)
+                src = jnp.broadcast_to(chunk, (rows, LANES))
+                # keep the gather's index math int32 (Mosaic has no
+                # i64; the package-level x64 default would promote)
+                with jax.enable_x64(False):
+                    g = jnp.take_along_axis(src, lo, axis=1)
+                acc = jnp.where(hi == c, g, acc)
+            return acc
 
-        acc = jax.lax.fori_loop(jnp.int32(0), nch_ref[b], chunk_step,
+        nslab = jax.lax.div(nch_ref[b] + jnp.int32(7), jnp.int32(8))
+        acc = jax.lax.fori_loop(jnp.int32(0), nslab, slab_step,
                                 jnp.zeros((rows, LANES), jnp.float32))
         y_ref[...] = jnp.sum(
             (acc * vals).reshape(SUBS, kpad, LANES), axis=1)

@@ -65,7 +65,9 @@ def l1_strengthened_diag(A):
                                                 np.abs(vals), 0.0),
                          minlength=n).astype(vals.dtype)
         d = np.asarray(A.diagonal())
-        return jnp.asarray(d + np.sign(d) * l1)
+        # numpy out (both branches): the host-setup ship casts numpy
+        # leaves host-side before the wire
+        return d + np.sign(d) * l1
     rows, cols, vals = A.coo()
     offdiag = jnp.where(rows != cols, jnp.abs(vals), 0.0)
     l1 = jax.ops.segment_sum(offdiag, rows, num_segments=A.num_rows,
