@@ -1126,6 +1126,97 @@ def AMGX_read_system_global(rsrc_h, mode: str, filename: str,
 
 
 @_api
+@_outputs(1)
+def AMGX_read_system_maps_one_ring(rsrc_h, mode: str, filename: str,
+                                   allocated_halo_depth=1,
+                                   num_partitions=None,
+                                   partition_sizes=None,
+                                   partition_vector=None):
+    """include/amgx_c.h:452 — read + partition a system and return each
+    rank's piece in ONE-RING LOCAL numbering (owned columns first, then
+    halo columns in sorted-global order) together with the B2L comm
+    maps (neighbors, send/recv index maps). The reference returns the
+    calling rank's piece via out-pointers; the single-controller analog
+    returns rc plus a list of per-rank dicts with keys n, nnz,
+    block_dimx, block_dimy, row_ptrs, col_indices (local one-ring),
+    data, diag_data, rhs, sol, neighbors, send_sizes, send_maps,
+    recv_sizes, recv_maps."""
+    rc, pieces = AMGX_read_system_global(
+        rsrc_h, mode, filename, allocated_halo_depth, num_partitions,
+        partition_sizes, partition_vector)
+    if rc != RC.OK:
+        return rc, None
+    offsets = np.asarray(pieces[0]["partition_offsets"], np.int64)
+    R = len(pieces)
+    halo_lists = []
+    for r, p in enumerate(pieces):
+        lo, hi = offsets[r], offsets[r + 1]
+        cg = np.asarray(p["col_indices_global"], np.int64)
+        halo_lists.append(np.unique(cg[(cg < lo) | (cg >= hi)]))
+    out = []
+    for r, p in enumerate(pieces):
+        lo, hi = offsets[r], offsets[r + 1]
+        n_r = int(hi - lo)
+        cg = np.asarray(p["col_indices_global"], np.int64)
+        hl = halo_lists[r]
+        owned = (cg >= lo) & (cg < hi)
+        local = np.where(owned, cg - lo,
+                         n_r + np.searchsorted(hl, cg)).astype(np.int32)
+        h_owner = np.searchsorted(offsets, hl, side="right") - 1
+        # neighbors = union of recv-side owners and ranks whose halo
+        # lists reference MY rows (on a pattern-asymmetric matrix a
+        # rank can be send-only toward a peer it receives nothing from)
+        send_only = [q for q in range(R) if q != r and np.any(
+            (halo_lists[q] >= lo) & (halo_lists[q] < hi))]
+        neighbors = np.unique(np.concatenate(
+            [h_owner, np.asarray(send_only, np.int64)])).astype(np.int32)
+        recv_maps = [
+            (n_r + np.nonzero(h_owner == nb)[0]).astype(np.int32)
+            for nb in neighbors]
+        # send maps by symmetry: what each neighbor's halo list wants
+        # from my owned range (the B2L maps of
+        # distributed_arranger.h:28-117)
+        send_maps = [
+            (halo_lists[nb][(halo_lists[nb] >= lo)
+                            & (halo_lists[nb] < hi)]
+             - lo).astype(np.int32)
+            for nb in neighbors]
+        out.append({
+            "n": n_r, "nnz": int(p["nnz"]), "block_dimx": 1,
+            "block_dimy": 1, "row_ptrs": p["row_ptrs"],
+            "col_indices": local, "data": p["data"],
+            "diag_data": p["diag"], "rhs": p["rhs"], "sol": p["sol"],
+            "num_neighbors": int(neighbors.shape[0]),
+            "neighbors": neighbors,
+            "send_sizes": np.asarray([m.shape[0] for m in send_maps],
+                                     np.int32),
+            "send_maps": send_maps,
+            "recv_sizes": np.asarray([m.shape[0] for m in recv_maps],
+                                     np.int32),
+            "recv_maps": recv_maps,
+        })
+    return RC.OK, out
+
+
+@_api
+def AMGX_free_system_maps_one_ring(*_args):
+    """include/amgx_c.h:478 — frees the buffers returned by
+    AMGX_read_system_maps_one_ring. The Python analog's buffers are
+    garbage-collected; provided for call-site parity."""
+    return RC.OK
+
+
+@_api
+def AMGX_solver_register_print_callback(callback):
+    """include/amgx_c.h:600 (deprecated tail) — per-solver print
+    callback registration; the reference's implementation routes to the
+    global callback, as does this analog."""
+    from .output import register_print_callback
+    register_print_callback(callback)
+    return RC.OK
+
+
+@_api
 def AMGX_matrix_comm_from_maps_one_ring(mtx_h, allocated_halo_depth,
                                         num_neighbors, neighbors,
                                         send_sizes, send_maps,

@@ -833,11 +833,30 @@ _SHARDED_SELECTORS = {"SIZE_2": 1, "PARALLEL_GREEDY": 1, "SIZE_4": 2,
 def sharded_eligible(amg, A) -> Optional[str]:
     """None if the sharded setup supports this AMG config; else the
     reason string (callers fall back to the global-setup path)."""
-    if amg.algorithm != "AGGREGATION":
-        return "classical/energymin algorithms use the global setup"
-    sel = str(amg.cfg.get("selector", amg.scope)).upper()
-    if sel not in _SHARDED_SELECTORS:
-        return f"selector {sel} not sharded (geo/dummy use global setup)"
+    if amg.algorithm == "CLASSICAL":
+        # sharded classical (setup_classical.py): PMIS + D1 + AHAT only
+        sel = str(amg.cfg.get("selector", amg.scope)).upper()
+        if sel != "PMIS":
+            return f"classical selector {sel} not sharded (PMIS only)"
+        interp = str(amg.cfg.get("interpolator", amg.scope)).upper()
+        if interp != "D1":
+            return (f"classical interpolator {interp} not sharded "
+                    "(D1 only)")
+        if str(amg.cfg.get("strength", amg.scope)).upper() != "AHAT":
+            return "classical strength != AHAT not sharded"
+        if int(amg.cfg.get("aggressive_levels", amg.scope)) > 0:
+            return "aggressive coarsening uses the global setup"
+        if (int(amg.cfg.get("interp_max_elements", amg.scope)) > 0
+                or float(amg.cfg.get("interp_truncation_factor",
+                                     amg.scope)) <= 1.0):
+            return "interpolation truncation uses the global setup"
+    elif amg.algorithm != "AGGREGATION":
+        return "energymin algorithms use the global setup"
+    else:
+        sel = str(amg.cfg.get("selector", amg.scope)).upper()
+        if sel not in _SHARDED_SELECTORS:
+            return (f"selector {sel} not sharded (geo/dummy use global "
+                    "setup)")
     if A.is_block:
         return "block systems use the global setup"
     if amg.cycle_name in ("CG", "CGF"):
@@ -936,12 +955,29 @@ def build_sharded_hierarchy(amg, shard_A: ShardMatrix, mesh, axis: str):
     formula = int(cfg.get("weight_formula", scope))
     n_local0 = shard_A.n_local
     n_g0 = shard_A.n_global
+    # consolidation boundary: by default a coarse level consolidates to
+    # the replicated tail when its global size fits one shard's initial
+    # budget; matrix_consolidation_lower_threshold (the reference's
+    # consolidation knob) overrides it so deeper levels stay sharded
+    thr = int(cfg.get("matrix_consolidation_lower_threshold", scope))
+    consolidate_at = thr if thr > 0 else n_local0
     offsets = np.minimum(np.arange(R + 1) * n_local0, n_g0
                          ).astype(np.int32)
     M = shard_A
     levels, levels_data, ncl_last = [], [], None
     offsets_last = None
     lvl = 0
+    if amg.algorithm == "CLASSICAL":
+        from .setup_classical import run_classical_levels
+        res = run_classical_levels(amg, mesh, axis, M, offsets, R,
+                                   consolidate_at)
+        if res is None:
+            return None
+        (levels, levels_data, M, offsets, lvl, offsets_last,
+         ncl_last) = res
+        return _finish_sharded(amg, mesh, axis, M, offsets, lvl,
+                               levels, levels_data, offsets_last,
+                               ncl_last, R)
     sel = str(cfg.get("selector", scope)).upper()
     passes = _SHARDED_SELECTORS.get(sel, 1)
     if sel == "MULTI_PAIRWISE":
@@ -995,8 +1031,8 @@ def build_sharded_hierarchy(amg, shard_A: ShardMatrix, mesh, axis: str):
                 or n < amg.min_fine_rows
                 or (n <= amg.dense_lu_num_rows and lvl > 0)):
             break
-        if lvl > 0 and n <= n_local0:
-            break      # tail fits one shard's budget: consolidate
+        if lvl > 0 and n <= consolidate_at:
+            break      # tail fits the consolidation budget
         # -- pass 1: matching on this level's matrix --------------------
         agg, paired, w, countsA = runA(M, offsets, False)
         ca = np.asarray(countsA)
@@ -1118,6 +1154,18 @@ def build_sharded_hierarchy(amg, shard_A: ShardMatrix, mesh, axis: str):
         lvl += 1
     if not levels:
         return None
+    return _finish_sharded(amg, mesh, axis, M, offsets, lvl, levels,
+                           levels_data, offsets_last, ncl_last, R)
+
+
+def _finish_sharded(amg, mesh, axis, M, offsets, lvl, levels,
+                    levels_data, offsets_last, ncl_last, R):
+    """Shared tail of the sharded build (aggregation and classical):
+    gather + compact the consolidation-boundary level, build the
+    replicated tail with the existing global setup, attach smoothers."""
+    from ..solvers.base import make_solver
+    from .amg import _replicate
+    cfg, scope = amg.cfg, amg.scope
     # ---- replicated tail: gather + compact + existing global setup ----
     A_tail = _gather_compact(M, offsets).init()
     amg.levels = list(levels)

@@ -1,0 +1,539 @@
+"""Sharded (per-rank) classical AMG setup: PMIS + D1 + distributed RAP.
+
+The classical analog of distributed/setup.py's aggregation build — the
+reference's per-rank classical level construction
+(src/classical/classical_amg_level.cu:254-341: strength + CF-splitting
+on the rank-local matrix, distributed Galerkin RAP over exchanged halo
+rows of P, one-ring renumbering via
+src/distributed/distributed_manager.cu `createOneRingHaloRows`). TPU
+redesign on the same primitives the aggregation setup uses:
+
+- strength (AHAT) and the D1 interpolation formula are row-local under
+  the row-wise partition: every owned row's entries are shard-resident,
+  so both compute with zero communication beyond per-vertex halo state
+  (diag sign, row threshold, CF state, coarse ids);
+- reverse-edge strength (the PMIS graph is symmetrized) is computed
+  locally from exchanged per-vertex thresholds under the module's
+  value-symmetry assumption (|a_ji| = |a_ij|, setup.py module docs);
+- PMIS is the same synchronous fixed point as the single-device
+  selector (amg/classical/selectors.py pmis_split) with semantic-id
+  hashes, so the CF split is bit-identical to the single-device path;
+- the Galerkin triple product replaces the reference's halo-row
+  exchange with triple routing: every fine entry a_kl expands against
+  the P rows of k and l into (CI, CJ, P[k,CI] * a_kl * P[l,CJ])
+  triples routed to CI's owner. The remote P row of a halo column l
+  arrives by exchanging the per-slot (cid, weight) vectors — the
+  one-ring halo-row exchange, vectorized per slot;
+- level assembly (halo lists, a2a maps, transfer shards) mirrors the
+  aggregation phase C, generalized to weighted multi-entry P rows.
+
+Scope (v1): selector PMIS, interpolator D1, strength AHAT, scalar
+matrices, no truncation/aggressive levels; everything else falls back
+to the global-setup path (setup.sharded_eligible).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dist_matrix import ShardMatrix
+from .setup import (_SENT, _Edges, _a2a_maps, _owner_of_sem,
+                    _remote_uniq_flags, _route, _seg_max,
+                    _sorted_by_rid, _take, _unique_remote)
+
+FINE, COARSE, UNDECIDED = 0, 1, -1
+
+
+def _hash01_sem(sem_ids):
+    """selectors._hash01 on semantic global ids (bit-identical PMIS
+    weights to the single-device fixed point)."""
+    i = sem_ids.astype(jnp.uint32)
+    h = i * jnp.uint32(2654435761)
+    h = (h ^ (h >> 16)) * jnp.uint32(0x45D9F3B)
+    h = h ^ (h >> 16)
+    return (h & jnp.uint32(0xFFFFF)).astype(jnp.float64) / float(1 << 20)
+
+
+def _strength_masks(E: _Edges, M: ShardMatrix, theta: float,
+                    max_row_sum: float):
+    """(strong_out, strong_in) per local edge. strong_out is the AHAT
+    mask of the owned row; strong_in is the mask of the REVERSE edge
+    (col -> row), computed locally from exchanged per-vertex thresholds
+    under the value-symmetry assumption (a_ji == a_ij)."""
+    n = E.n_local
+    diag = M.diag
+    rows_c = jnp.minimum(E.rows, n)
+    offd = E.valid & (E.row_sem != E.col_sem)
+    sgn = jnp.where(diag < 0, -1.0, 1.0)
+    sl = jnp.concatenate([sgn, jnp.ones((1,), sgn.dtype)])
+    c_out = jnp.where(offd, -E.vals * sl[rows_c], 0.0)
+    rowmax = jnp.maximum(_seg_max(c_out, rows_c, n + 1, 0.0)[:n], 0.0)
+    thr = theta * rowmax
+    weak = jnp.zeros((n,), bool)
+    if max_row_sum < 1.0:
+        rowsum = jax.ops.segment_sum(
+            jnp.where(E.valid, E.vals, 0.0), rows_c,
+            num_segments=n + 1)[:n]
+        weak = jnp.abs(rowsum) > max_row_sum * jnp.abs(diag)
+    tl = jnp.concatenate([thr, jnp.zeros((1,), thr.dtype)])
+    wl = jnp.concatenate([weak, jnp.zeros((1,), bool)])
+    strong_out = offd & (c_out > 0) & (c_out >= tl[rows_c]) \
+        & ~wl[rows_c]
+    c_col = E.col_state(sgn, E.exchange(sgn), 1.0)
+    thr_col = E.col_state(thr, E.exchange(thr), 0.0)
+    weak_col = E.col_state(weak, E.exchange(weak), True)
+    c_in = jnp.where(offd, -E.vals * c_col, 0.0)
+    strong_in = offd & (c_in > 0) & (c_in >= thr_col) & ~weak_col
+    return strong_out, strong_in
+
+
+def _pmis_body(E: _Edges, active, strong_out, strong_in, me, offsets,
+               axis: str, max_iters: int):
+    """Sharded synchronous PMIS fixed point — bit-identical rounds to
+    pmis_split (same weights, same two-phase round structure)."""
+    n = E.n_local
+    adj = strong_out | strong_in
+    rows_c = jnp.minimum(E.rows, n)
+
+    def seg_any(mask):
+        return jax.ops.segment_max(
+            jnp.concatenate([mask, jnp.zeros((1,), bool)]),
+            jnp.concatenate([rows_c, jnp.full((1,), n, jnp.int32)]),
+            num_segments=n + 1)[:n]
+
+    outdeg = jax.ops.segment_sum(
+        strong_out.astype(jnp.float64), rows_c, num_segments=n + 1)[:n]
+    indeg = jax.ops.segment_sum(
+        strong_in.astype(jnp.float64), rows_c, num_segments=n + 1)[:n]
+    idx_sem = offsets[me] + jnp.arange(n, dtype=jnp.int32)
+    w = 0.5 * (outdeg + indeg) + _hash01_sem(idx_sem)
+    w = jnp.where(active, w, -1.0)
+    has_nbr = seg_any(adj)
+    state0 = jnp.where(active & ~has_nbr, COARSE,
+                       jnp.where(active, UNDECIDED, FINE)
+                       ).astype(jnp.int32)
+    halo_w = E.exchange(w)
+
+    def cond(carry):
+        it, state = carry
+        any_und = jax.lax.psum(
+            jnp.sum((state == UNDECIDED).astype(jnp.int32)), axis) > 0
+        return (it < max_iters) & any_und
+
+    def body(carry):
+        it, state = carry
+        und = state == UNDECIDED
+        halo_st = E.exchange(state)
+        und_c = E.col_state(und, halo_st == UNDECIDED, False)
+        w_c = E.col_state(w, halo_w, -1.0)
+        nbr_max = _seg_max(
+            jnp.where(adj & und_c, w_c, -jnp.inf), rows_c, n + 1,
+            -jnp.inf)[:n]
+        state = jnp.where(und & (w > nbr_max), COARSE, state)
+        # phase 2 sees this round's new COARSE points (incl. remote)
+        halo_st2 = E.exchange(state)
+        c_col = E.col_state(state == COARSE, halo_st2 == COARSE, False)
+        c_nbr = seg_any(adj & c_col)
+        state = jnp.where((state == UNDECIDED) & c_nbr, FINE, state)
+        return it + 1, state
+
+    _, state = jax.lax.while_loop(cond, body, (jnp.int32(0), state0))
+    state = jnp.where(state == UNDECIDED, FINE, state)
+    return jnp.where(active, state, FINE).astype(jnp.int32)
+
+
+def _cids_of_cf(cf, active, offsets_c, me):
+    """Contiguous semantic coarse ids of the owned C points."""
+    is_c = active & (cf == COARSE)
+    rank = jnp.cumsum(is_c.astype(jnp.int32)) - 1
+    return jnp.where(is_c, offsets_c[me] + rank, -1).astype(jnp.int32)
+
+
+def _d1_rows(E: _Edges, M: ShardMatrix, cf, cid_sem, strong_out,
+             PK: int):
+    """Per-vertex D1 interpolation rows as (n, PK) padded slot vectors
+    of (semantic cid, weight) — the Distance1Interpolator formula
+    (amg/classical/interpolators.py:336), row-local. C rows inject."""
+    n = E.n_local
+    rows_c = jnp.minimum(E.rows, n)
+    cf_col = E.col_state(cf, E.exchange(cf), jnp.int32(FINE))
+    cid_col = E.col_state(cid_sem, E.exchange(cid_sem), jnp.int32(-1))
+    offd = E.valid & (E.row_sem != E.col_sem)
+    neg = E.vals < 0
+    in_Ci = strong_out & (cid_col >= 0) & neg & offd
+    sum_neg = jax.ops.segment_sum(
+        jnp.where(offd & neg, E.vals, 0.0), rows_c,
+        num_segments=n + 1)[:n]
+    sum_Ci = jax.ops.segment_sum(
+        jnp.where(in_Ci, E.vals, 0.0), rows_c, num_segments=n + 1)[:n]
+    pos_lump = jax.ops.segment_sum(
+        jnp.where(offd & ~neg, E.vals, 0.0), rows_c,
+        num_segments=n + 1)[:n]
+    dmod = M.diag + pos_lump
+    alpha = jnp.where(sum_Ci == 0, 0.0,
+                      sum_neg / jnp.where(sum_Ci == 0, 1.0, sum_Ci))
+    al = jnp.concatenate([alpha, jnp.zeros((1,), alpha.dtype)])
+    dl = jnp.concatenate([jnp.where(dmod == 0, 1.0, dmod),
+                          jnp.ones((1,), dmod.dtype)])
+    w_e = -al[rows_c] * E.vals / dl[rows_c]
+    fl = jnp.concatenate([cf == FINE, jnp.zeros((1,), bool)])
+    entry = in_Ci & fl[rows_c]
+    # within-row rank of each entry: sort entries by row (stable), rank
+    # = position - first position of that row
+    order = jnp.argsort(
+        jnp.where(entry, rows_c, n).astype(jnp.int32), stable=True)
+    r_s = rows_c[order]
+    e_s = entry[order]
+    pos = jnp.arange(r_s.shape[0], dtype=jnp.int32)
+    first_of = jax.ops.segment_min(
+        jnp.where(e_s, pos, r_s.shape[0]), r_s, num_segments=n + 1)
+    rank = pos - first_of[jnp.minimum(r_s, n)]
+    slot_ok = e_s & (rank < PK)
+    tgt_row = jnp.where(slot_ok, r_s, n)
+    tgt_slot = jnp.clip(jnp.where(slot_ok, rank, 0), 0, PK - 1)
+    p_cid = jnp.full((n + 1, PK), -1, jnp.int32).at[
+        tgt_row, tgt_slot].set(
+        jnp.where(slot_ok, cid_col[order], -1), mode="drop")
+    p_w = jnp.zeros((n + 1, PK), E.vals.dtype).at[
+        tgt_row, tgt_slot].set(
+        jnp.where(slot_ok, w_e[order], 0.0), mode="drop")
+    is_c = cf == COARSE
+    p_cid = p_cid.at[:n, 0].set(jnp.where(is_c, cid_sem, p_cid[:n, 0]))
+    p_w = p_w.at[:n, 0].set(jnp.where(is_c, 1.0, p_w[:n, 0]))
+    return p_cid[:n], p_w[:n]
+
+
+def classical_phase_a(M: ShardMatrix, offsets, axis: str, theta: float,
+                      max_row_sum: float, max_iters: int):
+    """CF split + counts [nc_local, PK_local] (PK = max D1 entries per
+    row; >= 1 covers injection rows)."""
+    me = jax.lax.axis_index(axis)
+    n = M.n_local
+    E = _Edges(M, offsets, me)
+    idx_sem = offsets[me] + jnp.arange(n, dtype=jnp.int32)
+    active = idx_sem < offsets[me + 1]
+    strong_out, strong_in = _strength_masks(E, M, theta, max_row_sum)
+    cf = _pmis_body(E, active, strong_out, strong_in, me, offsets,
+                    axis, max_iters)
+    nc_local = jnp.sum((active & (cf == COARSE)).astype(jnp.int32))
+    cf_col = E.col_state(cf, E.exchange(cf), jnp.int32(FINE))
+    offd = E.valid & (E.row_sem != E.col_sem)
+    cnt = jax.ops.segment_sum(
+        (strong_out & (cf_col == COARSE) & (E.vals < 0) & offd
+         ).astype(jnp.int32),
+        jnp.minimum(E.rows, n), num_segments=n + 1)[:n]
+    pk = jnp.maximum(jnp.max(jnp.where(active, cnt, 0)), 1)
+    return cf, jnp.concatenate([nc_local[None], pk[None]])
+
+
+def classical_phase_b1(M: ShardMatrix, offsets, cf, offsets_c,
+                       axis: str, theta: float, max_row_sum: float,
+                       PK: int):
+    """Routing budgets, packed (2R,): per-dest triple counts followed
+    by per-dest R-member record counts."""
+    me = jax.lax.axis_index(axis)
+    R = offsets.shape[0] - 1
+    n = M.n_local
+    E = _Edges(M, offsets, me)
+    idx_sem = offsets[me] + jnp.arange(n, dtype=jnp.int32)
+    active = idx_sem < offsets[me + 1]
+    strong_out, _ = _strength_masks(E, M, theta, max_row_sum)
+    cid_sem = _cids_of_cf(cf, active, offsets_c, me)
+    p_cid, _p_w = _d1_rows(E, M, cf, cid_sem, strong_out, PK)
+    pv = p_cid >= 0
+    plen = jnp.sum(pv, axis=1).astype(jnp.int32)
+    own_p = _owner_of_sem(p_cid.reshape(-1), offsets_c, R,
+                          pv.reshape(-1)).reshape(n, PK)
+    plen_col = E.col_state(plen, E.exchange(plen), jnp.int32(0))
+    rows_c = jnp.minimum(E.rows, n)
+    safe_r = jnp.clip(rows_c, 0, n - 1)
+    cnt_t = jnp.zeros((R,), jnp.int32)
+    for a in range(PK):
+        d_a = jnp.where(E.valid & (rows_c < n), own_p[safe_r, a], R)
+        cnt_t = cnt_t.at[jnp.clip(d_a, 0, R - 1)].add(
+            jnp.where(d_a < R, plen_col, 0))
+    dest_m = jnp.where(own_p == me, R, own_p).reshape(-1)
+    cnt_m = jnp.zeros((R,), jnp.int32).at[
+        jnp.clip(dest_m, 0, R - 1)].add(
+        (dest_m < R).astype(jnp.int32))
+    return jnp.concatenate([cnt_t, cnt_m])
+
+
+def classical_phase_b2(M: ShardMatrix, offsets, cf, offsets_c,
+                       axis: str, theta: float, max_row_sum: float,
+                       PK: int, NCL_c: int, maxt: int, maxm: int):
+    """Expand + route + dedup the weighted Galerkin triples, route the
+    R-operator member records, count phase-C buffer sizes."""
+    from ..matrix import lexsort_rc
+    me = jax.lax.axis_index(axis)
+    R = offsets.shape[0] - 1
+    n = M.n_local
+    E = _Edges(M, offsets, me)
+    idx_sem = offsets[me] + jnp.arange(n, dtype=jnp.int32)
+    active = idx_sem < offsets[me + 1]
+    strong_out, _ = _strength_masks(E, M, theta, max_row_sum)
+    cid_sem = _cids_of_cf(cf, active, offsets_c, me)
+    p_cid, p_w = _d1_rows(E, M, cf, cid_sem, strong_out, PK)
+    pv = p_cid >= 0
+    rank_p = jnp.clip(_owner_of_sem(p_cid.reshape(-1), offsets_c, R,
+                                    pv.reshape(-1)), 0, R - 1
+                      ).reshape(n, PK)
+    p_phys = jnp.where(
+        pv, rank_p * NCL_c + (p_cid - offsets_c[rank_p]),
+        -1).astype(jnp.int32)
+    # one-ring halo P rows: exchange each (cid, weight) slot vector
+    halo_cid = [E.exchange(p_cid[:, a]) for a in range(PK)]
+    halo_w = [E.exchange(p_w[:, a]) for a in range(PK)]
+    rows_c = jnp.minimum(E.rows, n)
+    Etot = E.ci.shape[0]
+    pcid_l = jnp.concatenate([p_cid, jnp.full((1, PK), -1, jnp.int32)])
+    pw_l = jnp.concatenate([p_w, jnp.zeros((1, PK), p_w.dtype)])
+    CI_a = pcid_l[rows_c]                               # (E, PK)
+    WI_a = pw_l[rows_c]
+    CJ_b = jnp.stack(
+        [E.col_state(p_cid[:, a], halo_cid[a], jnp.int32(-1))
+         for a in range(PK)], axis=1)                   # (E, PK)
+    WJ_b = jnp.stack(
+        [E.col_state(p_w[:, a], halo_w[a], 0.0)
+         for a in range(PK)], axis=1)
+    own_CI = _owner_of_sem(CI_a.reshape(-1), offsets_c, R,
+                           (CI_a >= 0).reshape(-1)).reshape(Etot, PK)
+    shape3 = (Etot, PK, PK)
+    tri_ci = jnp.broadcast_to(CI_a[:, :, None], shape3).reshape(-1)
+    tri_cj = jnp.broadcast_to(CJ_b[:, None, :], shape3).reshape(-1)
+    tri_v = (WI_a[:, :, None] * E.vals[:, None, None]
+             * WJ_b[:, None, :]).reshape(-1)
+    tri_ok = ((CI_a >= 0)[:, :, None] & (CJ_b >= 0)[:, None, :]
+              & E.valid[:, None, None]).reshape(-1)
+    dest_t = jnp.where(
+        tri_ok,
+        jnp.broadcast_to(own_CI[:, :, None], shape3).reshape(-1), R)
+    rank_cj = jnp.clip(
+        _owner_of_sem(tri_cj, offsets_c, R, tri_ok), 0, R - 1)
+    cj_phys = jnp.where(
+        tri_ok, rank_cj * NCL_c + (tri_cj - offsets_c[rank_cj]),
+        _SENT).astype(jnp.int32)
+    ci_flat = jnp.where(tri_ok, tri_ci, _SENT)
+    v_flat = jnp.where(tri_ok, tri_v, 0.0)
+    rCI, rCJ, rv = _route(
+        (ci_flat, cj_phys, v_flat),
+        jnp.where(dest_t == me, R, dest_t), me, axis, R, maxt,
+        (_SENT, _SENT, jnp.zeros((), v_flat.dtype)))
+    keep = tri_ok & (dest_t == me)
+    aCI = jnp.concatenate([jnp.where(keep, ci_flat, _SENT), rCI])
+    aCJ = jnp.concatenate([jnp.where(keep, cj_phys, _SENT), rCJ])
+    av = jnp.concatenate([jnp.where(keep, v_flat, 0.0), rv])
+    slot = jnp.where(aCI != _SENT, aCI - offsets_c[me],
+                     NCL_c).astype(jnp.int32)
+    cj = jnp.where(aCJ != _SENT, aCJ, _SENT).astype(jnp.int32)
+    order = lexsort_rc(slot, cj)
+    slot_s, cj_s, v_s = slot[order], cj[order], av[order]
+    valid_s = slot_s < NCL_c
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool),
+         (slot_s[1:] != slot_s[:-1]) | (cj_s[1:] != cj_s[:-1])]) & valid_s
+    seg = jnp.cumsum(first) - 1
+    T = slot_s.shape[0]
+    vsum = jax.ops.segment_sum(jnp.where(valid_s, v_s, 0.0), seg,
+                               num_segments=T, indices_are_sorted=True)
+    v_out = jnp.where(first, vsum[jnp.clip(seg, 0, T - 1)], 0.0)
+    n_unique = jnp.sum(first.astype(jnp.int32))
+    # member records for R: (CI sem, fine gid, weight) per P entry
+    gid_phys = me * n + jnp.arange(n, dtype=jnp.int32)
+    gid_b = jnp.broadcast_to(gid_phys[:, None], (n, PK)).reshape(-1)
+    own_p = _owner_of_sem(p_cid.reshape(-1), offsets_c, R,
+                          pv.reshape(-1))
+    mcid, mgid, mw = _route(
+        (p_cid.reshape(-1), gid_b, p_w.reshape(-1)),
+        jnp.where(own_p == me, R, own_p), me, axis, R, maxm,
+        (_SENT, _SENT, jnp.zeros((), p_w.dtype)))
+
+    def cnt_uniq(vals_phys, mask, NCL):
+        _, uniq = _remote_uniq_flags(vals_phys, mask, me, NCL)
+        return jnp.sum(uniq.astype(jnp.int32))
+
+    owner_cj = jnp.clip(cj_s // NCL_c, 0, R)
+    counts = jnp.concatenate([
+        n_unique[None],
+        jnp.sum((first & (owner_cj == me)).astype(jnp.int32))[None],
+        jnp.sum((first & (owner_cj != me)).astype(jnp.int32))[None],
+        cnt_uniq(cj_s, first, NCL_c)[None],
+        cnt_uniq(p_phys.reshape(-1),
+                 pv.reshape(-1) & jnp.repeat(active, PK), NCL_c)[None],
+        cnt_uniq(mgid, mcid != _SENT, n)[None]])
+    return slot_s, cj_s, v_out, p_phys, p_w, mcid, mgid, mw, counts
+
+
+def classical_phase_c(M: ShardMatrix, offsets, triples, p_phys, p_w,
+                      mcid, mgid, mw, offsets_c, axis: str, NCL_c: int,
+                      PK: int, E_own: int, E_halo: int, H_c: int,
+                      mp_c: int, H_p: int, mp_p: int, H_r: int,
+                      mp_r: int):
+    """Assemble the coarse ShardMatrix + weighted P/R transfer shards
+    (the multi-entry generalization of setup._phase_c_body)."""
+    me = jax.lax.axis_index(axis)
+    R = offsets.shape[0] - 1
+    n = M.n_local
+    slot_s, cj_s, v_s = triples
+    Etot = slot_s.shape[0]
+    nc_local = offsets_c[me + 1] - offsets_c[me]
+    valid_s = slot_s < NCL_c
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool),
+         (slot_s[1:] != slot_s[:-1]) | (cj_s[1:] != cj_s[:-1])]) & valid_s
+    owner_cj = jnp.clip(cj_s // NCL_c, 0, R)
+    oidx, osel, _ = _take(first & (owner_cj == me), E_own, Etot - 1)
+    rid_own = jnp.where(osel, slot_s[oidx], NCL_c).astype(jnp.int32)
+    ci_own = jnp.where(osel, cj_s[oidx] - me * NCL_c, 0).astype(jnp.int32)
+    va_own = jnp.where(osel, v_s[oidx], 0.0)
+    hlist, hcnt = _unique_remote(cj_s, first, me, NCL_c, H_c)
+    hidx, hsel, _ = _take(first & (owner_cj != me), E_halo, Etot - 1)
+    rid_halo = jnp.where(hsel, slot_s[hidx], NCL_c).astype(jnp.int32)
+    ci_halo = jnp.where(
+        hsel, jnp.searchsorted(hlist, cj_s[hidx]), 0).astype(jnp.int32)
+    va_halo = jnp.where(hsel, v_s[hidx], 0.0)
+    send_c, recv_c = _a2a_maps(hlist, hcnt, me, NCL_c, NCL_c, axis, R,
+                               mp_c)
+    isd = first & (cj_s == me * NCL_c + slot_s)
+    diag = jnp.zeros((NCL_c,), v_s.dtype).at[
+        jnp.where(isd, slot_s, NCL_c)].add(
+        jnp.where(isd, v_s, 0.0), mode="drop")
+    diag = jnp.where(jnp.arange(NCL_c) < nc_local, diag, 1.0)
+    A_c = dict(rid_own=rid_own, ci_own=ci_own, va_own=va_own,
+               rid_halo=rid_halo, ci_halo=ci_halo, va_halo=va_halo,
+               diag=diag, halo_src=hlist, a2a_send=send_c,
+               a2a_recv=recv_c)
+    dt = v_s.dtype
+    # ---- P shard: flatten the (n, PK) slot vectors -------------------
+    idx_sem = offsets[me] + jnp.arange(n, dtype=jnp.int32)
+    active = idx_sem < offsets[me + 1]
+    pv = (p_phys >= 0) & active[:, None]
+    owner_p = jnp.where(pv, jnp.clip(p_phys // NCL_c, 0, R - 1), R)
+    rid_flat = jnp.broadcast_to(
+        jnp.arange(n, dtype=jnp.int32)[:, None], (n, PK)).reshape(-1)
+    pp_flat = p_phys.reshape(-1)
+    pw_flat = p_w.reshape(-1)
+    ow_flat = owner_p.reshape(-1)
+    plist, pcnt = _unique_remote(pp_flat, pv.reshape(-1), me, NCL_c,
+                                 H_p)
+    own_m = pv.reshape(-1) & (ow_flat == me)
+    halo_m = pv.reshape(-1) & (ow_flat != me) & (ow_flat < R)
+    p_rid_o, p_ci_o, p_va_o = _sorted_by_rid(
+        jnp.where(own_m, rid_flat, n).astype(jnp.int32),
+        jnp.where(own_m, pp_flat - me * NCL_c, 0).astype(jnp.int32),
+        jnp.where(own_m, pw_flat, 0.0).astype(dt), n_sent=n)
+    p_rid_h, p_ci_h, p_va_h = _sorted_by_rid(
+        jnp.where(halo_m, rid_flat, n).astype(jnp.int32),
+        jnp.where(halo_m, jnp.searchsorted(plist, pp_flat), 0
+                  ).astype(jnp.int32),
+        jnp.where(halo_m, pw_flat, 0.0).astype(dt), n_sent=n)
+    send_p, recv_p = _a2a_maps(plist, pcnt, me, NCL_c, NCL_c, axis, R,
+                               mp_p)
+    P_sh = dict(rid_own=p_rid_o, ci_own=p_ci_o, va_own=p_va_o,
+                rid_halo=p_rid_h, ci_halo=p_ci_h, va_halo=p_va_h,
+                diag=jnp.ones((n,), dt), halo_src=plist,
+                a2a_send=send_p, a2a_recv=recv_p)
+    # ---- R shard: rows = my coarse slots, cols = fine vertices -------
+    # local part: my fine vertices whose P entries target my coarse rows
+    r_rid_o, r_ci_o, r_va_o = _sorted_by_rid(
+        jnp.where(own_m, pp_flat - me * NCL_c, NCL_c).astype(jnp.int32),
+        jnp.where(own_m, rid_flat, 0).astype(jnp.int32),
+        jnp.where(own_m, pw_flat, 0.0).astype(dt), n_sent=NCL_c)
+    mvalid = mcid != _SENT
+    rlist, rcnt = _unique_remote(mgid, mvalid, me, n, H_r)
+    r_rid_h, r_ci_h, r_va_h = _sorted_by_rid(
+        jnp.where(mvalid, mcid - offsets_c[me], NCL_c).astype(jnp.int32),
+        jnp.where(mvalid, jnp.searchsorted(rlist, mgid), 0
+                  ).astype(jnp.int32),
+        jnp.where(mvalid, mw, 0.0).astype(dt), n_sent=NCL_c)
+    send_r, recv_r = _a2a_maps(rlist, rcnt, me, n, n, axis, R, mp_r)
+    R_sh = dict(rid_own=r_rid_o, ci_own=r_ci_o, va_own=r_va_o,
+                rid_halo=r_rid_h, ci_halo=r_ci_h, va_halo=r_va_h,
+                diag=jnp.ones((NCL_c,), dt), halo_src=rlist,
+                a2a_send=send_r, a2a_recv=recv_r)
+    return A_c, P_sh, R_sh
+
+
+def run_classical_levels(amg, mesh, axis: str, M: ShardMatrix, offsets,
+                         R: int, consolidate_at: int):
+    """Host orchestration of the sharded classical level loop (the
+    classical counterpart of build_sharded_hierarchy's aggregation
+    loop; same three-phase count-sync structure). Returns (levels,
+    levels_data, M, offsets, lvl, offsets_last, ncl_last) or None when
+    no sharded level could be built."""
+    from .setup import DistAMGLevel, _mk_shard, _wrap
+    cfg, scope = amg.cfg, amg.scope
+    theta = float(cfg.get("strength_threshold", scope))
+    mrs = float(cfg.get("max_row_sum", scope))
+    levels, levels_data = [], []
+    offsets_last = ncl_last = None
+    lvl = 0
+    while True:
+        n = int(offsets[-1])
+        if (lvl + 1 >= amg.max_levels or n <= max(amg.min_coarse_rows, 1)
+                or n < amg.min_fine_rows
+                or (n <= amg.dense_lu_num_rows and lvl > 0)):
+            break
+        if lvl > 0 and n <= consolidate_at:
+            break      # tail fits the consolidation budget
+        offs = jnp.asarray(offsets)
+
+        def fa(Mx, _o=offs):
+            cf, c = classical_phase_a(Mx.local(), _o, axis, theta, mrs,
+                                      30)
+            return cf[None], c[None]
+        cf_s, countsA = _wrap(mesh, axis, M, fa)(M)
+        ca = np.asarray(countsA)
+        nc_locals = ca[:, 0].astype(np.int64)
+        nc_g = int(nc_locals.sum())
+        if nc_g <= 0 or nc_g >= n or \
+                (n / max(nc_g, 1)) < amg.coarsen_threshold:
+            break
+        PK = max(int(ca[:, 1].max()), 1)
+        NCL_c = max(int(nc_locals.max()), 1)
+        offsets_c = np.concatenate(
+            [[0], np.cumsum(nc_locals)]).astype(np.int32)
+        offs_c = jnp.asarray(offsets_c)
+
+        def fb1(args, _o=offs, _oc=offs_c, _pk=PK):
+            Mx, cf_ = args
+            return classical_phase_b1(Mx.local(), _o, cf_[0], _oc,
+                                      axis, theta, mrs, _pk)[None]
+        cb1 = np.asarray(_wrap(mesh, axis, (M, cf_s), fb1)((M, cf_s)))
+        maxt = max(int(cb1[:, :R].max()), 1)
+        maxm = max(int(cb1[:, R:].max()), 1)
+
+        def fb2(args, _o=offs, _oc=offs_c, _pk=PK, _ncl=NCL_c,
+                _mt=maxt, _mm=maxm):
+            Mx, cf_ = args
+            out = classical_phase_b2(Mx.local(), _o, cf_[0], _oc, axis,
+                                     theta, mrs, _pk, _ncl, _mt, _mm)
+            return jax.tree.map(lambda a: a[None], out)
+        outB = _wrap(mesh, axis, (M, cf_s), fb2)((M, cf_s))
+        (slot_s, cj_s, v_s, p_phys, p_w, mcid, mgid, mw, countsB) = outB
+        cb = np.asarray(countsB)
+        E_own, E_halo, H_c, H_p, H_r = (
+            max(int(cb[:, i].max()), 1) for i in (1, 2, 3, 4, 5))
+
+        def fcc(args, _o=offs, _oc=offs_c, _ncl=NCL_c, _pk=PK,
+                _eo=E_own, _eh=E_halo, _hc=H_c, _hp=H_p, _hr=H_r):
+            (Mx, s1, c1, v1, pp, pw, mc, mg, mww) = args
+            out = classical_phase_c(
+                Mx.local(), _o, (s1[0], c1[0], v1[0]), pp[0], pw[0],
+                mc[0], mg[0], mww[0], _oc, axis, _ncl, _pk, _eo, _eh,
+                _hc, max(_hc, 1), _hp, max(_hp, 1), _hr, max(_hr, 1))
+            return jax.tree.map(lambda a: a[None], out)
+        argsC = (M, slot_s, cj_s, v_s, p_phys, p_w, mcid, mgid, mw)
+        A_c_f, P_f, R_f = _wrap(mesh, axis, argsC, fcc)(argsC)
+        A_c = _mk_shard(A_c_f, R * NCL_c, NCL_c, NCL_c, H_c, R, axis)
+        P_sh = _mk_shard(P_f, n, M.n_local, NCL_c, H_p, R, axis)
+        R_sh = _mk_shard(R_f, R * NCL_c, NCL_c, M.n_local, H_r, R, axis)
+        levels.append(DistAMGLevel(M, lvl))
+        levels_data.append({"A": M, "P": P_sh, "R": R_sh})
+        offsets_last, ncl_last = offsets_c, NCL_c
+        M, offsets = A_c, offsets_c
+        lvl += 1
+    if not levels:
+        return None
+    return levels, levels_data, M, offsets, lvl, offsets_last, ncl_last

@@ -134,6 +134,17 @@ class DistributedSolver:
         if mode == "global":
             return None
         reason = sharded_eligible(s.amg, self.shard_A)
+        # aggregation decisions need |a_ji| == |a_ij|; the classical
+        # reverse-edge strength additionally uses the owned value's
+        # SIGN as the transpose proxy, so it needs signed symmetry
+        if reason is None and not self._value_symmetry_probe(
+                signed=s.amg.algorithm == "CLASSICAL"):
+            # the sharded selectors assume |a_ji| = |a_ij| (setup.py
+            # module docs); on value-asymmetric matrices their decisions
+            # would silently diverge from the single-device path —
+            # fail fast / fall back instead
+            reason = ("matrix is not value-symmetric (sharded setup "
+                      "decisions assume |a_ji| = |a_ij|)")
         if reason is not None:
             if mode == "sharded":
                 raise BadParametersError(
@@ -146,6 +157,53 @@ class DistributedSolver:
                 "distributed_setup_mode=sharded: problem too small for "
                 "one sharded level (fits a single shard's budget)")
         return data
+
+    def _value_symmetry_probe(self, signed: bool = False) -> bool:
+        """Exact |a_ji| == |a_ij| (or, with `signed`, a_ji == a_ij)
+        check of the fine operator from the stacked shard fields
+        (host-side, once per setup): the sharded selectors' decisions
+        assume value symmetry (setup.py module docs); a pattern- or
+        value-asymmetric matrix must not take the sharded path
+        silently."""
+        import numpy as np
+        M = self.shard_A
+        R = M.rid_own.shape[0]
+        nl = M.n_local
+        nlc = M.n_local_cols
+        rows, cols, vals = [], [], []
+        rid_o = np.asarray(M.rid_own)
+        ci_o = np.asarray(M.ci_own)
+        va_o = np.asarray(M.va_own)
+        rid_h = np.asarray(M.rid_halo)
+        ci_h = np.asarray(M.ci_halo)
+        va_h = np.asarray(M.va_halo)
+        hsrc = np.asarray(M.halo_src)
+        for r in range(R):
+            vo = rid_o[r] < nl
+            rows.append(r * nl + rid_o[r][vo])
+            cols.append(r * nlc + ci_o[r][vo])
+            vals.append(va_o[r][vo])
+            vh = rid_h[r] < nl
+            rows.append(r * nl + rid_h[r][vh])
+            cols.append(hsrc[r][np.clip(ci_h[r][vh], 0,
+                                        hsrc.shape[1] - 1)])
+            vals.append(va_h[r][vh])
+        rows = np.concatenate(rows).astype(np.int64)
+        cols = np.concatenate(cols).astype(np.int64)
+        vals = np.concatenate(vals)
+        if not signed:
+            vals = np.abs(vals)
+        m = np.int64(R) * max(nl, nlc)
+        key = rows * m + cols
+        order = np.argsort(key, kind="stable")
+        k1, v1 = key[order], vals[order]
+        keyt = cols * m + rows
+        order2 = np.argsort(keyt, kind="stable")
+        k2, v2 = keyt[order2], vals[order2]
+        if not np.array_equal(k1, k2):
+            return False               # pattern-asymmetric
+        scale = float(np.abs(v1).max()) if v1.size else 1.0
+        return bool(np.all(np.abs(v1 - v2) <= 1e-12 * max(scale, 1e-300)))
 
     def _build_data(self):
         """Hand-build the solve-data pytree (stacked arrays); per-shard

@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Matrix/system format converter.
+
+Analog of the reference CLI utility (/root/reference/examples/convert.c):
+read a system in one supported format (MatrixMarket `.mtx` or the
+binary system format) and write it in another, chosen by the output
+extension (`.mtx` -> MatrixMarket, anything else -> binary).
+
+Usage:
+    python examples/convert.py input.mtx output.bin
+    python examples/convert.py input.bin output.mtx
+"""
+import argparse
+import sys
+
+sys.path.insert(0, __import__("os").path.join(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__)),
+    ".."))
+
+import os  # noqa: E402
+if os.environ.get("JAX_PLATFORMS"):
+    import jax  # noqa: E402
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("input", help="input system (.mtx or binary)")
+    ap.add_argument("output",
+                    help="output path (.mtx -> MatrixMarket, else "
+                         "binary)")
+    args = ap.parse_args()
+
+    from amgx_tpu.io import read_system, write_system
+    A, b, x = read_system(args.input)
+    fmt = ("matrixmarket" if args.output.lower().endswith(".mtx")
+           else "binary")
+    write_system(args.output, A, b, x, fmt=fmt)
+    n = A.num_rows
+    print(f"converted {args.input} -> {args.output} "
+          f"({fmt}; {n} rows, {A.nnz} nnz"
+          f"{', rhs' if b is not None else ''}"
+          f"{', sol' if x is not None else ''})")
+
+
+if __name__ == "__main__":
+    main()

@@ -325,3 +325,119 @@ def test_replace_coefficients_bad_length_recovers(system):
         _safe(capi.AMGX_matrix_replace_coefficients(
             mtx, len(ro) - 1, len(ci), 2.0 * va))
     assert capi._get(mtx).new_vals is None  # rebuild completed
+
+
+CLS_CFG = ("config_version=2, solver(s)=FGMRES, s:max_iters=60,"
+           " s:tolerance=1e-8, s:convergence=RELATIVE_INI,"
+           " s:gmres_n_restart=30, s:monitor_residual=1,"
+           " s:preconditioner(amg)=AMG, amg:algorithm=CLASSICAL,"
+           " amg:selector=PMIS, amg:interpolator=D1,"
+           " amg:smoother=JACOBI_L1, amg:presweeps=1,"
+           " amg:postsweeps=1, amg:max_iters=1,"
+           " amg:coarse_solver=DENSE_LU_SOLVER, amg:min_coarse_rows=16,"
+           " amg:amg_host_setup=never")
+
+
+def test_classical_pieces_path_parity(system):
+    """CLASSICAL from per-rank pieces: the sharded PMIS+D1 setup
+    (distributed/setup_classical.py) makes the pieces path work for
+    classical AMG — previously it raised (the controller-global
+    fallback needs the global matrix)."""
+    A, b = system
+    n = A.num_rows
+    n_local = -(-n // N_DEV)
+    offsets = np.minimum(np.arange(N_DEV + 1) * n_local, n)
+
+    capi.AMGX_initialize()
+    cfg_h = _safe(*capi.AMGX_config_create(CLS_CFG))
+    rs = _safe(*capi.AMGX_resources_create_simple(cfg_h))
+    mtx = _safe(*capi.AMGX_matrix_create(rs, "dDDI"))
+    dist = _safe(*capi.AMGX_distribution_create(cfg_h))
+    _safe(capi.AMGX_distribution_set_partition_data(
+        dist, capi.AMGX_DIST_PARTITION_OFFSETS, offsets))
+    for ro, ci, va in _pieces_of(A, offsets):
+        _safe(capi.AMGX_matrix_upload_distributed(
+            mtx, n, len(ro) - 1, len(ci), 1, 1, ro, ci, va, None,
+            dist))
+    m = capi._get(mtx)
+    assert m.part is not None and m.A is None     # no global assembly
+
+    slv = _safe(*capi.AMGX_solver_create(rs, "dDDI", cfg_h))
+    _safe(capi.AMGX_solver_setup(slv, mtx))
+    rhs = _safe(*capi.AMGX_vector_create(rs, "dDDI"))
+    sol = _safe(*capi.AMGX_vector_create(rs, "dDDI"))
+    _safe(capi.AMGX_vector_bind(rhs, mtx))
+    for r in range(N_DEV):
+        lo, hi = int(offsets[r]), int(offsets[r + 1])
+        _safe(capi.AMGX_vector_upload_distributed(
+            rhs, hi - lo, 1, b[lo:hi]))
+    _safe(capi.AMGX_solver_solve_with_0_initial_guess(slv, rhs, sol))
+    rc, its = capi.AMGX_solver_get_iterations_number(slv)
+    x = _safe(*capi.AMGX_vector_download(sol))
+
+    s = amgx.create_solver(Config.from_string(CLS_CFG))
+    s.setup(A)
+    ref = s.solve(jnp.asarray(b))
+    assert int(its) == int(ref.iterations)
+    r = b - np.asarray(amgx.ops.spmv(A, jnp.asarray(x)))
+    assert np.linalg.norm(r) / np.linalg.norm(b) < 1e-7
+    capi.AMGX_solver_destroy(slv)
+    capi.AMGX_matrix_destroy(mtx)
+
+
+def test_read_system_maps_one_ring(tmp_path, system):
+    """amgx_c.h:452/:478 analog: one-ring local numbering + B2L maps
+    reconstruct the global matrix exactly."""
+    A, b = system
+    path = str(tmp_path / "sys.mtx")
+    from amgx_tpu.io import write_system
+    write_system(path, A, np.asarray(b))
+    capi.AMGX_initialize()
+    cfg_h = _safe(*capi.AMGX_config_create(CFG))
+    rs = _safe(*capi.AMGX_resources_create_simple(cfg_h))
+    rc, parts = capi.AMGX_read_system_maps_one_ring(
+        rs, "dDDI", path, 1, N_DEV)
+    assert rc == capi.RC.OK and len(parts) == N_DEV
+    n = A.num_rows
+    n_local = -(-n // N_DEV)
+    offsets = np.minimum(np.arange(N_DEV + 1) * n_local, n)
+    dense = np.zeros((n, n))
+    for r, p in enumerate(parts):
+        lo = int(offsets[r])
+        n_r = p["n"]
+        # local one-ring numbering: cols < n_r owned, >= n_r halo
+        halo_globals = np.full(max(p["col_indices"].max() + 1 - n_r, 0),
+                               -1, np.int64)
+        # reconstruct halo globals via the neighbors' send maps
+        for nb, rmap in zip(p["neighbors"], p["recv_maps"]):
+            q = parts[int(nb)]
+            # neighbor's send map FOR ME: find my rank in its lists
+            at = list(q["neighbors"]).index(r)
+            gsend = q["send_maps"][at] + int(offsets[int(nb)])
+            assert len(gsend) == len(rmap)
+            halo_globals[rmap - n_r] = gsend
+        ro = np.asarray(p["row_ptrs"])
+        ci = np.asarray(p["col_indices"])
+        va = np.asarray(p["data"])
+        for i in range(n_r):
+            for e in range(ro[i], ro[i + 1]):
+                c = ci[e]
+                g = lo + c if c < n_r else halo_globals[c - n_r]
+                assert g >= 0
+                dense[lo + i, g] += va[e]
+    ref = np.asarray(A.to_dense())
+    assert np.allclose(dense, ref, atol=1e-12)
+    # free analog is a no-op that returns OK
+    assert capi.AMGX_free_system_maps_one_ring() == capi.RC.OK
+
+
+def test_solver_register_print_callback():
+    capi.AMGX_initialize()
+    seen = []
+    rc = capi.AMGX_solver_register_print_callback(
+        lambda msg, _n: seen.append(msg))
+    assert rc == capi.RC.OK
+    from amgx_tpu.output import amgx_printf, register_print_callback
+    amgx_printf("one-ring-test")
+    register_print_callback(None)
+    assert any("one-ring-test" in m for m in seen)
