@@ -43,10 +43,13 @@ from .partition import partition_matrix
 
 # smoother solve-data keys that partition row-wise (leading dim = rows);
 # CsrMatrix-valued entries (the ILU factors) shard like the level
-# operator itself. Any other key (nested preconditioners, global
-# permutations) marks the smoother as not distribution-aware.
+# operator itself; _REPLICATED_KEYS are small row-independent arrays
+# (polynomial coefficients) that tile across the mesh. Any other key
+# (nested preconditioners, global permutations) marks the smoother as
+# not distribution-aware.
 _ROWWISE_KEYS = {"dinv", "Einv", "colors", "is_coarse", "gs_diag",
                  "u_diag"}
+_REPLICATED_KEYS = {"taus"}
 
 
 def _partition_rowwise(arr, n_ranks: int, n_local: int):
@@ -137,10 +140,16 @@ def _shard_smoother_data(sm, A_sh: ShardMatrix, n_ranks: int, axis: str):
     # colors (nb,)); the shard stores scalar-expanded rows
     n_local = A_sh.n_local // A_sh.bdimx
     for k, v in data.items():
-        if k == "A":
+        if k in ("A", "precond"):
+            # 'precond' is rebuilt by the distributed chain walk
+            # (solver.py chain_data) — every chain member is admitted
+            # and sharded individually
             continue
         if isinstance(v, CsrMatrix):
             out[k] = _shard(v, n_ranks, axis)
+            continue
+        if k in _REPLICATED_KEYS:
+            out[k] = _replicate(v, n_ranks)
             continue
         if k not in _ROWWISE_KEYS:
             raise BadParametersError(

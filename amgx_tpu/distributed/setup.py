@@ -958,9 +958,10 @@ def build_sharded_hierarchy(amg, shard_A: ShardMatrix, mesh, axis: str):
     # consolidation boundary: by default a coarse level consolidates to
     # the replicated tail when its global size fits one shard's initial
     # budget; matrix_consolidation_lower_threshold (the reference's
-    # consolidation knob) overrides it so deeper levels stay sharded
+    # consolidation knob, an AVERAGE-rows-per-rank threshold) overrides
+    # it so deeper levels stay sharded
     thr = int(cfg.get("matrix_consolidation_lower_threshold", scope))
-    consolidate_at = thr if thr > 0 else n_local0
+    consolidate_at = thr * R if thr > 0 else n_local0
     offsets = np.minimum(np.arange(R + 1) * n_local0, n_g0
                          ).astype(np.int32)
     M = shard_A

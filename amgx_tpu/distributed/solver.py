@@ -33,8 +33,13 @@ from .dist_matrix import ShardMatrix, shard_matrix_from_partition
 from .partition import (partition_matrix, partition_vector,
                         unpartition_vector)
 
-_SUPPORTED_PRECONDS = {"NOSOLVER", "DUMMY", "BLOCK_JACOBI", "JACOBI",
-                       "JACOBI_L1", "AMG"}
+# preconditioners with hand-built per-shard data (diagonal-derived);
+# ANY other solver is admitted when its solve-data partitions row-wise
+# (the same data-driven test the distributed AMG smoother sharding
+# uses, amg.py _shard_smoother_data) — matching the reference's
+# any-tree-any-rank-count composability (include/solvers/solver.h:271)
+_DIAG_PRECONDS = {"NOSOLVER", "DUMMY", "BLOCK_JACOBI", "JACOBI",
+                  "JACOBI_L1", "AMG"}
 
 
 def default_mesh(n_devices: Optional[int] = None, axis: str = "p",
@@ -64,15 +69,8 @@ class DistributedSolver:
                 "distributed solve: scaling is not yet supported (the "
                 "distributed path bypasses Solver.setup; scale the system "
                 "before partitioning)")
-        # validate the preconditioner chain is distribution-aware
-        s = self.solver
-        while s is not None:
-            p = s.preconditioner
-            if p is not None and p.name not in _SUPPORTED_PRECONDS:
-                raise BadParametersError(
-                    f"distributed solve: preconditioner {p.name} not yet "
-                    f"supported (use one of {sorted(_SUPPORTED_PRECONDS)})")
-            s = p
+        # non-diagonal preconditioners are validated data-driven at
+        # setup time (their solve-data must partition row-wise)
         self._fn = None
 
     # -- setup -----------------------------------------------------------
@@ -104,8 +102,23 @@ class DistributedSolver:
         # GLOBAL matrix on the controller, then every level is sharded
         # (distributed/amg.py — the round-2 fallback path).
         self._sharded_amg = {}
+        self._precond_shard_data = {}
         s = self.solver
         while s is not None:
+            if s.name not in _DIAG_PRECONDS and s is not self.solver:
+                # data-driven admission: set up on the global matrix,
+                # shard the solve-data row-wise (raises when a data key
+                # does not partition by rows)
+                if A is None:
+                    raise BadParametersError(
+                        f"distributed preconditioner {s.name} from "
+                        "per-rank pieces is not supported (its setup "
+                        "needs the global matrix on the controller)")
+                from .amg import _shard_smoother_data
+                s._owns_scaling = False
+                s.setup(A)
+                self._precond_shard_data[id(s)] = _shard_smoother_data(
+                    s, self.shard_A, self.n_ranks, self.axis)
             if s.name == "AMG":
                 data = self._try_sharded_setup(s)
                 if data is not None:
@@ -159,51 +172,45 @@ class DistributedSolver:
         return data
 
     def _value_symmetry_probe(self, signed: bool = False) -> bool:
-        """Exact |a_ji| == |a_ij| (or, with `signed`, a_ji == a_ij)
-        check of the fine operator from the stacked shard fields
-        (host-side, once per setup): the sharded selectors' decisions
-        assume value symmetry (setup.py module docs); a pattern- or
-        value-asymmetric matrix must not take the sharded path
-        silently."""
-        import numpy as np
-        M = self.shard_A
-        R = M.rid_own.shape[0]
-        nl = M.n_local
-        nlc = M.n_local_cols
-        rows, cols, vals = [], [], []
-        rid_o = np.asarray(M.rid_own)
-        ci_o = np.asarray(M.ci_own)
-        va_o = np.asarray(M.va_own)
-        rid_h = np.asarray(M.rid_halo)
-        ci_h = np.asarray(M.ci_halo)
-        va_h = np.asarray(M.va_halo)
-        hsrc = np.asarray(M.halo_src)
-        for r in range(R):
-            vo = rid_o[r] < nl
-            rows.append(r * nl + rid_o[r][vo])
-            cols.append(r * nlc + ci_o[r][vo])
-            vals.append(va_o[r][vo])
-            vh = rid_h[r] < nl
-            rows.append(r * nl + rid_h[r][vh])
-            cols.append(hsrc[r][np.clip(ci_h[r][vh], 0,
-                                        hsrc.shape[1] - 1)])
-            vals.append(va_h[r][vh])
-        rows = np.concatenate(rows).astype(np.int64)
-        cols = np.concatenate(cols).astype(np.int64)
-        vals = np.concatenate(vals)
-        if not signed:
-            vals = np.abs(vals)
-        m = np.int64(R) * max(nl, nlc)
-        key = rows * m + cols
-        order = np.argsort(key, kind="stable")
-        k1, v1 = key[order], vals[order]
-        keyt = cols * m + rows
-        order2 = np.argsort(keyt, kind="stable")
-        k2, v2 = keyt[order2], vals[order2]
-        if not np.array_equal(k1, k2):
-            return False               # pattern-asymmetric
-        scale = float(np.abs(v1).max()) if v1.size else 1.0
-        return bool(np.all(np.abs(v1 - v2) <= 1e-12 * max(scale, 1e-300)))
+        """Randomized on-device symmetry check: <y, A x> == <x, A y>
+        for symmetric A (two shard_mapped SpMVs + psum dots — no global
+        matrix is ever materialized, preserving the pieces path's
+        contract). The sharded selectors assume value symmetry
+        (setup.py module docs; the classical reverse-edge strength
+        additionally relies on signs), and a generically asymmetric
+        matrix fails this probe with probability ~1 — it then falls
+        back to the global setup (auto) or raises (sharded). The probe
+        is signed-strict, so a |.|-symmetric sign-flipped matrix also
+        falls back: conservative, and correct for the Notay weights
+        which read signed values."""
+        from . import comms
+        from ..ops.spmv import spmv
+        del signed    # the dot probe is signed-strict for all callers
+        n = self.part.n_global
+        R = self.n_ranks
+        rng = np.random.default_rng(0xA317)
+        xl = partition_vector(rng.standard_normal(n), R,
+                              self.part.n_local)
+        yl = partition_vector(rng.standard_normal(n), R,
+                              self.part.n_local)
+        axis = self.axis
+
+        def body(M, xs, ys):
+            Ml = jax.tree.map(lambda a: a[0], M)
+            with comms.collective_axis(axis):
+                ax = spmv(Ml, xs[0])
+                ay = spmv(Ml, ys[0])
+                s1 = jax.lax.psum(jnp.vdot(ys[0], ax), axis)
+                s2 = jax.lax.psum(jnp.vdot(xs[0], ay), axis)
+            return jnp.stack([s1, s2])
+
+        pspec = jax.tree.map(lambda _: P(axis), self.shard_A)
+        fn = jax.jit(shard_map(
+            body, mesh=self.mesh, in_specs=(pspec, P(axis), P(axis)),
+            out_specs=P(), check_vma=False))
+        s1, s2 = (float(v) for v in fn(self.shard_A, xl, yl))
+        scale = max(abs(s1), abs(s2), 1e-300)
+        return abs(s1 - s2) <= 1e-10 * scale
 
     def _build_data(self):
         """Hand-build the solve-data pytree (stacked arrays); per-shard
@@ -230,6 +237,10 @@ class DistributedSolver:
                 else:
                     from .amg import shard_amg
                     d["amg"] = shard_amg(s.amg, self.n_ranks, self.axis)
+            elif id(s) in self._precond_shard_data:
+                d.update({k: v for k, v in
+                          self._precond_shard_data[id(s)].items()
+                          if k != "A"})
             if s.preconditioner is not None:
                 d["precond"] = chain_data(s.preconditioner)
             return d

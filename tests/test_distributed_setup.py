@@ -323,3 +323,40 @@ class TestShardedValueSymmetryGuard:
         d.setup(A)
         r = d.solve(jnp.ones(A.num_rows))
         assert bool(r.converged)
+
+
+class TestWidenedOuterPreconditioners:
+    """The distributed preconditioner envelope is data-driven (any
+    solver whose solve-data partitions row-wise is admitted —
+    include/solvers/solver.h:271 composability), replacing the round-3
+    whitelist. Each admitted solver: mesh-vs-single-device iteration
+    parity."""
+
+    @pytest.mark.parametrize("name", ["MULTICOLOR_DILU",
+                                      "MULTICOLOR_GS",
+                                      "CHEBYSHEV_POLY"])
+    def test_outer_precond_parity(self, name):
+        A = gallery.poisson("7pt", 12, 12, 12).init()
+        cfg = Config.from_string(
+            "config_version=2, solver(s)=FGMRES, s:max_iters=80,"
+            " s:tolerance=1e-8, s:convergence=RELATIVE_INI,"
+            " s:gmres_n_restart=40, s:monitor_residual=1,"
+            f" s:preconditioner(p)={name}, p:max_iters=2")
+        s = amgx.create_solver(cfg)
+        s.setup(A)
+        r1 = s.solve(jnp.ones(A.num_rows))
+        d = DistributedSolver(cfg, default_mesh(N_DEV))
+        d.setup(A)
+        r2 = d.solve(np.ones(A.num_rows))
+        assert bool(r1.converged) and bool(r2.converged)
+        assert int(r1.iterations) == int(r2.iterations)
+
+    def test_non_rowwise_precond_rejected(self):
+        A = gallery.poisson("7pt", 8, 8, 8).init()
+        cfg = Config.from_string(
+            "config_version=2, solver(s)=FGMRES, s:max_iters=10,"
+            " s:monitor_residual=1, s:preconditioner(p)=GS")
+        d = DistributedSolver(cfg, default_mesh(N_DEV))
+        with pytest.raises(BadParametersError,
+                           match="not distribution-aware"):
+            d.setup(A)
