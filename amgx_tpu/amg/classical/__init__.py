@@ -42,10 +42,12 @@ class ClassicalAMGLevel(AMGLevel):
                 f"{self.algorithm} AMG supports scalar matrices only (the "
                 "reference has the same restriction); use "
                 "algorithm=AGGREGATION for block matrices")
+        from ...profiling import trace_region
         cfg, scope = self.cfg, self.scope
         st = registry.strength.create(str(cfg.get("strength", scope)),
                                       cfg, scope)
-        self.strong = st.strong_mask(self.A)
+        with trace_region(f"cls.L{self.level_index}.strength"):
+            self.strong = st.strong_mask(self.A)
         sel_name = str(cfg.get(self.selector_param, scope))
         # aggressive coarsening on the first `aggressive_levels` levels
         aggressive = self.level_index < int(cfg.get("aggressive_levels",
@@ -59,8 +61,9 @@ class ClassicalAMGLevel(AMGLevel):
         if not registry.classical_selectors.has(sel_name):
             sel_name = self.selector_fallback
         sel = registry.classical_selectors.create(sel_name, cfg, scope)
-        self.cf_map = sel.mark_coarse_fine_points(self.A, self.strong)
-        self.coarse_size = int(jnp.sum(self.cf_map == 1))
+        with trace_region(f"cls.L{self.level_index}.cfsplit"):
+            self.cf_map = sel.mark_coarse_fine_points(self.A, self.strong)
+            self.coarse_size = int(jnp.sum(self.cf_map == 1))
         self._aggressive = aggressive
 
     def create_coarse_matrix(self) -> CsrMatrix:
@@ -84,12 +87,18 @@ class ClassicalAMGLevel(AMGLevel):
         # Device-resident setup keeps ell='never': the auto layout probe
         # costs blocking device fetches per level and SWELL is host-built.
         from ...matrix import host_resident
-        P = interp.generate(self.A, self.cf_map, self.strong)
+        from ...profiling import trace_region
+        k = self.level_index
+        with trace_region(f"cls.L{k}.interp"):
+            P = interp.generate(self.A, self.cf_map, self.strong)
         ell = "auto" if host_resident(P.row_offsets, P.col_indices,
                                       P.values) else "never"
-        self.P = P.init(ell=ell)
-        self.R = transpose(self.P).init(ell=ell)
-        return galerkin_rap(self.R, self.A, self.P)
+        with trace_region(f"cls.L{k}.layoutP"):
+            self.P = P.init(ell=ell)
+        with trace_region(f"cls.L{k}.transposeR"):
+            self.R = transpose(self.P).init(ell=ell)
+        with trace_region(f"cls.L{k}.rap"):
+            return galerkin_rap(self.R, self.A, self.P)
 
     def reuse_structure(self, old):
         """structure_reuse_levels: keep strength/CF-split and the

@@ -161,13 +161,16 @@ class AMG:
             # cast OUTSIDE the host default-device block: orig's arrays
             # are uncommitted accelerator data, and an astype dispatched
             # under default_device(cpu) would pull them over the tunnel
+            from ..profiling import trace_region
             l0_dev = self._l0_device_cast(A)
             with jax.default_device(host):
-                Af = self._pull_numpy(self._strip_layouts(A))
-                Af = Af.init()
+                with trace_region("amg.host_pull"):
+                    Af = self._pull_numpy(self._strip_layouts(A))
+                    Af = Af.init()
                 self._register_device_l0(A, Af, l0_dev)
                 self._build_levels_checked(Af, 0)
-                self._finalize_setup(t0)
+                with trace_region("amg.finalize"):
+                    self._finalize_setup(t0)
             return self
         self._ship_device = None
         Af = A if A.initialized else A.init()
@@ -478,11 +481,13 @@ class AMG:
             # flight to) the accelerator; only the stragglers (smoother
             # and coarse-solver payloads) transfer here. amg_precision
             # casting happens host-side before the wire.
-            self._prefetch_leaves(data)
-            self._resolve_put_cache()
-            self._data_cache = jax.tree.map(
-                lambda leaf: self._put_cache[id(leaf)][1]
-                if hasattr(leaf, "dtype") else leaf, data)
+            from ..profiling import trace_region
+            with trace_region("amg.ship_resolve"):
+                self._prefetch_leaves(data)
+                self._resolve_put_cache()
+                self._data_cache = jax.tree.map(
+                    lambda leaf: self._put_cache[id(leaf)][1]
+                    if hasattr(leaf, "dtype") else leaf, data)
             return self._data_cache
         dt = self._PRECISIONS[self.precision]
         if dt is not None:

@@ -696,10 +696,14 @@ class DistAMGLevel:
     P/R ShardMatrix shards in the solve-data (the same duck-typed spmv
     dispatch the solve-phase sharding uses)."""
 
-    def __init__(self, A_sh: ShardMatrix, level_index: int):
+    def __init__(self, A_sh: ShardMatrix, level_index: int,
+                 offsets: Optional[np.ndarray] = None):
         self.A = A_sh
         self.level_index = level_index
         self.smoother = None
+        # semantic row-offset vector of this level's numbering (used by
+        # the sharded coloring to hash semantic ids)
+        self.offsets = offsets
 
     def restrict(self, data, r):
         from ..ops.spmv import spmv
@@ -764,10 +768,12 @@ def _mk_shard(fields: dict, n_global: int, n_local: int,
         n_halo=n_halo, n_ranks=R, axis_name=axis, exchange_mode="a2a")
 
 
-def _smoother_data(name: str, M: ShardMatrix, solver):
+def _smoother_data(name: str, M: ShardMatrix, solver, mesh=None,
+                   axis=None, offsets=None):
     """Row-partitioned smoother solve-data from stacked shard fields
     (JACOBI dinv; JACOBI_L1 dinv with halo-inclusive off-diagonal L1
-    sums — solver._dinv_l1 semantics)."""
+    sums — solver._dinv_l1 semantics; MULTICOLOR_DILU/GS via the
+    sharded JPL coloring + per-color halo-exchanging Einv recurrence)."""
     if name in ("NOSOLVER", "DUMMY"):
         return {"A": M}
     d = M.diag
@@ -778,6 +784,17 @@ def _smoother_data(name: str, M: ShardMatrix, solver):
 
     if name in ("JACOBI", "BLOCK_JACOBI"):
         return {"A": M, "dinv": jax.jit(dinv_of)(d)}
+    if name in ("MULTICOLOR_DILU", "MULTICOLOR_GS"):
+        colors_s, nc = sharded_coloring(M, mesh, axis, offsets)
+        # the solve-phase color sweeps read num_colors off the solver
+        # (solver_setup never runs — there is no global matrix)
+        solver.num_colors = nc
+        solver.row_colors = None
+        if name == "MULTICOLOR_GS":
+            return {"A": M, "dinv": jax.jit(dinv_of)(d),
+                    "colors": colors_s}
+        Einv = _sharded_dilu_einv(M, mesh, axis, colors_s, nc)
+        return {"A": M, "Einv": Einv, "colors": colors_s}
     if name == "CHEBYSHEV_POLY":
         # taus need only the global Gershgorin bound: per-shard absolute
         # row sums (owned + halo entries are all shard-local), global
@@ -822,8 +839,164 @@ def _smoother_data(name: str, M: ShardMatrix, solver):
         f"sharded setup: smoother {name} not row-partitionable")
 
 
+# ---------------------------------------------------------------------------
+# sharded coloring + strong smoothers (MULTICOLOR_DILU / MULTICOLOR_GS)
+# ---------------------------------------------------------------------------
+
+def _hash_w_sem(sem_ids, rnd):
+    """ops.coloring._hash_w on explicit semantic ids with a traced
+    round (identical uint32 math, so the sharded JPL fixed point makes
+    the same per-round decisions as the single-device one)."""
+    i = sem_ids.astype(jnp.uint32)
+    h = (i + rnd.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)) * \
+        jnp.uint32(2654435761)
+    h = (h ^ (h >> 15)) * jnp.uint32(0x85EBCA6B)
+    return h ^ (h >> 13)
+
+
+def sharded_coloring(M: ShardMatrix, mesh, axis: str, offsets_np,
+                     max_rounds: int = 64):
+    """Per-shard Jones-Plassmann-Luby MIN_MAX coloring with a halo
+    color-state exchange each round — the boundary_coloring=SYNC_COLORS
+    policy (src/core.cu:353-354; min_max.cu): boundary rows always see
+    their cross-rank neighbors' true color state, so the coloring is
+    globally proper. Hash weights are keyed on SEMANTIC global ids,
+    which makes the result the EXACT coloring ops.coloring._jpl_min_max
+    computes on the assembled matrix — bit-identical colors, hence
+    bit-identical DILU factors and iteration parity with the
+    single-device path. Assumes a pattern-symmetric matrix (the sharded
+    envelope's value-symmetry probe already guarantees it).
+
+    Returns (stacked row colors (R, n_local) int32, num_colors)."""
+    n_local = M.n_local
+    offsets = jnp.asarray(offsets_np, jnp.int32)
+    pspec = jax.tree.map(lambda _: P(axis), M)
+
+    def init_body(Ms):
+        Mx = jax.tree.map(lambda a: a[0], Ms)
+        offd = (Mx.ci_own != Mx.rid_own).astype(jnp.int32)
+        has = jax.ops.segment_max(offd, Mx.rid_own,
+                                  num_segments=n_local,
+                                  indices_are_sorted=True)
+        if Mx.rid_halo.shape[0]:
+            has = jnp.maximum(has, jax.ops.segment_max(
+                jnp.ones_like(Mx.rid_halo), Mx.rid_halo,
+                num_segments=n_local, indices_are_sorted=True))
+        # rows with no neighbors (and last-rank pad rows, which have no
+        # entries at all) take color 0 immediately
+        return jnp.where(has > 0, jnp.int32(-1), jnp.int32(0))[None]
+
+    def round_body(Ms, colors_s, rnd, nc0):
+        Mx = jax.tree.map(lambda a: a[0], Ms)
+        colors = colors_s[0]
+        me = jax.lax.axis_index(axis)
+        sem = offsets[me] + jnp.arange(n_local, dtype=jnp.int32)
+        w = _hash_w_sem(sem, rnd)
+        offd = Mx.ci_own != Mx.rid_own
+
+        def extract(colors, ncol, maximize):
+            un = colors < 0
+            fill = jnp.uint32(0) if maximize else jnp.uint32(0xFFFFFFFF)
+            wm = jnp.where(un, w, fill)
+            seg = jax.ops.segment_max if maximize else jax.ops.segment_min
+            nbest = seg(jnp.where(offd, wm[Mx.ci_own], fill), Mx.rid_own,
+                        num_segments=n_local, indices_are_sorted=True)
+            if Mx.rid_halo.shape[0]:
+                halo_w = Mx.exchange_halo(wm)
+                hp = halo_w if Mx.n_halo else jnp.full((1,), fill,
+                                                       jnp.uint32)
+                nb2 = seg(hp[Mx.ci_halo], Mx.rid_halo,
+                          num_segments=n_local, indices_are_sorted=True)
+                nbest = jnp.maximum(nbest, nb2) if maximize \
+                    else jnp.minimum(nbest, nb2)
+            take = un & ((w > nbest) if maximize else (w < nbest))
+            return jnp.where(take, ncol, colors)
+
+        colors = extract(colors, nc0, True)
+        un1 = jax.lax.psum(jnp.sum((colors < 0).astype(jnp.int32)), axis)
+        colors = extract(colors, nc0 + 1, False)
+        un2 = jax.lax.psum(jnp.sum((colors < 0).astype(jnp.int32)), axis)
+        return colors[None], jnp.stack([un1, un2])
+
+    def fin_body(colors_s, nxt):
+        c = jnp.where(colors_s[0] < 0, nxt, colors_s[0])
+        num = jax.lax.pmax(jnp.max(c), axis) + 1
+        return c[None], num
+
+    init_fn = jax.jit(shard_map(init_body, mesh=mesh, in_specs=(pspec,),
+                                out_specs=P(axis), check_vma=False))
+    step_fn = jax.jit(shard_map(
+        round_body, mesh=mesh, in_specs=(pspec, P(axis), P(), P()),
+        out_specs=(P(axis), P()), check_vma=False))
+    fin_fn = jax.jit(shard_map(
+        fin_body, mesh=mesh, in_specs=(P(axis), P()),
+        out_specs=(P(axis), P()), check_vma=False))
+
+    colors_s = init_fn(M)
+    next_color = 0
+    for rnd in range(max_rounds):
+        colors_s, cnt = step_fn(M, colors_s, jnp.uint32(rnd),
+                                jnp.int32(next_color))
+        after_max, after_min = (int(v) for v in np.asarray(cnt))
+        if after_max == 0:
+            next_color += 1          # min phase was a no-op
+            break
+        next_color += 2
+        if after_min == 0:
+            break
+    colors_s, num = fin_fn(colors_s, jnp.int32(next_color))
+    return colors_s, int(num)
+
+
+def _sharded_dilu_einv(M: ShardMatrix, mesh, axis: str, colors_s,
+                       num_colors: int):
+    """Per-shard DILU E^{-1} recurrence color-by-color with a halo Einv
+    exchange per color (multicolor_dilu_solver.cu:650-810 setup). The
+    reverse-edge value a_ji equals the stored a_ij because the sharded
+    envelope admits only (probe-verified) value-symmetric matrices —
+    the transpose lookup the single-device _match_transpose performs
+    collapses to the owned value. Einv_j is zero until color_j is
+    processed, so the color_j < color_i predicate falls out for free,
+    exactly as in the single-device setup."""
+    n_local = M.n_local
+    pspec = jax.tree.map(lambda _: P(axis), M)
+
+    def body(Ms, cs):
+        Mx = jax.tree.map(lambda a: a[0], Ms)
+        colors = cs[0]
+        d = Mx.diag
+        Einv = jnp.zeros((n_local,), Mx.va_own.dtype)
+        for c in range(num_colors):
+            e = jax.ops.segment_sum(
+                Mx.va_own * Einv[Mx.ci_own] * Mx.va_own, Mx.rid_own,
+                num_segments=n_local, indices_are_sorted=True)
+            if Mx.rid_halo.shape[0]:
+                halo_E = Mx.exchange_halo(Einv)
+                hp = halo_E if Mx.n_halo else jnp.zeros((1,), Einv.dtype)
+                e = e + jax.ops.segment_sum(
+                    Mx.va_halo * hp[Mx.ci_halo] * Mx.va_halo,
+                    Mx.rid_halo, num_segments=n_local,
+                    indices_are_sorted=True)
+            blk = d - e
+            new = jnp.where(blk == 0, 0.0, 1.0 / jnp.where(blk == 0, 1.0,
+                                                           blk))
+            Einv = jnp.where(colors == c, new, Einv)
+        return Einv[None]
+
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(pspec, P(axis)),
+                           out_specs=P(axis), check_vma=False))
+    return fn(M, colors_s)
+
+
+# MIN_MAX-equivalent schemes the sharded coloring reproduces exactly.
+# GREEDY_RECOLOR is deliberately excluded: its single-device form adds
+# a recoloring pass on top of MIN_MAX (ops/coloring.py), which the
+# sharded JPL does not reproduce — it falls back to the global setup.
+_SHARDED_COLORINGS = {"MIN_MAX", "PARALLEL_GREEDY", "LOCALLY_DOWNWIND"}
+
 _SHARDED_SMOOTHERS = {"JACOBI", "BLOCK_JACOBI", "JACOBI_L1", "NOSOLVER",
-                      "DUMMY", "CHEBYSHEV_POLY"}
+                      "DUMMY", "CHEBYSHEV_POLY", "MULTICOLOR_DILU",
+                      "MULTICOLOR_GS"}
 # selector -> matching passes. MULTI_PAIRWISE's entry marks membership
 # only; its real pass count comes from cfg aggregation_passes.
 _SHARDED_SELECTORS = {"SIZE_2": 1, "PARALLEL_GREEDY": 1, "SIZE_4": 2,
@@ -861,13 +1034,22 @@ def sharded_eligible(amg, A) -> Optional[str]:
         return "block systems use the global setup"
     if amg.cycle_name in ("CG", "CGF"):
         return "K-cycles use the global setup"
-    names = {amg.cfg.get_solver("smoother", amg.scope)[0].upper()}
+    pairs = [amg.cfg.get_solver("smoother", amg.scope)]
     if int(amg.cfg.get("fine_levels", amg.scope)) >= 0:
-        names.add(amg.cfg.get_solver("fine_smoother", amg.scope)[0].upper())
-        names.add(amg.cfg.get_solver("coarse_smoother", amg.scope)[0].upper())
-    bad = names - _SHARDED_SMOOTHERS
+        pairs.append(amg.cfg.get_solver("fine_smoother", amg.scope))
+        pairs.append(amg.cfg.get_solver("coarse_smoother", amg.scope))
+    bad = {n.upper() for n, _ in pairs} - _SHARDED_SMOOTHERS
     if bad:
         return f"smoother(s) {sorted(bad)} not row-partitionable"
+    for n, scp in pairs:
+        if n.upper() not in ("MULTICOLOR_DILU", "MULTICOLOR_GS"):
+            continue
+        scheme = str(amg.cfg.get("matrix_coloring_scheme", scp)).upper()
+        if scheme not in _SHARDED_COLORINGS:
+            return (f"coloring scheme {scheme} has no sharded analog "
+                    "(MIN_MAX-family only)")
+        if int(amg.cfg.get("coloring_level", scp)) != 1:
+            return "sharded coloring supports coloring_level=1 only"
     if float(amg.cfg.get("error_scaling", amg.scope)):
         return "error_scaling uses the global setup"
     return None
@@ -1147,7 +1329,7 @@ def build_sharded_hierarchy(amg, shard_A: ShardMatrix, mesh, axis: str):
         P_sh = _mk_shard(P_f, n_g0, M.n_local, NCL_c, sizes[3], R, axis)
         R_sh = _mk_shard(R_f, R * NCL_c, NCL_c, M.n_local, sizes[4], R,
                          axis)
-        level = DistAMGLevel(M, lvl)
+        level = DistAMGLevel(M, lvl, offsets=np.asarray(offsets))
         levels.append(level)
         levels_data.append({"A": M, "P": P_sh, "R": R_sh})
         offsets_last, ncl_last = offsets_c, NCL_c
@@ -1177,8 +1359,12 @@ def _finish_sharded(amg, mesh, axis, M, offsets, lvl, levels,
         name, scp = assign(k)
         lv.smoother = make_solver(name, cfg, scp)
         lv.smoother._owns_scaling = False
+        # duck-typed operator view: color-sweep smoothers read static
+        # metadata (is_block, block_dimx) off self.A at trace time
+        lv.smoother.A = levels_data[k]["A"]
         levels_data[k]["smoother"] = _smoother_data(
-            name.upper(), levels_data[k]["A"], lv.smoother)
+            name.upper(), levels_data[k]["A"], lv.smoother,
+            mesh=mesh, axis=axis, offsets=lv.offsets)
     tail_data = []
     for k in range(boundary, len(amg.levels)):
         lv = amg.levels[k]

@@ -529,7 +529,7 @@ def run_classical_levels(amg, mesh, axis: str, M: ShardMatrix, offsets,
         A_c = _mk_shard(A_c_f, R * NCL_c, NCL_c, NCL_c, H_c, R, axis)
         P_sh = _mk_shard(P_f, n, M.n_local, NCL_c, H_p, R, axis)
         R_sh = _mk_shard(R_f, R * NCL_c, NCL_c, M.n_local, H_r, R, axis)
-        levels.append(DistAMGLevel(M, lvl))
+        levels.append(DistAMGLevel(M, lvl, offsets=np.asarray(offsets)))
         levels_data.append({"A": M, "P": P_sh, "R": R_sh})
         offsets_last, ncl_last = offsets_c, NCL_c
         M, offsets = A_c, offsets_c

@@ -95,6 +95,8 @@ class DistributedSolver:
                 f"{self.n_ranks}")
         self.shard_A = shard_matrix_from_partition(part, self.axis)
         self.part = part
+        self._upload_user_colors = (A is not None
+                                    and A.user_colors is not None)
         # wire the solver chain: A views + per-shard Jacobi data. AMG
         # members build their hierarchy SHARDED when the config supports
         # it (distributed/setup.py — per-rank level build, no global
@@ -147,6 +149,20 @@ class DistributedSolver:
         if mode == "global":
             return None
         reason = sharded_eligible(s.amg, self.shard_A)
+        if reason is None and getattr(self, "_upload_user_colors", False):
+            names = {s.amg.cfg.get_solver(k, s.amg.scope)[0].upper()
+                     for k in ("smoother", "fine_smoother",
+                               "coarse_smoother")}
+            if any(n.startswith("MULTICOLOR") or n == "FIXCOLOR_GS"
+                   for n in names):
+                # a user-attached coloring (AMGX_matrix_attach_coloring)
+                # must drive the color-sweep smoothers; the sharded
+                # setup always runs its own JPL — fall back so the
+                # attached colors are honored (single-device _color()
+                # semantics). Jacobi-family smoothers never read
+                # colors, so they stay sharded-eligible.
+                reason = ("user-attached matrix coloring requires the "
+                          "global setup")
         # aggregation decisions need |a_ji| == |a_ij|; the classical
         # reverse-edge strength additionally uses the owned value's
         # SIGN as the transpose proxy, so it needs signed symmetry
@@ -210,7 +226,13 @@ class DistributedSolver:
             out_specs=P(), check_vma=False))
         s1, s2 = (float(v) for v in fn(self.shard_A, xl, yl))
         scale = max(abs(s1), abs(s2), 1e-300)
-        return abs(s1 - s2) <= 1e-10 * scale
+        # dot-product rounding grows ~sqrt(n)*eps in the VALUE dtype:
+        # a fixed 1e-10 would fail genuinely symmetric f32 systems
+        vdt = np.dtype(self.shard_A.va_own.dtype)
+        if vdt.kind != "f":
+            vdt = np.dtype(np.float64)
+        tol = max(1e-10, 100.0 * np.sqrt(n) * np.finfo(vdt).eps)
+        return abs(s1 - s2) <= tol * scale
 
     def _build_data(self):
         """Hand-build the solve-data pytree (stacked arrays); per-shard

@@ -59,7 +59,7 @@ def poisson(points: str, nx: int, ny: int = 1, nz: int = 1,
     row_offsets = np.zeros(n + 1, np.int32)
     np.cumsum(counts, out=row_offsets[1:])
     A = CsrMatrix.from_scipy_like(row_offsets, cols.astype(np.int32),
-                                  jnp.asarray(vals), n, n)
+                                  vals, n, n)
     # structured-grid annotation: lets the GEO aggregation selector keep
     # every coarse level banded (DIA) instead of falling to gather paths
     import dataclasses
@@ -135,5 +135,5 @@ def random_matrix(n: int, max_nnz_per_row: int = 8, seed: int = 0,
     row_offsets = np.zeros(n + 1, np.int32)
     np.cumsum(counts, out=row_offsets[1:])
     return CsrMatrix.from_scipy_like(row_offsets, cols.astype(np.int32),
-                                     jnp.asarray(vals), n, n,
+                                     vals, n, n,
                                      block_dims=block_dims)

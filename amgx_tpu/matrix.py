@@ -28,20 +28,24 @@ import numpy as np
 
 from .errors import BadParametersError
 
-# device array -> the host numpy original it was created from. Real AmgX
-# matrices always originate on the host (uploads, readers, gallery); the
-# host-CPU setup path (amg_host_setup) reads them back, and on a
+# id(device array) -> the host numpy original it was created from. Real
+# AmgX matrices always originate on the host (uploads, readers, gallery);
+# the host-CPU setup path (amg_host_setup) reads them back, and on a
 # tunneled accelerator that pull costs ~10 s at 128^3 — retaining the
-# upload-side original makes it free. Weak keys: the mirror dies with
-# the device array.
-_HOST_MIRROR: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+# upload-side original makes it free. jax ArrayImpl is weakref-able but
+# NOT hashable, so the mirror is keyed by id() with weakref.finalize
+# eviction (the entry dies with the device array, and the finalizer
+# guards against id reuse).
+_HOST_MIRROR: dict = {}
 
 
 def _register_host_mirror(dev_arr, np_arr):
     try:
-        _HOST_MIRROR[dev_arr] = np_arr
+        key = id(dev_arr)
+        weakref.finalize(dev_arr, _HOST_MIRROR.pop, key, None)
     except TypeError:  # pragma: no cover - non-weakrefable array type
-        pass
+        return
+    _HOST_MIRROR[key] = np_arr
 
 
 def host_mirror_asarray(x):
@@ -49,10 +53,7 @@ def host_mirror_asarray(x):
     uploaded from host data (no accelerator->host transfer)."""
     if isinstance(x, np.ndarray):
         return x
-    try:
-        m = _HOST_MIRROR.get(x)
-    except TypeError:
-        m = None
+    m = _HOST_MIRROR.get(id(x))
     return m if m is not None else np.asarray(x)
 
 
@@ -198,6 +199,10 @@ class CsrMatrix:
         if not self.is_block and host_resident(
                 self.row_offsets, self.col_indices, self.values):
             return self._init_host(ell, ell_max_ratio)
+        if not self.is_block:
+            out = self._init_from_mirrors(ell, ell_max_ratio)
+            if out is not None:
+                return out
         row_nnz = jnp.diff(self.row_offsets)
         row_ids = jnp.repeat(
             jnp.arange(n, dtype=jnp.int32), row_nnz,
@@ -221,6 +226,50 @@ class CsrMatrix:
             self, row_ids=row_ids, diag_idx=diag_idx,
             ell_cols=ell_cols, ell_vals=ell_vals,
             dia_offsets=dia_offsets, dia_vals=dia_vals, initialized=True)
+
+    def _init_from_mirrors(self, ell: str,
+                           ell_max_ratio: float) -> "Optional[CsrMatrix]":
+        """init() for an accelerator matrix whose base arrays retain
+        host mirrors (every host-originated upload does): build the
+        SpMV auxiliaries host-side in numpy and ship the finished
+        layout in a few large contiguous puts. The alternative — eager
+        per-op init on a tunneled accelerator — costs one remote
+        compile per op (~100 s at 128^3) and litters HBM with eager
+        temporaries that degrade every later transfer (measured:
+        device_put drops ~30x after an eager device init)."""
+        import jax as _jax
+        m_ro = _HOST_MIRROR.get(id(self.row_offsets))
+        m_ci = _HOST_MIRROR.get(id(self.col_indices))
+        m_va = _HOST_MIRROR.get(id(self.values))
+        m_dg = (None if self.diag is None
+                else _HOST_MIRROR.get(id(self.diag)))
+        if m_ro is None or m_ci is None or m_va is None or \
+                (self.diag is not None and m_dg is None):
+            return None
+        try:
+            dev = next(iter(self.values.devices()))
+        except Exception:
+            return None
+        host = dataclasses.replace(
+            self, row_offsets=m_ro, col_indices=m_ci, values=m_va,
+            diag=m_dg)._init_host(ell, ell_max_ratio)
+
+        def up(x):
+            if x is None or not hasattr(x, "dtype"):
+                return x
+            x = np.ascontiguousarray(x)
+            d = _jax.device_put(x, dev)
+            _register_host_mirror(d, x)
+            return d
+
+        return dataclasses.replace(
+            self, row_ids=up(host.row_ids), diag_idx=up(host.diag_idx),
+            ell_cols=up(host.ell_cols), ell_vals=up(host.ell_vals),
+            dia_offsets=host.dia_offsets, dia_vals=up(host.dia_vals),
+            swell_cols=up(host.swell_cols), swell_vals=up(host.swell_vals),
+            swell_c0row=up(host.swell_c0row),
+            swell_nchunk=up(host.swell_nchunk),
+            swell_w128=host.swell_w128, initialized=True)
 
     def _init_host(self, ell: str, ell_max_ratio: float) -> "CsrMatrix":
         """Numpy form of init() for host-resident scalar matrices — same

@@ -227,6 +227,52 @@ long long amgx_d2_build(
     std::vector<uint8_t> row_keep;
     std::vector<size_t> row_rank;
 
+    // Pre-filtered per-row sublists, built once in O(nnz): the two-hop
+    // loops below re-scan each strong-F neighbour's full row up to
+    // three times per fine row; on D2 operators most entries fail the
+    // filter every time. strongC = entries with strong && C (feeds the
+    // C-hat stamping); neg = in-graph off-diagonal entries with
+    // vals*sgn(k) < 0 (feeds the distribution sums). Entry order is
+    // preserved, so the float accumulation order — and the emitted P —
+    // is bit-identical to the unfiltered sweeps.
+    std::vector<int64_t> sc_off(static_cast<size_t>(n) + 1, 0);
+    std::vector<int64_t> ng_off(static_cast<size_t>(n) + 1, 0);
+    for (int32_t k = 0; k < n; ++k) {
+        int64_t csc = 0, cng = 0;
+        const double sk = sgn[static_cast<size_t>(k)];
+        for (int32_t f = ro[k]; f < ro[k + 1]; ++f) {
+            const int32_t l = ci[f];
+            if (l < 0 || l >= n) continue;
+            if (strong[f] && cf[l] == COARSE) ++csc;
+            if (l != k && vals[f] * sk < 0.0) ++cng;
+        }
+        sc_off[static_cast<size_t>(k) + 1] =
+            sc_off[static_cast<size_t>(k)] + csc;
+        ng_off[static_cast<size_t>(k) + 1] =
+            ng_off[static_cast<size_t>(k)] + cng;
+    }
+    std::vector<int32_t> sc_col(static_cast<size_t>(sc_off[n]));
+    std::vector<int32_t> ng_col(static_cast<size_t>(ng_off[n]));
+    std::vector<double> ng_val(static_cast<size_t>(ng_off[n]));
+    {
+        std::vector<int64_t> ps = sc_off, pn = ng_off;
+        for (int32_t k = 0; k < n; ++k) {
+            const double sk = sgn[static_cast<size_t>(k)];
+            for (int32_t f = ro[k]; f < ro[k + 1]; ++f) {
+                const int32_t l = ci[f];
+                if (l < 0 || l >= n) continue;
+                if (strong[f] && cf[l] == COARSE)
+                    sc_col[static_cast<size_t>(
+                        ps[static_cast<size_t>(k)]++)] = l;
+                if (l != k && vals[f] * sk < 0.0) {
+                    const int64_t t = pn[static_cast<size_t>(k)]++;
+                    ng_col[static_cast<size_t>(t)] = l;
+                    ng_val[static_cast<size_t>(t)] = vals[f];
+                }
+            }
+        }
+    }
+
     for (int32_t i = 0; i < n; ++i) {
         res->ptr[static_cast<size_t>(i)] =
             static_cast<int64_t>(res->col.size());
@@ -237,21 +283,17 @@ long long amgx_d2_build(
         }
         // C-hat_i: strong C neighbours + strong-C neighbours of strong-F
         // neighbours (all members are C points)
-        for (int32_t e = ro[i]; e < ro[i + 1]; ++e) {
-            const int32_t j = ci[e];
-            if (j < 0 || j >= n) continue;  // halo/rectangular column
-            if (strong[e] && cf[j] == COARSE) stamp[static_cast<size_t>(j)] = i;
-        }
+        for (int64_t t = sc_off[static_cast<size_t>(i)];
+             t < sc_off[static_cast<size_t>(i) + 1]; ++t)
+            stamp[static_cast<size_t>(sc_col[static_cast<size_t>(t)])] = i;
         for (int32_t e = ro[i]; e < ro[i + 1]; ++e) {
             const int32_t k = ci[e];
             if (k < 0 || k >= n) continue;
             if (!(strong[e] && cf[k] == FINE && k != i)) continue;
-            for (int32_t f = ro[k]; f < ro[k + 1]; ++f) {
-                const int32_t l = ci[f];
-                if (l < 0 || l >= n) continue;
-                if (strong[f] && cf[l] == COARSE)
-                    stamp[static_cast<size_t>(l)] = i;
-            }
+            for (int64_t t = sc_off[static_cast<size_t>(k)];
+                 t < sc_off[static_cast<size_t>(k) + 1]; ++t)
+                stamp[static_cast<size_t>(
+                    sc_col[static_cast<size_t>(t)])] = i;
         }
         touched.clear();
         double D = diag[static_cast<size_t>(i)];
@@ -276,33 +318,33 @@ long long amgx_d2_build(
             if (in_chat && cf[j] == COARSE) acc_add(j, vals[e]);
             if (!in_chat && !strong_f) D += vals[e];
         }
-        // two-hop terms through strong F neighbours
+        // two-hop terms through strong F neighbours (the negative
+        // in-graph sublist of row k is exactly the entry set the
+        // original full-row scans kept — same entries, same order)
         for (int32_t e = ro[i]; e < ro[i + 1]; ++e) {
             const int32_t k = ci[e];
             if (k < 0 || k >= n) continue;
             if (!(strong[e] && cf[k] == FINE && k != i)) continue;
             const double aik = vals[e];
-            const double sk = sgn[static_cast<size_t>(k)];
+            const int64_t f0 = ng_off[static_cast<size_t>(k)];
+            const int64_t f1 = ng_off[static_cast<size_t>(k) + 1];
             double d = 0.0;
-            for (int32_t f = ro[k]; f < ro[k + 1]; ++f) {
-                const int32_t l = ci[f];
-                if (l < 0 || l >= n) continue;
-                if (l == k || !(vals[f] * sk < 0.0)) continue;
+            for (int64_t f = f0; f < f1; ++f) {
+                const int32_t l = ng_col[static_cast<size_t>(f)];
                 if (stamp[static_cast<size_t>(l)] == i || l == i)
-                    d += vals[f];
+                    d += ng_val[static_cast<size_t>(f)];
             }
             if (d == 0.0) {  // k distributes nowhere: lump a_ik
                 D += aik;
                 continue;
             }
-            for (int32_t f = ro[k]; f < ro[k + 1]; ++f) {
-                const int32_t l = ci[f];
-                if (l < 0 || l >= n) continue;
-                if (l == k || !(vals[f] * sk < 0.0)) continue;
+            for (int64_t f = f0; f < f1; ++f) {
+                const int32_t l = ng_col[static_cast<size_t>(f)];
+                const double v = ng_val[static_cast<size_t>(f)];
                 if (l == i)
-                    D += aik * vals[f] / d;  // "+i" feedback
+                    D += aik * v / d;  // "+i" feedback
                 else if (stamp[static_cast<size_t>(l)] == i)
-                    acc_add(l, aik * vals[f] / d);
+                    acc_add(l, aik * v / d);
             }
         }
         std::sort(touched.begin(), touched.end());

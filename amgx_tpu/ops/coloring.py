@@ -58,10 +58,72 @@ def _sym_edges(A: CsrMatrix):
     return r[order], c[order]
 
 
+def _hash_w_np(n, salt: int):
+    i = np.arange(n, dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        h = (i + np.uint32((salt * 0x9E3779B9) & 0xFFFFFFFF)) * \
+            np.uint32(2654435761)
+        h = (h ^ (h >> 15)) * np.uint32(0x85EBCA6B)
+        h = h ^ (h >> 13)
+    return h
+
+
+def _jpl_min_max_np(n: int, sr, sc, max_rounds: int, use_min: bool):
+    """Host (numpy) twin of the JPL fixed point below — identical hash,
+    round structure, and straggler handling, so colors are bit-equal.
+    The host-setup hierarchy build (amg_host_setup) runs smoother
+    setup on numpy-backed matrices; one eager XLA:CPU dispatch per
+    round per color would otherwise dominate the whole classical setup
+    (measured: ~minutes at 96^3)."""
+    order = np.argsort(sr, kind="stable")
+    sr, sc = sr[order], sc[order]
+    ro = np.zeros(n + 1, np.int64)
+    np.cumsum(np.bincount(sr, minlength=n), out=ro[1:])
+    colors = np.full(n, -1, np.int32)
+    has_nbr = np.zeros(n, bool)
+    has_nbr[sr] = True
+    colors[~has_nbr] = 0
+    next_color = 0
+
+    def extract(colors, w, ncol, maximize):
+        from ..matrix import _np_row_reduce
+        un = colors < 0
+        fill = np.uint32(0) if maximize else np.uint32(0xFFFFFFFF)
+        wm = np.where(un, w, fill)
+        op = np.maximum if maximize else np.minimum
+        nbest = _np_row_reduce(op, wm[sc], ro, n, fill)
+        take = un & ((w > nbest) if maximize else (w < nbest))
+        colors[take] = ncol
+
+    for rnd in range(max_rounds):
+        if not (colors < 0).any():
+            break
+        w = _hash_w_np(n, rnd)
+        extract(colors, w, next_color, True)
+        next_color += 1
+        if use_min:
+            if not (colors < 0).any():
+                break
+            extract(colors, w, next_color, False)
+            next_color += 1
+    colors[colors < 0] = next_color
+    num = int(colors.max()) + 1 if n else 0
+    return Coloring(jnp.asarray(colors), num)
+
+
 def _jpl_min_max(A: CsrMatrix, max_rounds: int = 64, use_min: bool = True,
                  edges=None):
     """Jones-Plassmann-Luby with (max, min) extraction per round."""
+    from ..matrix import host_resident
     n = A.num_rows
+    if edges is None and host_resident(A.row_offsets, A.col_indices):
+        ro = np.asarray(A.row_offsets)
+        ci = np.asarray(A.col_indices)
+        rows = np.repeat(np.arange(n, dtype=np.int32), np.diff(ro))
+        offd = rows != ci
+        sr = np.concatenate([rows[offd], ci[offd]])
+        sc = np.concatenate([ci[offd], rows[offd]])
+        return _jpl_min_max_np(n, sr, sc, max_rounds, use_min)
     sr, sc = _sym_edges(A) if edges is None else edges
     colors = jnp.full((n,), -1, jnp.int32)
     has_nbr = jnp.zeros((n,), bool).at[sr].set(True)

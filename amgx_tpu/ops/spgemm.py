@@ -144,7 +144,8 @@ def galerkin_rap(R: CsrMatrix, A: CsrMatrix, P: CsrMatrix) -> CsrMatrix:
     if not (A.is_block or R.has_external_diag or A.has_external_diag
             or P.has_external_diag) and _on_host(A) and _on_host(R) \
             and _on_host(P) and np.asarray(A.values).dtype.kind == "f" \
-            and np.asarray(P.values).dtype.kind == "f":
+            and np.asarray(P.values).dtype.kind == "f" \
+            and np.asarray(R.values).dtype.kind == "f":
         from .. import native
         out = native.rap_native(
             R.num_rows, A.num_rows, P.num_cols,

@@ -52,6 +52,31 @@ from .base import Solver
 from .relaxation import _apply_dinv, l1_strengthened_diag, safe_recip
 
 
+def _match_transpose_np(A: CsrMatrix):
+    """Host twin of _match_transpose (scalar matrices): numpy int64-key
+    searchsorted. CSR keys are already sorted when columns are sorted
+    in-row (the host hierarchy build's invariant), so the argsort is
+    usually skipped entirely — the device form's eager int64 argsort
+    was the single hottest op of the host smoother setup."""
+    import numpy as np
+    ro = np.asarray(A.row_offsets)
+    cols = np.asarray(A.col_indices).astype(np.int64)
+    vals = np.asarray(A.values)
+    rows = np.repeat(np.arange(A.num_rows, dtype=np.int64), np.diff(ro))
+    keys = rows * A.num_cols + cols
+    if np.all(keys[1:] >= keys[:-1]):
+        order = None
+        skeys = keys
+    else:
+        order = np.argsort(keys, kind="stable")
+        skeys = keys[order]
+    want = cols * A.num_cols + rows
+    pos = np.clip(np.searchsorted(skeys, want), 0, max(keys.shape[0] - 1, 0))
+    found = skeys[pos] == want
+    src = pos if order is None else order[pos]
+    return np.where(found, vals[src], 0.0)
+
+
 def _match_transpose(A: CsrMatrix):
     """For every CSR entry (i,j) return the value of (j,i), or 0 when the
     pattern has no such entry (the reference's warp search over row j,
@@ -217,8 +242,35 @@ class MulticolorDILUSolver(_ColoredSolver):
     """
 
     def solver_setup(self):
+        from ..matrix import host_resident
         self._color()
         A = self.A
+        if not A.is_block and host_resident(A.row_offsets, A.col_indices,
+                                            A.values):
+            # host fast path (amg_host_setup hierarchies): the whole
+            # color recurrence in synchronous numpy — the eager
+            # per-color XLA:CPU dispatches and the int64-key argsort
+            # dominated the classical setup otherwise
+            import numpy as onp
+            ro = onp.asarray(A.row_offsets)
+            vals = onp.asarray(A.values)
+            n = A.num_rows
+            cols = onp.asarray(A.col_indices)
+            at_vals = _match_transpose_np(A)
+            d = onp.asarray(A.diagonal())
+            colors = onp.asarray(self.row_colors)
+            Einv = onp.zeros(n, vals.dtype)
+            from ..matrix import _np_row_reduce
+            prod = vals * at_vals
+            for c in range(self.num_colors):
+                e = _np_row_reduce(onp.add, prod * Einv[cols], ro, n, 0.0)
+                blk = d - e
+                new = onp.divide(1.0, blk,
+                                 out=onp.zeros_like(blk),
+                                 where=blk != 0)
+                Einv = onp.where(colors == c, new, Einv)
+            self._Einv = Einv
+            return
         rows, cols, vals = A.coo()
         at_vals = _match_transpose(A)
         d = A.diagonal()

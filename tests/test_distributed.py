@@ -171,11 +171,45 @@ class TestDistSolve:
         r = np.asarray(A.init().to_dense()) @ np.asarray(res.x) - b
         assert np.linalg.norm(r) < 1e-8
 
-    def test_unsupported_precond_rejected(self, mesh):
+    def test_strong_precond_admitted_data_driven(self, mesh):
+        """The preconditioner envelope is data-driven: MULTICOLOR_ILU is
+        admitted when its solve-data partitions row-wise (construction
+        no longer rejects by name; setup() shards the triangular
+        factors as halo-exchanging shards)."""
+        A = gallery.poisson5pt(12, 12)
+        b = np.ones(A.num_rows)
+        cfg = Config.from_string(
+            "solver=PCG, max_iters=200, monitor_residual=1,"
+            " tolerance=1e-8, preconditioner(ilu)=MULTICOLOR_ILU")
+        ds = DistributedSolver(cfg, mesh)   # must NOT raise
+        ds.setup(A)
+        res = ds.solve(b)
+        assert res.converged
+        r = np.asarray(A.init().to_dense()) @ np.asarray(res.x) - b
+        assert np.linalg.norm(r) < 1e-6
+
+    def test_precond_from_pieces_rejected_at_setup(self, mesh):
+        """Setting up a global-matrix-needing preconditioner from
+        per-rank pieces (no controller-global A) raises at setup()."""
+        from amgx_tpu.distributed.partition import partition_from_pieces
+        A = gallery.poisson5pt(12, 12).init()
         cfg = Config.from_string(
             "solver=PCG, preconditioner(ilu)=MULTICOLOR_ILU")
+        ds = DistributedSolver(cfg, mesh)
+        n_ranks = int(mesh.devices.size)
+        ro = np.asarray(A.row_offsets)
+        ci = np.asarray(A.col_indices)
+        va = np.asarray(A.values)
+        n_local = -(-A.num_rows // n_ranks)
+        pieces = []
+        for r in range(n_ranks):
+            lo = min(r * n_local, A.num_rows)
+            hi = min(lo + n_local, A.num_rows)
+            s, e = int(ro[lo]), int(ro[hi])
+            pieces.append((ro[lo:hi + 1] - ro[lo], ci[s:e], va[s:e]))
+        part = partition_from_pieces(pieces, A.num_rows)
         with pytest.raises(amgx.errors.AMGXError):
-            DistributedSolver(cfg, mesh)
+            ds.setup_from_partition(part)
 
 
 # ---------------------------------------------------------------------------

@@ -156,11 +156,23 @@ class TestShardedSetupFallback:
     def test_mode_sharded_rejects_unsupported_smoother(self):
         A = _poisson()
         mesh = default_mesh(N_DEV)
+        # MULTICOLOR_ILU's triangular factors do not build per-shard
         cfg = Config.from_string(
-            BASE + ", amg:smoother=MULTICOLOR_DILU,"
+            BASE + ", amg:smoother=MULTICOLOR_ILU,"
             " amg:distributed_setup_mode=sharded")
         d = DistributedSolver(cfg, mesh)
         with pytest.raises(BadParametersError, match="row-partitionable"):
+            d.setup(A)
+
+    def test_mode_sharded_rejects_non_minmax_coloring(self):
+        A = _poisson()
+        mesh = default_mesh(N_DEV)
+        cfg = Config.from_string(
+            BASE + ", amg:smoother(sm)=MULTICOLOR_DILU,"
+            " sm:matrix_coloring_scheme=MULTI_HASH,"
+            " amg:distributed_setup_mode=sharded")
+        d = DistributedSolver(cfg, mesh)
+        with pytest.raises(BadParametersError, match="coloring scheme"):
             d.setup(A)
 
     def test_auto_uses_sharded_when_supported(self):
@@ -216,6 +228,61 @@ def test_sharded_chebyshev_poly_smoother():
     assert bool(r2.converged)
     assert int(r1.iterations) == int(r2.iterations)
     assert _n_sharded_levels(d) >= 1
+
+
+class TestShardedStrongSmoothers:
+    """MULTICOLOR_DILU / MULTICOLOR_GS built per-shard (VERDICT-r4 #1):
+    the sharded JPL coloring hashes SEMANTIC global ids with a halo
+    color-state exchange each round (boundary_coloring=SYNC_COLORS,
+    src/core.cu:353-354), so colors — and hence the DILU Einv
+    recurrence (multicolor_dilu_solver.cu:650-810) — are bit-identical
+    to the single-device setup: iteration counts must MATCH."""
+
+    @pytest.mark.parametrize("extra", [
+        ", amg:smoother=MULTICOLOR_DILU, amg:relaxation_factor=0.9",
+        ", amg:smoother=MULTICOLOR_GS, amg:relaxation_factor=0.9",
+        ", amg:smoother=MULTICOLOR_GS, amg:relaxation_factor=0.9,"
+        " amg:symmetric_GS=1",
+    ])
+    def test_sharded_setup_parity(self, extra):
+        A = _poisson()
+        s, r1 = _solve_single(A, extra)
+        d, r2 = _solve_dist(A, "sharded", extra)
+        assert bool(r1.converged) and bool(r2.converged)
+        assert int(r1.iterations) == int(r2.iterations)
+        assert _n_sharded_levels(d) >= 1
+
+    def test_sharded_coloring_matches_single_device(self):
+        """The per-shard coloring IS the single-device MIN_MAX coloring
+        (semantic-id hashing): per-row colors equal after reassembly."""
+        from amgx_tpu.distributed.setup import sharded_coloring
+        from amgx_tpu.distributed.partition import partition_matrix
+        from amgx_tpu.distributed.dist_matrix import \
+            shard_matrix_from_partition
+        from amgx_tpu.ops.coloring import color_matrix
+        A = _poisson()
+        ref = color_matrix(A, Config.from_string("config_version=2"))
+        mesh = default_mesh(N_DEV)
+        part = partition_matrix(A, N_DEV)
+        M = shard_matrix_from_partition(part, mesh.axis_names[0])
+        offsets = np.minimum(np.arange(N_DEV + 1) * part.n_local,
+                             A.num_rows).astype(np.int32)
+        colors_s, nc = sharded_coloring(M, mesh, mesh.axis_names[0],
+                                        offsets)
+        got = np.asarray(colors_s).reshape(-1)[: A.num_rows]
+        assert nc == ref.num_colors
+        np.testing.assert_array_equal(got, np.asarray(ref.row_colors))
+
+    def test_dilu_classical_sharded_parity(self):
+        A = gallery.poisson("7pt", 16, 16, 16).init()
+        extra = (", amg:algorithm=CLASSICAL, amg:selector=PMIS,"
+                 " amg:interpolator=D1, amg:smoother=MULTICOLOR_DILU,"
+                 " amg:relaxation_factor=0.9, amg:amg_host_setup=never")
+        s, r1 = _solve_single(A, extra)
+        d, r2 = _solve_dist(A, "sharded", extra)
+        assert bool(r2.converged)
+        assert int(r1.iterations) == int(r2.iterations)
+        assert _n_sharded_levels(d) >= 1
 
 
 CLS_BASE = ("config_version=2, solver(s)=FGMRES, s:max_iters=60,"

@@ -62,6 +62,23 @@ class TestMatrix:
         A2 = A.with_values(A.values * 2.0)
         assert np.allclose(dense_of(A2), 2 * dense_of(A))
 
+    def test_host_mirror_hit_and_eviction(self):
+        # the mirror must actually store (jax ArrayImpl is unhashable,
+        # so a WeakKeyDictionary would silently drop every entry) and
+        # must evict when the device array dies
+        import gc
+        from amgx_tpu.matrix import (_HOST_MIRROR, _register_host_mirror,
+                                     host_mirror_asarray)
+        src = np.arange(8, dtype=np.float64)
+        dev = jnp.asarray(src)
+        before = len(_HOST_MIRROR)
+        _register_host_mirror(dev, src)
+        assert len(_HOST_MIRROR) == before + 1
+        assert host_mirror_asarray(dev) is src     # no device pull
+        del dev
+        gc.collect()
+        assert len(_HOST_MIRROR) == before         # finalizer evicted
+
 
 class TestSpmv:
     @pytest.mark.parametrize("stencil,dims", [("5pt", (7, 5, 1)),
