@@ -151,6 +151,9 @@ class AMG:
         self._data_cache = None
         self._put_cache = {}
         self._l0_seed = None     # dropped unless this setup re-registers
+        self._resetup_precast = None
+        self._vr_plan = None     # value-resetup plan re-derives lazily
+        self._last_resetup_value_only = False
         host = self._host_setup_device(A)
         if host is not None:
             # decide BEFORE init: the SpMV-layout build is itself eager
@@ -267,6 +270,15 @@ class AMG:
         if reuse == 0 or not self.levels or \
                 A.num_rows != self.levels[0].A.num_rows:
             return self.setup(A)
+        self._last_resetup_value_only = False
+        if (reuse < 0 or reuse >= len(self.levels)) \
+                and self._ship_device is None:
+            from .value_resetup import try_value_resetup
+            from ..profiling import trace_region
+            with trace_region("amg.value_resetup"):
+                if try_value_resetup(self, A):
+                    self._last_resetup_value_only = True
+                    return self
         self._data_cache = None
         if self._ship_device is not None:
             host = jax.devices("cpu")[0]
@@ -287,6 +299,8 @@ class AMG:
         t0 = time.perf_counter()
         k = len(self.levels) if reuse < 0 else min(reuse, len(self.levels))
         old_levels, self.levels = self.levels, []
+        self._resetup_precast = None
+        self._vr_plan = None
         self._put_cache = {}
         self._seed_put_cache()
         from .aggregation.galerkin import (deferred_wrap_checks,
@@ -497,11 +511,16 @@ class AMG:
             # Krylov outer loop — on TPU this halves (or quarters) HBM
             # traffic and turns on the f32 Pallas SpMV kernels
             memo = {}
+            pre = getattr(self, "_resetup_precast", None) or {}
 
             def cast(leaf):
                 key = id(leaf)
                 if key not in memo:
-                    memo[key] = (leaf, self._cast_leaf(leaf))
+                    # the one-dispatch value-resetup emits the reduced-
+                    # precision twins inside its own program; reuse them
+                    # instead of dispatching a fresh astype per leaf
+                    memo[key] = (leaf, pre[key] if key in pre
+                                 else self._cast_leaf(leaf))
                 return memo[key][1]
             data = jax.tree.map(cast, data)
         return data

@@ -25,6 +25,11 @@ class AlgebraicMultigridSolver(Solver):
     def solver_resetup(self):
         self.amg.resetup(self.A)
 
+    def _resetup_kept_static(self):
+        # the hierarchy's depth/level shapes depend on the values; only
+        # the fused value-only resetup guarantees they were kept
+        return bool(getattr(self.amg, "_last_resetup_value_only", False))
+
     def solve_data(self):
         d = super().solve_data()
         d["amg"] = self.amg.solve_data()

@@ -57,6 +57,35 @@ def host_mirror_asarray(x):
     return m if m is not None else np.asarray(x)
 
 
+def host_arrays(*arrays):
+    """numpy views of the given arrays with NO accelerator->host
+    transfer: numpy / CPU-resident arrays pass through, accelerator
+    arrays resolve via the retained host mirror. Returns None when any
+    array cannot be served host-side (callers fall back to the device
+    path). This is what lets setup-phase index math run in synchronous
+    numpy even when the user's matrix lives on the TPU."""
+    out = []
+    for a in arrays:
+        if a is None:
+            out.append(None)
+            continue
+        if isinstance(a, np.ndarray):
+            out.append(a)
+            continue
+        m = _HOST_MIRROR.get(id(a))
+        if m is not None:
+            out.append(m)
+            continue
+        try:
+            if next(iter(a.devices())).platform == "cpu":
+                out.append(np.asarray(a))
+                continue
+        except Exception:
+            pass
+        return None
+    return out
+
+
 def lexsort_rc(rows, cols):
     """Stable (rows, cols)-lexicographic order via two int32 argsorts.
 
@@ -528,9 +557,7 @@ class CsrMatrix:
             out = dataclasses.replace(
                 out, ell_vals=out._scatter_ell_vals(flat, max_k))
         if self.initialized and self.dia_offsets is not None:
-            out = dataclasses.replace(
-                out, dia_vals=out._build_dia_vals(self.dia_offsets,
-                                                  self.row_ids))
+            out = out._refill_dia(values)
         if self.initialized and self.swell_cols is not None:
             if host_resident(self.row_offsets, values):
                 from .ops.pallas_swell import swell_vals_host
@@ -545,6 +572,55 @@ class CsrMatrix:
                     out, swell_cols=None, swell_vals=None,
                     swell_c0row=None, swell_nchunk=None, swell_w128=0)
         return out
+
+    def _refill_dia(self, values) -> "CsrMatrix":
+        """Values-only DIA refill for replace_coefficients. With host
+        (numpy) values and mirror-backed structure the scatter runs in
+        numpy and ships as one put — the eager device scatter-add +
+        searchsorted chain costs seconds per resetup over a tunnel
+        (the same economics as _init_from_mirrors)."""
+        def host_of(a):
+            if isinstance(a, np.ndarray):
+                return a
+            return _HOST_MIRROR.get(id(a))
+
+        ro = host_of(self.row_offsets)
+        ci = host_of(self.col_indices)
+        if isinstance(values, np.ndarray) and ro is not None \
+                and ci is not None and not np.iscomplexobj(values):
+            from .ops.pallas_spmv import LANES, dia_padded_rows
+            k = len(self.dia_offsets)
+            n = self.num_rows
+            row_ids = np.repeat(np.arange(n, dtype=np.int64),
+                                np.diff(ro))
+            offs = np.asarray(self.dia_offsets, np.int64)
+            d_idx = np.searchsorted(offs, ci.astype(np.int64) - row_ids)
+            rows_pad = dia_padded_rows(k, n)
+            flat = np.bincount(d_idx * (rows_pad * LANES) + row_ids,
+                               weights=values,
+                               minlength=k * rows_pad * LANES)
+            dia_np = flat.astype(values.dtype).reshape(k, rows_pad,
+                                                       LANES)
+            # device of the (unchanged) structure arrays — the new
+            # values may be host numpy at this point
+            try:
+                dev = next(iter(self.row_offsets.devices()))
+                on_accel = dev.platform != "cpu"
+            except Exception:
+                on_accel = False
+            if on_accel:
+                import jax as _jax
+                vals_c = np.ascontiguousarray(values)
+                d_vals = _jax.device_put(vals_c, dev)
+                _register_host_mirror(d_vals, vals_c)
+                d_dia = _jax.device_put(dia_np, dev)
+                _register_host_mirror(d_dia, dia_np)
+                return dataclasses.replace(self, values=d_vals,
+                                           dia_vals=d_dia)
+            return dataclasses.replace(self, dia_vals=jnp.asarray(dia_np))
+        return dataclasses.replace(
+            self, dia_vals=self._build_dia_vals(self.dia_offsets,
+                                                self.row_ids))
 
     def interior_exterior_split(self, num_owned_cols: int):
         """INTERIOR/BOUNDARY view split (include/matrix.h:82-88 views):

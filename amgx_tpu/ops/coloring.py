@@ -114,11 +114,12 @@ def _jpl_min_max_np(n: int, sr, sc, max_rounds: int, use_min: bool):
 def _jpl_min_max(A: CsrMatrix, max_rounds: int = 64, use_min: bool = True,
                  edges=None):
     """Jones-Plassmann-Luby with (max, min) extraction per round."""
-    from ..matrix import host_resident
+    from ..matrix import host_arrays
     n = A.num_rows
-    if edges is None and host_resident(A.row_offsets, A.col_indices):
-        ro = np.asarray(A.row_offsets)
-        ci = np.asarray(A.col_indices)
+    ha = host_arrays(A.row_offsets, A.col_indices) if edges is None \
+        else None
+    if ha is not None:
+        ro, ci = ha
         rows = np.repeat(np.arange(n, dtype=np.int32), np.diff(ro))
         offd = rows != ci
         sr = np.concatenate([rows[offd], ci[offd]])
@@ -188,13 +189,86 @@ class MatrixColoring:
 
 @registry.matrix_coloring.register("MIN_MAX")
 @registry.matrix_coloring.register("PARALLEL_GREEDY")
-@registry.matrix_coloring.register("GREEDY_RECOLOR")
 @registry.matrix_coloring.register("LOCALLY_DOWNWIND")
 class MinMaxColoring(MatrixColoring):
+    """LOCALLY_DOWNWIND documented deviation: the reference's downwind
+    ordering (locally_downwind.cu) targets DILU sweep quality on
+    convection problems; here it aliases MIN_MAX (GREEDY_RECOLOR below
+    is the real quality scheme of this port)."""
+
     def color_matrix(self, A):
         if self.coloring_level >= 2:
             return _jpl_min_max(A, edges=_square_edges(A))
         return _jpl_min_max(A)
+
+
+def _greedy_recolor_np(n, ro_e, sc, colors, num_colors):
+    """Descending-class first-fit recolor over the symmetrized edge
+    lists (rows CSR-ordered): each color class is an independent set,
+    so its vertices reassign simultaneously to their smallest
+    neighbor-free color. One pass; the count never increases (a
+    vertex's own class is always free). O(nnz) per class sweep total."""
+    colors = colors.copy()
+    K = int(num_colors)
+    if K <= 2 or n == 0:
+        return colors, K
+    for c in range(K - 1, 0, -1):
+        rows_c = np.flatnonzero(colors == c)
+        if rows_c.size == 0:
+            continue
+        used = np.zeros((rows_c.size, K), bool)
+        # neighbor colors of each class-c vertex (fresh gather — earlier
+        # classes may already have moved); flat edge positions of the
+        # class rows, fully vectorized
+        cnt = ro_e[rows_c + 1] - ro_e[rows_c]
+        tot = int(cnt.sum())
+        if tot:
+            tgt = np.repeat(np.arange(rows_c.size), cnt)
+            pos = (np.arange(tot) - np.repeat(np.cumsum(cnt) - cnt, cnt)
+                   + np.repeat(ro_e[rows_c], cnt))
+            used[tgt, colors[sc[pos]]] = True
+        new = np.argmax(~used, axis=1)      # smallest free color (<= c)
+        colors[rows_c] = new
+    return colors, int(colors.max()) + 1
+
+
+@registry.matrix_coloring.register("GREEDY_RECOLOR")
+class GreedyRecolorColoring(MatrixColoring):
+    """JPL MIN_MAX followed by a greedy recoloring pass that shrinks
+    the color count (greedy_recolor.cu:1-1172 role): fewer colors
+    directly cuts the serial sweep depth of MULTICOLOR_DILU/GS.
+    Reassignment runs class-by-class in descending color order; each
+    class is an independent set, so the whole class moves at once to
+    its smallest neighbor-free color."""
+
+    def color_matrix(self, A):
+        from ..matrix import host_arrays
+        n = A.num_rows
+        # one edge build serves both the base JPL and the recolor pass
+        # (at distance 2 the _square_edges SpGEMM is the dominant cost)
+        sq_edges = _square_edges(A) if self.coloring_level >= 2 else None
+        base = (_jpl_min_max(A, edges=sq_edges)
+                if self.coloring_level >= 2 else _jpl_min_max(A))
+        if base.num_colors <= 2:
+            return base
+        ha = host_arrays(A.row_offsets, A.col_indices) \
+            if self.coloring_level < 2 else None
+        if ha is not None:
+            ro, ci = ha
+            rows = np.repeat(np.arange(n, dtype=np.int32), np.diff(ro))
+            offd = rows != ci
+            sr = np.concatenate([rows[offd], ci[offd]])
+            sc = np.concatenate([ci[offd], rows[offd]])
+        else:
+            sr, sc = sq_edges if sq_edges is not None else _sym_edges(A)
+            sr, sc = np.asarray(sr), np.asarray(sc)
+        order = np.argsort(sr, kind="stable")
+        sr, sc = sr[order], sc[order]
+        ro_e = np.zeros(n + 1, np.int64)
+        np.cumsum(np.bincount(sr, minlength=n), out=ro_e[1:])
+        colors, num = _greedy_recolor_np(
+            n, ro_e, sc, np.asarray(base.row_colors), base.num_colors)
+        return Coloring(jnp.asarray(colors), num)
 
 
 @registry.matrix_coloring.register("MIN_MAX_2RING")

@@ -210,9 +210,26 @@ class Solver:
             (self.preconditioner.resetup if reuse
              else self.preconditioner.setup)(self.precond_operator(A))
         (self.solver_resetup if reuse else self.solver_setup)()
-        self._jit_cache.clear()
+        # a value-only resetup changes no static solve state (shapes,
+        # level counts, color counts all derive from the structure,
+        # which is kept) — the traced solve functions stay valid and
+        # the new coefficients flow through as arguments; clearing
+        # would force a full Python re-trace per coefficient cycle
+        if not (reuse and self._resetup_kept_static()):
+            self._jit_cache.clear()
         self.setup_time = time.perf_counter() - t0
         return self
+
+    def _resetup_kept_static(self) -> bool:
+        """Did the last resetup keep every static ingredient of this
+        (sub)tree's traced solve functions? Standard solvers' static
+        state derives from the matrix PATTERN (shapes, colorings, ELL
+        widths), which replace_coefficients keeps by contract — so the
+        default is True and the question recurses down the chain. The
+        AMG wrapper overrides: its hierarchy depth/level shapes depend
+        on the VALUES unless the fused value-only resetup ran."""
+        return (self.preconditioner is None
+                or self.preconditioner._resetup_kept_static())
 
     def precond_operator(self, A: CsrMatrix) -> CsrMatrix:
         """The operator the preconditioner tree is set up against

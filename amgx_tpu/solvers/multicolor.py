@@ -52,25 +52,24 @@ from .base import Solver
 from .relaxation import _apply_dinv, l1_strengthened_diag, safe_recip
 
 
-def _match_transpose_np(A: CsrMatrix):
+def _match_transpose_np(num_rows, num_cols, ro, ci, vals):
     """Host twin of _match_transpose (scalar matrices): numpy int64-key
-    searchsorted. CSR keys are already sorted when columns are sorted
-    in-row (the host hierarchy build's invariant), so the argsort is
-    usually skipped entirely — the device form's eager int64 argsort
-    was the single hottest op of the host smoother setup."""
+    searchsorted over host (numpy/mirror) arrays. CSR keys are already
+    sorted when columns are sorted in-row (the host hierarchy build's
+    invariant), so the argsort is usually skipped entirely — the device
+    form's eager int64 argsort was the single hottest op of the host
+    smoother setup."""
     import numpy as np
-    ro = np.asarray(A.row_offsets)
-    cols = np.asarray(A.col_indices).astype(np.int64)
-    vals = np.asarray(A.values)
-    rows = np.repeat(np.arange(A.num_rows, dtype=np.int64), np.diff(ro))
-    keys = rows * A.num_cols + cols
+    cols = ci.astype(np.int64)
+    rows = np.repeat(np.arange(num_rows, dtype=np.int64), np.diff(ro))
+    keys = rows * num_cols + cols
     if np.all(keys[1:] >= keys[:-1]):
         order = None
         skeys = keys
     else:
         order = np.argsort(keys, kind="stable")
         skeys = keys[order]
-    want = cols * A.num_cols + rows
+    want = cols * num_cols + rows
     pos = np.clip(np.searchsorted(skeys, want), 0, max(keys.shape[0] - 1, 0))
     found = skeys[pos] == want
     src = pos if order is None else order[pos]
@@ -242,22 +241,41 @@ class MulticolorDILUSolver(_ColoredSolver):
     """
 
     def solver_setup(self):
-        from ..matrix import host_resident
+        from ..matrix import host_arrays
         self._color()
         A = self.A
-        if not A.is_block and host_resident(A.row_offsets, A.col_indices,
-                                            A.values):
-            # host fast path (amg_host_setup hierarchies): the whole
-            # color recurrence in synchronous numpy — the eager
-            # per-color XLA:CPU dispatches and the int64-key argsort
-            # dominated the classical setup otherwise
+        ha = None if A.is_block else host_arrays(
+            A.row_offsets, A.col_indices, A.values)
+        if ha is not None and A.has_external_diag \
+                and host_arrays(A.diag) is None:
+            ha = None             # device-only external diagonal
+        if ha is not None:
+            # host fast path (host-resident OR mirror-backed device
+            # matrices): the whole color recurrence in synchronous
+            # numpy — the eager per-color dispatches and the int64-key
+            # argsort dominated the smoother setup otherwise (minutes
+            # at 96^3 on a tunneled accelerator)
             import numpy as onp
-            ro = onp.asarray(A.row_offsets)
-            vals = onp.asarray(A.values)
+            ro, cols, vals = ha
             n = A.num_rows
-            cols = onp.asarray(A.col_indices)
-            at_vals = _match_transpose_np(A)
-            d = onp.asarray(A.diagonal())
+            at_vals = _match_transpose_np(n, A.num_cols, ro, cols, vals)
+            hd = host_arrays(A.diag) if A.has_external_diag else None
+            if A.has_external_diag and hd is not None:
+                d = hd[0]
+            else:
+                # first-occurrence in-row diagonal (padded-duplicate
+                # CSR convention), scanned host-side
+                rows64 = onp.repeat(onp.arange(n, dtype=onp.int64),
+                                    onp.diff(ro))
+                is_diag = cols == rows64
+                cand = onp.where(is_diag, onp.arange(cols.shape[0]),
+                                 cols.shape[0])
+                from ..matrix import _np_row_reduce
+                dmin = _np_row_reduce(onp.minimum, cand, ro, n,
+                                      cols.shape[0])
+                d = onp.where(dmin < cols.shape[0],
+                              vals[onp.minimum(dmin, cols.shape[0] - 1)],
+                              0.0)
             colors = onp.asarray(self.row_colors)
             Einv = onp.zeros(n, vals.dtype)
             from ..matrix import _np_row_reduce
