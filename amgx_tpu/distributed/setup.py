@@ -1019,10 +1019,9 @@ def sharded_eligible(amg, A) -> Optional[str]:
             return "classical strength != AHAT not sharded"
         if int(amg.cfg.get("aggressive_levels", amg.scope)) > 0:
             return "aggressive coarsening uses the global setup"
-        if (int(amg.cfg.get("interp_max_elements", amg.scope)) > 0
-                or float(amg.cfg.get("interp_truncation_factor",
-                                     amg.scope)) <= 1.0):
-            return "interpolation truncation uses the global setup"
+        # interp_max_elements / interp_truncation_factor are supported:
+        # truncation is a per-row top-k on the D1 slot vectors
+        # (setup_classical._truncate_slots, src/truncate.cu semantics)
     elif amg.algorithm != "AGGREGATION":
         return "energymin algorithms use the global setup"
     else:

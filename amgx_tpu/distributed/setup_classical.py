@@ -27,9 +27,11 @@ redesign on the same primitives the aggregation setup uses:
 - level assembly (halo lists, a2a maps, transfer shards) mirrors the
   aggregation phase C, generalized to weighted multi-entry P rows.
 
-Scope (v1): selector PMIS, interpolator D1, strength AHAT, scalar
-matrices, no truncation/aggressive levels; everything else falls back
-to the global-setup path (setup.sharded_eligible).
+Scope: selector PMIS, interpolator D1 (with interp_truncation_factor /
+interp_max_elements truncation — src/truncate.cu semantics on the slot
+vectors), strength AHAT, scalar matrices; aggressive levels and the
+other interpolators fall back to the global-setup path
+(setup.sharded_eligible).
 """
 from __future__ import annotations
 
@@ -150,11 +152,50 @@ def _cids_of_cf(cf, active, offsets_c, me):
     return jnp.where(is_c, offsets_c[me] + rank, -1).astype(jnp.int32)
 
 
+def _truncate_slots(p_cid, p_w, factor: float, max_elements: int):
+    """Interpolation truncation on the (n, PK) D1 slot vectors
+    (src/truncate.cu semantics, bit-matching interpolators._truncate):
+    entries rank in ascending-cid order — the assembled P's CSR entry
+    order — with a stable descending-|w| pass, so equal-weight ties
+    resolve exactly as the single-device path resolves them; dropped
+    slots become (-1, 0) and kept weights rescale to preserve the row
+    sum. Slot-local: no communication."""
+    if factor > 1.0 and max_elements <= 0:
+        return p_cid, p_w
+    n, PK = p_cid.shape
+    valid = p_cid >= 0
+    absw = jnp.where(valid, jnp.abs(p_w), -1.0)
+    keep = valid
+    if factor <= 1.0:
+        rmax = jnp.maximum(jnp.max(absw, axis=1, keepdims=True), 0.0)
+        keep = keep & (jnp.abs(p_w) >= factor * rmax)
+    if max_elements > 0 and PK > max_elements:
+        big = jnp.int32(2**31 - 1)
+        ord1 = jnp.argsort(jnp.where(valid, p_cid, big), axis=1,
+                           stable=True)
+        a_s = jnp.take_along_axis(absw, ord1, axis=1)
+        ord2 = jnp.argsort(-a_s, axis=1, stable=True)
+        comp = jnp.take_along_axis(ord1, ord2, axis=1)
+        ranks = jnp.zeros_like(p_cid).at[
+            jnp.arange(n)[:, None], comp].set(
+            jnp.broadcast_to(jnp.arange(PK, dtype=jnp.int32)[None],
+                             (n, PK)))
+        keep = keep & (ranks < max_elements)
+    rowsum = jnp.sum(jnp.where(valid, p_w, 0.0), axis=1)
+    keptsum = jnp.sum(jnp.where(keep, p_w, 0.0), axis=1)
+    scale = jnp.where(keptsum == 0, 1.0,
+                      rowsum / jnp.where(keptsum == 0, 1.0, keptsum))
+    return (jnp.where(keep, p_cid, -1),
+            jnp.where(keep, p_w * scale[:, None], 0.0))
+
+
 def _d1_rows(E: _Edges, M: ShardMatrix, cf, cid_sem, strong_out,
-             PK: int):
+             PK: int, trunc_factor: float = 1.1,
+             max_elements: int = -1):
     """Per-vertex D1 interpolation rows as (n, PK) padded slot vectors
     of (semantic cid, weight) — the Distance1Interpolator formula
-    (amg/classical/interpolators.py:336), row-local. C rows inject."""
+    (amg/classical/interpolators.py:336), row-local. C rows inject.
+    Truncation applies per slot vector (see _truncate_slots)."""
     n = E.n_local
     rows_c = jnp.minimum(E.rows, n)
     cf_col = E.col_state(cf, E.exchange(cf), jnp.int32(FINE))
@@ -201,7 +242,8 @@ def _d1_rows(E: _Edges, M: ShardMatrix, cf, cid_sem, strong_out,
     is_c = cf == COARSE
     p_cid = p_cid.at[:n, 0].set(jnp.where(is_c, cid_sem, p_cid[:n, 0]))
     p_w = p_w.at[:n, 0].set(jnp.where(is_c, 1.0, p_w[:n, 0]))
-    return p_cid[:n], p_w[:n]
+    return _truncate_slots(p_cid[:n], p_w[:n], trunc_factor,
+                           max_elements)
 
 
 def classical_phase_a(M: ShardMatrix, offsets, axis: str, theta: float,
@@ -229,7 +271,8 @@ def classical_phase_a(M: ShardMatrix, offsets, axis: str, theta: float,
 
 def classical_phase_b1(M: ShardMatrix, offsets, cf, offsets_c,
                        axis: str, theta: float, max_row_sum: float,
-                       PK: int):
+                       PK: int, trunc_factor: float = 1.1,
+                       max_elements: int = -1):
     """Routing budgets, packed (2R,): per-dest triple counts followed
     by per-dest R-member record counts."""
     me = jax.lax.axis_index(axis)
@@ -240,7 +283,8 @@ def classical_phase_b1(M: ShardMatrix, offsets, cf, offsets_c,
     active = idx_sem < offsets[me + 1]
     strong_out, _ = _strength_masks(E, M, theta, max_row_sum)
     cid_sem = _cids_of_cf(cf, active, offsets_c, me)
-    p_cid, _p_w = _d1_rows(E, M, cf, cid_sem, strong_out, PK)
+    p_cid, _p_w = _d1_rows(E, M, cf, cid_sem, strong_out, PK,
+                           trunc_factor, max_elements)
     pv = p_cid >= 0
     plen = jnp.sum(pv, axis=1).astype(jnp.int32)
     own_p = _owner_of_sem(p_cid.reshape(-1), offsets_c, R,
@@ -262,7 +306,9 @@ def classical_phase_b1(M: ShardMatrix, offsets, cf, offsets_c,
 
 def classical_phase_b2(M: ShardMatrix, offsets, cf, offsets_c,
                        axis: str, theta: float, max_row_sum: float,
-                       PK: int, NCL_c: int, maxt: int, maxm: int):
+                       PK: int, NCL_c: int, maxt: int, maxm: int,
+                       trunc_factor: float = 1.1,
+                       max_elements: int = -1):
     """Expand + route + dedup the weighted Galerkin triples, route the
     R-operator member records, count phase-C buffer sizes."""
     from ..matrix import lexsort_rc
@@ -274,7 +320,8 @@ def classical_phase_b2(M: ShardMatrix, offsets, cf, offsets_c,
     active = idx_sem < offsets[me + 1]
     strong_out, _ = _strength_masks(E, M, theta, max_row_sum)
     cid_sem = _cids_of_cf(cf, active, offsets_c, me)
-    p_cid, p_w = _d1_rows(E, M, cf, cid_sem, strong_out, PK)
+    p_cid, p_w = _d1_rows(E, M, cf, cid_sem, strong_out, PK,
+                          trunc_factor, max_elements)
     pv = p_cid >= 0
     rank_p = jnp.clip(_owner_of_sem(p_cid.reshape(-1), offsets_c, R,
                                     pv.reshape(-1)), 0, R - 1
@@ -466,6 +513,8 @@ def run_classical_levels(amg, mesh, axis: str, M: ShardMatrix, offsets,
     cfg, scope = amg.cfg, amg.scope
     theta = float(cfg.get("strength_threshold", scope))
     mrs = float(cfg.get("max_row_sum", scope))
+    tf = float(cfg.get("interp_truncation_factor", scope))
+    mel = int(cfg.get("interp_max_elements", scope))
     levels, levels_data = [], []
     offsets_last = ncl_last = None
     lvl = 0
@@ -499,7 +548,8 @@ def run_classical_levels(amg, mesh, axis: str, M: ShardMatrix, offsets,
         def fb1(args, _o=offs, _oc=offs_c, _pk=PK):
             Mx, cf_ = args
             return classical_phase_b1(Mx.local(), _o, cf_[0], _oc,
-                                      axis, theta, mrs, _pk)[None]
+                                      axis, theta, mrs, _pk, tf,
+                                      mel)[None]
         cb1 = np.asarray(_wrap(mesh, axis, (M, cf_s), fb1)((M, cf_s)))
         maxt = max(int(cb1[:, :R].max()), 1)
         maxm = max(int(cb1[:, R:].max()), 1)
@@ -508,7 +558,8 @@ def run_classical_levels(amg, mesh, axis: str, M: ShardMatrix, offsets,
                 _mt=maxt, _mm=maxm):
             Mx, cf_ = args
             out = classical_phase_b2(Mx.local(), _o, cf_[0], _oc, axis,
-                                     theta, mrs, _pk, _ncl, _mt, _mm)
+                                     theta, mrs, _pk, _ncl, _mt, _mm,
+                                     tf, mel)
             return jax.tree.map(lambda a: a[None], out)
         outB = _wrap(mesh, axis, (M, cf_s), fb2)((M, cf_s))
         (slot_s, cj_s, v_s, p_phys, p_w, mcid, mgid, mw, countsB) = outB

@@ -42,7 +42,10 @@ def poisson(points: str, nx: int, ny: int = 1, nz: int = 1,
     idx = (iz * ny + iy) * nx + ix
     rows_l, cols_l, vals_l = [], [], []
     diag_val = float(len(offsets) - 1)
-    for (dx, dy, dz) in offsets:
+    # emit the per-offset blocks in ascending (dz,dy,dx) = ascending
+    # column order: ONE stable row sort then yields (row, col) order —
+    # the two-key lexsort dominated gallery time at 256^3 (117M keys)
+    for (dx, dy, dz) in sorted(offsets, key=lambda o: (o[2], o[1], o[0])):
         jx, jy, jz = ix + dx, iy + dy, iz + dz
         mask = ((jx >= 0) & (jx < nx) & (jy >= 0) & (jy < ny)
                 & (jz >= 0) & (jz < nz))
@@ -53,7 +56,7 @@ def poisson(points: str, nx: int, ny: int = 1, nz: int = 1,
     rows = np.concatenate(rows_l)
     cols = np.concatenate(cols_l)
     vals = np.concatenate(vals_l)
-    order = np.lexsort((cols, rows))
+    order = np.argsort(rows, kind="stable")
     rows, cols, vals = rows[order], cols[order], vals[order]
     counts = np.bincount(rows, minlength=n)
     row_offsets = np.zeros(n + 1, np.int32)

@@ -111,20 +111,28 @@ def _jpl_min_max_np(n: int, sr, sc, max_rounds: int, use_min: bool):
     return Coloring(jnp.asarray(colors), num)
 
 
+def _host_sym_edges(A: CsrMatrix):
+    """Host (numpy) symmetrized off-diagonal edge lists via the
+    mirrors, or None when the arrays cannot be served host-side."""
+    from ..matrix import host_arrays
+    ha = host_arrays(A.row_offsets, A.col_indices)
+    if ha is None:
+        return None
+    ro, ci = ha
+    rows = np.repeat(np.arange(A.num_rows, dtype=np.int32), np.diff(ro))
+    offd = rows != ci
+    return (np.concatenate([rows[offd], ci[offd]]),
+            np.concatenate([ci[offd], rows[offd]]))
+
+
 def _jpl_min_max(A: CsrMatrix, max_rounds: int = 64, use_min: bool = True,
                  edges=None):
     """Jones-Plassmann-Luby with (max, min) extraction per round."""
-    from ..matrix import host_arrays
     n = A.num_rows
-    ha = host_arrays(A.row_offsets, A.col_indices) if edges is None \
-        else None
-    if ha is not None:
-        ro, ci = ha
-        rows = np.repeat(np.arange(n, dtype=np.int32), np.diff(ro))
-        offd = rows != ci
-        sr = np.concatenate([rows[offd], ci[offd]])
-        sc = np.concatenate([ci[offd], rows[offd]])
-        return _jpl_min_max_np(n, sr, sc, max_rounds, use_min)
+    if edges is None:
+        he = _host_sym_edges(A)
+        if he is not None:
+            return _jpl_min_max_np(n, he[0], he[1], max_rounds, use_min)
     sr, sc = _sym_edges(A) if edges is None else edges
     colors = jnp.full((n,), -1, jnp.int32)
     has_nbr = jnp.zeros((n,), bool).at[sr].set(True)
@@ -242,23 +250,22 @@ class GreedyRecolorColoring(MatrixColoring):
     its smallest neighbor-free color."""
 
     def color_matrix(self, A):
-        from ..matrix import host_arrays
         n = A.num_rows
         # one edge build serves both the base JPL and the recolor pass
-        # (at distance 2 the _square_edges SpGEMM is the dominant cost)
+        # (at distance 2 the _square_edges SpGEMM is the dominant cost;
+        # at distance 1 the host edge lists are shared via
+        # _host_sym_edges)
         sq_edges = _square_edges(A) if self.coloring_level >= 2 else None
-        base = (_jpl_min_max(A, edges=sq_edges)
-                if self.coloring_level >= 2 else _jpl_min_max(A))
+        he = _host_sym_edges(A) if self.coloring_level < 2 else None
+        if he is not None:
+            base = _jpl_min_max_np(n, he[0], he[1], 64, True)
+        else:
+            base = _jpl_min_max(A, edges=sq_edges) \
+                if sq_edges is not None else _jpl_min_max(A)
         if base.num_colors <= 2:
             return base
-        ha = host_arrays(A.row_offsets, A.col_indices) \
-            if self.coloring_level < 2 else None
-        if ha is not None:
-            ro, ci = ha
-            rows = np.repeat(np.arange(n, dtype=np.int32), np.diff(ro))
-            offd = rows != ci
-            sr = np.concatenate([rows[offd], ci[offd]])
-            sc = np.concatenate([ci[offd], rows[offd]])
+        if he is not None:
+            sr, sc = he
         else:
             sr, sc = sq_edges if sq_edges is not None else _sym_edges(A)
             sr, sc = np.asarray(sr), np.asarray(sc)

@@ -207,6 +207,12 @@ class ChebyshevSolver(_KrylovBase):
         self._d = (self.lmax + self.lmin) / 2.0
         self._c = (self.lmax - self.lmin) / 2.0
 
+    def _resetup_kept_static(self):
+        # _d/_c are VALUE-derived Python floats baked into the trace as
+        # constants (solve_iteration reads them directly) — a value-only
+        # resetup changes them, so the cached solve must re-trace
+        return False
+
     def computes_residual(self):
         return False
 

@@ -259,23 +259,30 @@ class MulticolorDILUSolver(_ColoredSolver):
             ro, cols, vals = ha
             n = A.num_rows
             at_vals = _match_transpose_np(n, A.num_cols, ro, cols, vals)
-            hd = host_arrays(A.diag) if A.has_external_diag else None
-            if A.has_external_diag and hd is not None:
-                d = hd[0]
+            if A.has_external_diag:
+                d = host_arrays(A.diag)[0]
             else:
-                # first-occurrence in-row diagonal (padded-duplicate
-                # CSR convention), scanned host-side
-                rows64 = onp.repeat(onp.arange(n, dtype=onp.int64),
-                                    onp.diff(ro))
-                is_diag = cols == rows64
-                cand = onp.where(is_diag, onp.arange(cols.shape[0]),
-                                 cols.shape[0])
-                from ..matrix import _np_row_reduce
-                dmin = _np_row_reduce(onp.minimum, cand, ro, n,
-                                      cols.shape[0])
-                d = onp.where(dmin < cols.shape[0],
-                              vals[onp.minimum(dmin, cols.shape[0] - 1)],
-                              0.0)
+                hdi = host_arrays(A.diag_idx) if A.diag_idx is not None \
+                    else None
+                if hdi is not None:
+                    # init already stored the first-occurrence in-row
+                    # diagonal index (padded-duplicate CSR convention)
+                    di = hdi[0]
+                    d = onp.where(di >= 0,
+                                  vals[onp.maximum(di, 0)], 0.0)
+                else:
+                    # fallback: scan (uninitialized host matrices)
+                    rows64 = onp.repeat(onp.arange(n, dtype=onp.int64),
+                                        onp.diff(ro))
+                    cand = onp.where(cols == rows64,
+                                     onp.arange(cols.shape[0]),
+                                     cols.shape[0])
+                    from ..matrix import _np_row_reduce
+                    dmin = _np_row_reduce(onp.minimum, cand, ro, n,
+                                          cols.shape[0])
+                    d = onp.where(
+                        dmin < cols.shape[0],
+                        vals[onp.minimum(dmin, cols.shape[0] - 1)], 0.0)
             colors = onp.asarray(self.row_colors)
             Einv = onp.zeros(n, vals.dtype)
             from ..matrix import _np_row_reduce

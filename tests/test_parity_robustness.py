@@ -68,6 +68,65 @@ def test_iteration_parity(config_name, fixture, expected_iters):
 
 
 # ---------------------------------------------------------------------
+# EXTERNAL parity anchors: the two runs the reference README publishes
+# verbatim (reference README.md "Running examples": examples/matrix.mtx
+# with src/configs/FGMRES_AGGREGATION.json) — the only AmgX iteration
+# counts published anywhere in its repo. Unlike the self-regression
+# table above, these rows are cross-checked against REAL AmgX output.
+# ---------------------------------------------------------------------
+
+def _readme_system():
+    from amgx_tpu.io import read_system
+    A, b, _x = read_system("/root/reference/examples/matrix.mtx")
+    if b is None:
+        b = np.ones(A.num_rows)
+    return A.init(), np.asarray(b)
+
+
+@pytest.mark.skipif(
+    not os.path.exists("/root/reference/examples/matrix.mtx"),
+    reason="reference checkout not present")
+def test_external_anchor_readme_single_device():
+    """Published single-GPU run: 'Total Iterations: 1' (Final Residual
+    1.6e-14). Must reproduce exactly."""
+    A, b = _readme_system()
+    cfg = Config.from_file(os.path.join(_CONFIG_DIR,
+                                        "FGMRES_AGGREGATION.json"))
+    slv = amgx.create_solver(cfg)
+    slv.setup(A)
+    res = slv.solve(jnp.asarray(b))
+    assert bool(res.converged)
+    assert int(res.iterations) == 1      # published AmgX count
+    r = np.asarray(b) - np.asarray(amgx.ops.spmv(A, res.x))
+    assert np.linalg.norm(r) < 1e-10
+
+
+@pytest.mark.skipif(
+    not os.path.exists("/root/reference/examples/matrix.mtx"),
+    reason="reference checkout not present")
+def test_external_anchor_readme_two_rank_distributed():
+    """Published 2-rank MPI run of the SAME system and config: 'Total
+    Iterations: 9' — AmgX's rank-local aggregation degrades the tiny
+    hierarchy. Our distributed path preserves the single-device
+    decisions (consolidation at this size), so it must converge at
+    least as fast as the published 9 — and in fact matches the
+    single-GPU count of 1 (documented design difference: semantic-id
+    decisions make the sharded hierarchy partition-independent)."""
+    from amgx_tpu.distributed import DistributedSolver, default_mesh
+    A, b = _readme_system()
+    cfg = Config.from_file(os.path.join(_CONFIG_DIR,
+                                        "FGMRES_AGGREGATION.json"))
+    d = DistributedSolver(cfg, default_mesh(2))
+    d.setup(A)
+    res = d.solve(b)
+    assert bool(res.converged)
+    assert int(res.iterations) <= 9      # published AmgX 2-rank count
+    assert int(res.iterations) == 1      # our partition-independence
+    r = np.asarray(b) - np.asarray(A.to_dense()) @ np.asarray(res.x)
+    assert np.linalg.norm(r) < 1e-10
+
+
+# ---------------------------------------------------------------------
 # robustness (smoother_nan_random.cu / zero_in_diagonal analogs)
 # ---------------------------------------------------------------------
 

@@ -189,3 +189,24 @@ def test_res_history_monotone_cg(poisson32, rhs32):
     hist = res.res_history
     assert hist[-1] <= 1e-10 * 1e12  # sanity
     assert hist.shape[0] == res.iterations + 1
+
+
+def test_chebyshev_resetup_rebakes_spectrum(poisson32, rhs32):
+    """CHEBYSHEV bakes its lambda estimates into the trace as Python
+    floats; a value-only resetup must re-trace (base.py jit-cache gate
+    consults _resetup_kept_static), or the solve silently runs with the
+    OLD smoothing interval."""
+    import numpy as np
+    s = make_solver("CHEBYSHEV", Config.from_string(
+        "max_iters=300, monitor_residual=1, tolerance=1e-5,"
+        " convergence=RELATIVE_INI,"
+        " chebyshev_lambda_estimate_mode=2"))
+    s.setup(poisson32)
+    r1 = s.solve(rhs32)
+    assert bool(r1.converged)
+    A2 = poisson32.with_values(poisson32.values * 50.0)
+    s.resetup(A2)
+    r2 = s.solve(rhs32)
+    assert bool(r2.converged), "stale spectrum bounds after resetup"
+    resid = np.asarray(rhs32) - np.asarray(A2.to_dense()) @ np.asarray(r2.x)
+    assert np.linalg.norm(resid) < 1e-3 * np.linalg.norm(np.asarray(rhs32))
