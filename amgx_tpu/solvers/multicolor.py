@@ -149,11 +149,15 @@ class MulticolorGSSolver(_ColoredSolver):
 
     def solve_iteration(self, data, b, st):
         x = st["x"]
-        order = list(range(self.num_colors))
+        nc = self.num_colors
+        # rolled color loop (traced color index — see the DILU sweep)
+        x = jax.lax.fori_loop(
+            0, nc, lambda c, x: self._color_update(data, b, x, c), x)
         if self.symmetric:
-            order = order + order[::-1]
-        for c in order:
-            x = self._color_update(data, b, x, c)
+            x = jax.lax.fori_loop(
+                0, nc,
+                lambda i, x: self._color_update(data, b, x, nc - 1 - i),
+                x)
         out = dict(st)
         out["x"] = x
         return out
@@ -345,18 +349,28 @@ class MulticolorDILUSolver(_ColoredSolver):
         A, Einv = data["A"], data["Einv"]
         x = st["x"]
         r = b - spmv(A, x)
-        # forward: (E+L) delta = r, colors ascending
-        delta = jnp.zeros_like(x)
-        for c in range(self.num_colors):
-            s = spmv(A, delta)      # only colors < c are nonzero in delta
-            upd = _apply_dinv(Einv, r - s, A.is_block)
-            delta = jnp.where(self._mask(data, c, x), upd, delta)
-        # backward: (E+U) Delta = E delta, colors descending
-        Delta = jnp.zeros_like(x)
-        for c in range(self.num_colors - 1, -1, -1):
-            s = spmv(A, Delta)      # only colors > c are nonzero in Delta
-            upd = delta - _apply_dinv(Einv, s, A.is_block)
-            Delta = jnp.where(self._mask(data, c, x), upd, Delta)
+        nc = self.num_colors
+        # color sweeps as lax.fori_loop (the mask compares against the
+        # TRACED color index): a Python unroll put 2*colors SpMVs per
+        # level into one XLA program, which at 128^3-classical scale
+        # (8 levels x ~8 colors) faulted the TPU at compile/run time
+
+        def fwd(c, delta):
+            # forward: (E+L) delta = r, colors ascending (only colors
+            # < c are nonzero in delta)
+            upd = _apply_dinv(Einv, r - spmv(A, delta), A.is_block)
+            return jnp.where(self._mask(data, c, x), upd, delta)
+
+        delta = jax.lax.fori_loop(0, nc, fwd, jnp.zeros_like(x))
+
+        def bwd(i, Delta):
+            # backward: (E+U) Delta = E delta, colors descending (only
+            # colors > c are nonzero in Delta)
+            c = nc - 1 - i
+            upd = delta - _apply_dinv(Einv, spmv(A, Delta), A.is_block)
+            return jnp.where(self._mask(data, c, x), upd, Delta)
+
+        Delta = jax.lax.fori_loop(0, nc, bwd, jnp.zeros_like(x))
         out = dict(st)
         out["x"] = x + self.relaxation_factor * Delta
         return out
@@ -490,17 +504,22 @@ class MulticolorILUSolver(_ColoredSolver):
         colors = data["colors"]
         x = st["x"]
         r = b - spmv(A, x)
+        nc = self.num_colors
+        # rolled color sweeps (traced color index — see the DILU sweep:
+        # a Python unroll emits 2*colors SpMVs per level into one XLA
+        # program, which faulted the TPU at 128^3-classical scale)
         # L y = r (unit diag), colors ascending (original ordering:
         # L only connects strictly lower colors)
-        y = jnp.zeros_like(r)
-        for c in range(self.num_colors):
-            s = spmv(Lp, y)
-            y = jnp.where(colors == c, r - s, y)
-        # U z = y, colors descending
-        z = jnp.zeros_like(r)
-        for c in range(self.num_colors - 1, -1, -1):
-            s = spmv(Up, z)         # diagonal term is zero pre-assignment
-            z = jnp.where(colors == c, u_dinv * (y - s), z)
+        y = jax.lax.fori_loop(
+            0, nc,
+            lambda c, y: jnp.where(colors == c, r - spmv(Lp, y), y),
+            jnp.zeros_like(r))
+        # U z = y, colors descending (diagonal term zero pre-assignment)
+        z = jax.lax.fori_loop(
+            0, nc,
+            lambda i, z: jnp.where(colors == nc - 1 - i,
+                                   u_dinv * (y - spmv(Up, z)), z),
+            jnp.zeros_like(r))
         out = dict(st)
         out["x"] = x + self.relaxation_factor * z
         return out
