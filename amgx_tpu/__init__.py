@@ -68,6 +68,15 @@ def create_solver(cfg: Config, scope: str = "default"):
     return make_solver(name, cfg, child_scope)
 
 
+def __getattr__(name):
+    # lazy: batch pulls in the solver registry, which stays an
+    # initialize()-time side effect for plain `import amgx_tpu`
+    if name == "batch":
+        from . import batch
+        return batch
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 def create_eigensolver(cfg: Config, scope: str = "default"):
     """Build an eigensolver from a config (AMG_EigenSolver analog,
     src/amg_eigensolver.cu; configs/eigen_configs presets)."""

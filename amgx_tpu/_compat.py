@@ -1,0 +1,32 @@
+"""JAX cross-version compatibility shims.
+
+The supported JAX span moved `shard_map` from
+`jax.experimental.shard_map` (<= 0.4.x, replication check spelled
+`check_rep`) to `jax.shard_map` (>= 0.5, spelled `check_vma`). All
+in-tree callers import from here and use the modern spelling; the shim
+translates for older runtimes.
+"""
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map
+    _CHECK_KW = "check_vma"
+except ImportError:  # pragma: no cover - version dependent
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    kw = {} if check_vma is None else {_CHECK_KW: check_vma}
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
+
+
+def enable_x64(enabled: bool = True):
+    """`jax.enable_x64(bool)` context manager; on older runtimes it maps
+    to jax.experimental.enable_x64/disable_x64."""
+    import jax
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(enabled)
+    from jax.experimental import disable_x64, enable_x64 as _enable
+    return _enable() if enabled else disable_x64()

@@ -43,6 +43,17 @@ def _level_plan(level, Ac_structure):
         # reads only dia_vals, so such hierarchies must take the
         # generic reuse loop
         return None
+    if A.ell_vals is not None or A.swell_vals is not None or \
+            Ac_structure.ell_vals is not None or \
+            Ac_structure.swell_vals is not None:
+        # the splice (try_value_resetup) rewrites values/dia_vals ONLY:
+        # an ELL/SWELL cache on either matrix would keep serving the OLD
+        # coefficients through spmv's layout dispatch. GEO levels never
+        # build these layouts today — this check turns that assumption
+        # into an enforced invariant instead of a silent-wrong-answer
+        # path (load-bearing for the batched subsystem's per-system
+        # value splice, batch/core.py).
+        return None
     nx, ny, nz = level.geo_fine_shape
     decomp = {}
     for d in A.dia_offsets:

@@ -1,0 +1,33 @@
+"""Batched solve subsystem: vmapped multi-RHS / multi-matrix AMG.
+
+The reference AmgX serves one matrix/RHS per solve handle (amgx_c.h);
+on TPU the leverage is the opposite direction — amortize ONE XLA trace
+across many simultaneous solves. Two batching shapes, both compiled
+into a single jitted program:
+
+- multi-RHS: many right-hand sides against one matrix (the solve data
+  is shared; only b/x carry the batch axis);
+- multi-matrix: many matrices sharing one sparsity pattern, each with
+  its own RHS. The AMG hierarchy *structure* is built once from the
+  shared pattern; per-system Galerkin values are spliced through the
+  existing structure-reuse / value-resetup path and stacked along a
+  leading batch axis. Structure arrays (colorings, aggregates, ELL
+  layouts) stay unbatched — `jax.vmap` maps only the value leaves.
+
+Per-system convergence comes free from the `lax.while_loop` batching
+rule: the loop runs while ANY system is unconverged and early-converged
+systems' states freeze via per-element select, so a stiff straggler
+never corrupts an already-converged neighbor.
+
+`queue.RequestBatcher` adds the serving layer: incoming solve requests
+are bucketed by (sparsity-pattern fingerprint, dtype), padded within a
+bucket to a small ladder of batch sizes so the jit cache stays bounded,
+and dispatched as one batched solve per bucket.
+"""
+from .core import BatchedSolveResult, BatchedSolver
+from .queue import PAD_SIZES, RequestBatcher, SolveRequest, pattern_fingerprint
+
+__all__ = [
+    "BatchedSolver", "BatchedSolveResult", "RequestBatcher",
+    "SolveRequest", "pattern_fingerprint", "PAD_SIZES",
+]

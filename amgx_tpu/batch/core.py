@@ -1,0 +1,330 @@
+"""BatchedSolver: one jitted program solving many systems at once.
+
+Execution model
+---------------
+`Solver._build_solve_fn()` already returns a pure function
+``solve_fn(data, b, x0) -> (x, stats)`` whose whole iteration loop is a
+`lax.while_loop`. Batching is therefore `jax.vmap(solve_fn)` with:
+
+- `b`/`x0` mapped along a leading batch axis,
+- the solve-data pytree mapped selectively: leaves that are IDENTICAL
+  across systems (structure arrays, aggregates, colorings — everything
+  the shared sparsity pattern determines) stay unbatched, leaves that
+  differ (matrix values, smoother inverses, coarse factors, Chebyshev
+  taus) are stacked and mapped along axis 0.
+
+Per-system convergence needs no bespoke masking: the `while_loop`
+batching rule runs the body while ANY system's predicate holds and
+freezes finished systems' carries with a per-element select — each
+system keeps its own iteration count, and an early-converged system's
+state is bit-identical to what a solo solve would have returned at its
+own stopping iteration.
+
+Multi-matrix setup reuse
+------------------------
+For same-pattern matrix batches the hierarchy structure is built ONCE
+(`setup(A0)`); each system's coefficients are then spliced through the
+existing `resetup` path (structure_reuse_levels / the fused
+value-resetup), and the per-system solve-data snapshots are stacked.
+Because structure arrays survive resetup as the *same objects*, the
+identity-based stacking recovers exactly the pattern/value split — the
+batched program holds one copy of the structure and B copies of the
+values. Caveat: the split is by object IDENTITY, so this holds for the
+coarse hierarchy (reused across resetups) and for fine matrices derived
+from one template via `with_values` (which keeps the index/layout
+arrays); B separately-constructed matrices carry B distinct (equal)
+fine-level structure objects, and any ELL/SWELL index payloads among
+them stack B-fold — derive batch members from a shared template when
+that matters.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+import weakref
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..errors import BadParametersError
+from ..matrix import CsrMatrix
+from ..solvers.base import SolveResult, Solver
+
+
+@dataclasses.dataclass
+class BatchedSolveResult:
+    """Per-system results of one batched solve (leading axis = system)."""
+
+    x: jax.Array                    # (B, n)
+    iterations: np.ndarray          # (B,) int
+    converged: np.ndarray           # (B,) bool
+    res_norm: np.ndarray            # (B,) or (B, block)
+    norm0: np.ndarray               # (B,) or (B, block)
+    res_history: Optional[np.ndarray] = None   # (B, hist_len[, block])
+    setup_time: float = 0.0
+    solve_time: float = 0.0
+
+    @property
+    def batch_size(self) -> int:
+        return int(self.x.shape[0])
+
+    @property
+    def all_converged(self) -> bool:
+        return bool(np.all(self.converged))
+
+    def per_system(self) -> List[SolveResult]:
+        """Split into per-system SolveResult views (the shape downstream
+        consumers of the single-solve API expect)."""
+        out = []
+        for i in range(self.batch_size):
+            hist = None
+            if self.res_history is not None:
+                hist = self.res_history[i][: int(self.iterations[i]) + 1]
+            out.append(SolveResult(
+                x=self.x[i], iterations=int(self.iterations[i]),
+                converged=bool(self.converged[i]),
+                res_norm=self.res_norm[i], norm0=self.norm0[i],
+                res_history=hist, setup_time=self.setup_time,
+                solve_time=self.solve_time))
+        return out
+
+
+def stack_solve_datas(datas: Sequence[Any]):
+    """Stack per-system solve-data pytrees along a new leading axis,
+    sharing leaves that are identical (by object identity) across every
+    system. Returns (stacked_data, in_axes_tree) where in_axes_tree has
+    a 0 at stacked leaf positions and None at shared ones — directly
+    usable as jax.vmap in_axes for the data argument."""
+    flat0, treedef = jax.tree.flatten(datas[0])
+    flats = [flat0]
+    for d in datas[1:]:
+        f, td = jax.tree.flatten(d)
+        flats.append(f)
+        if td != treedef:
+            raise BadParametersError(
+                "batched solve: solve-data structure differs between "
+                "systems (the sparsity pattern or hierarchy structure "
+                "drifted; multi-matrix batching requires "
+                "structure_reuse_levels=-1 so every system reuses one "
+                "hierarchy)")
+    stacked, axes = [], []
+    for leaves in zip(*flats):
+        first = leaves[0]
+        if all(lv is first for lv in leaves[1:]):
+            stacked.append(first)
+            axes.append(None)
+        else:
+            shapes = {np.shape(lv) for lv in leaves}
+            if len(shapes) != 1:
+                raise BadParametersError(
+                    f"batched solve: per-system solve-data leaf shapes "
+                    f"differ ({sorted(shapes)}); matrices must share one "
+                    f"sparsity pattern and hierarchy structure")
+            stacked.append(jnp.stack(leaves))
+            axes.append(0)
+    return (jax.tree.unflatten(treedef, stacked),
+            jax.tree.unflatten(treedef, axes))
+
+
+def _solver_tree(s: Solver):
+    """Every solver node reachable from s: the preconditioner chain,
+    plus AMG level smoothers and coarse solvers."""
+    while s is not None:
+        yield s
+        amg = getattr(s, "amg", None)
+        if amg is not None:
+            for lv in amg.levels:
+                if lv.smoother is not None:
+                    yield from _solver_tree(lv.smoother)
+            if getattr(amg, "coarse_solver", None) is not None:
+                yield from _solver_tree(amg.coarse_solver)
+        s = s.preconditioner
+
+
+def _amg_nodes(s: Solver):
+    for node in _solver_tree(s):
+        if hasattr(node, "amg"):
+            yield node
+
+
+class BatchedSolver:
+    """Solve many systems in one jitted program (see module docs).
+
+    Construct from a Config (builds its own solver tree) or wrap an
+    existing root solver with ``BatchedSolver(solver=slv)``. The wrapped
+    solver keeps working for single solves; `setup`/`resetup` on either
+    object stay coherent because they are the same tree.
+    """
+
+    def __init__(self, cfg: Optional[Config] = None, scope: str = "default",
+                 solver: Optional[Solver] = None):
+        if (cfg is None) == (solver is None):
+            raise BadParametersError(
+                "BatchedSolver: pass exactly one of cfg or solver")
+        if solver is None:
+            from .. import create_solver
+            solver = create_solver(cfg, scope)
+        self.solver = solver
+        # register on the wrapped tree root: a setup/resetup that
+        # invalidates the solver's own traces (base.py __setup_impl)
+        # clears every registered wrapper's jit cache; Solver.solve_many
+        # reuses `_batched` (latest wrapper wins for that convenience,
+        # but ALL wrappers stay linked for invalidation). The WeakSet
+        # keeps dropped wrappers collectable (they hold the solver, not
+        # vice versa).
+        solver._batched = self
+        if not hasattr(solver, "_batched_wrappers"):
+            solver._batched_wrappers = weakref.WeakSet()
+        solver._batched_wrappers.add(self)
+        self._suppress_invalidation = False
+        self._jit_cache = {}
+        # number of Python traces of the batched solve function — one per
+        # (batch size, dtype, sharing signature) bucket; serving tests
+        # assert this stays at 1 while a bucket is reused
+        self.trace_count = 0
+        self.setup_time = 0.0
+
+    # -- setup ----------------------------------------------------------
+    def setup(self, A: CsrMatrix) -> "BatchedSolver":
+        """Build the solver (and for AMG, the hierarchy structure) from
+        the batch's shared-pattern template matrix."""
+        t0 = time.perf_counter()
+        self.solver.setup(A)
+        self.setup_time = time.perf_counter() - t0
+        return self
+
+    def _check_multi_matrix_config(self):
+        for s in _amg_nodes(self.solver):
+            if int(s.cfg.get("structure_reuse_levels", s.scope)) == 0:
+                raise BadParametersError(
+                    "multi-matrix batching needs the AMG hierarchy "
+                    "structure shared across systems: set "
+                    "structure_reuse_levels=-1 in the AMG scope so "
+                    "resetup splices values instead of re-coarsening")
+        for s in _solver_tree(self.solver):
+            if s.trace_bakes_values:
+                raise BadParametersError(
+                    f"multi-matrix batching: solver {s.name} bakes "
+                    f"value-derived scalars into its trace (see "
+                    f"Solver.trace_bakes_values) — one batched trace "
+                    f"cannot serve per-system coefficients; use a "
+                    f"solver whose value state flows through solve_data "
+                    f"(e.g. JACOBI_L1, CHEBYSHEV_POLY)")
+
+    @contextlib.contextmanager
+    def _keep_batched_traces(self):
+        """Suppress the base-layer wrapper-cache invalidation around a
+        resetup KNOWN to keep every static trace ingredient — used for
+        the multi-matrix splice loop, where structure reuse is enforced
+        and trace-baking solvers are rejected up front."""
+        self._suppress_invalidation = True
+        try:
+            yield
+        finally:
+            self._suppress_invalidation = False
+
+    def _per_system_data(self, matrices: Sequence[CsrMatrix]):
+        """Resetup the solver per system against the shared structure and
+        snapshot each system's solve data (the per-system Galerkin values
+        flow through the existing value-resetup / structure-reuse path).
+        Snapshots are memoized per matrix OBJECT, so padded batches that
+        replicate a system (batch/queue.py) pay one resetup, not one per
+        duplicate."""
+        fresh = self.solver.A is None
+        if fresh:
+            self.setup(matrices[0])
+        # after setup: the AMG level smoothers exist and are scanned too
+        self._check_multi_matrix_config()
+        datas, memo = [], {}
+        with self._keep_batched_traces():
+            if fresh:
+                # the setup above already installed matrices[0]'s
+                # values — don't pay a redundant Galerkin resetup
+                memo[id(matrices[0])] = self.solver.solve_data()
+            for A_i in matrices:
+                if id(A_i) not in memo:
+                    self.solver.resetup(A_i)
+                    memo[id(A_i)] = self.solver.solve_data()
+                datas.append(memo[id(A_i)])
+        return datas
+
+    # -- solve -----------------------------------------------------------
+    def _build_batched_fn(self, data_axes):
+        solve_fn = self.solver._build_solve_fn()
+
+        def batched(data, b, x0):
+            self.trace_count += 1
+            return jax.vmap(solve_fn, in_axes=(data_axes, 0, 0))(data, b, x0)
+
+        return jax.jit(batched)
+
+    def solve_many(self, bs, matrices: Optional[Sequence[CsrMatrix]] = None,
+                   x0s=None, zero_initial_guess: bool = False
+                   ) -> BatchedSolveResult:
+        """Solve the batch: `bs` is (B, n) (or a sequence of B vectors).
+
+        matrices=None       -> multi-RHS against the already-set-up matrix;
+        matrices=[A_0..A_b] -> same-pattern multi-matrix batch (hierarchy
+                               structure reused, values spliced per system).
+        """
+        slv = self.solver
+        if slv.scaler is not None:
+            raise BadParametersError(
+                "batched solve: equation scaling is unsupported "
+                "(set scaling=NONE)")
+        B = jnp.stack([jnp.asarray(b) for b in bs]) \
+            if not hasattr(bs, "ndim") else jnp.asarray(bs)
+        if B.ndim != 2:
+            raise BadParametersError(
+                f"batched solve: rhs must stack to (batch, n), got "
+                f"{B.shape}")
+        nb = int(B.shape[0])
+        if matrices is not None:
+            if len(matrices) != nb:
+                raise BadParametersError(
+                    f"batched solve: {len(matrices)} matrices for "
+                    f"{nb} right-hand sides")
+            data, data_axes = stack_solve_datas(
+                self._per_system_data(matrices))
+        else:
+            if slv.A is None:
+                raise BadParametersError(
+                    "batched solve: solve_many() before setup()")
+            data, data_axes = slv.solve_data(), None
+        if x0s is None or zero_initial_guess:
+            X0 = jnp.zeros_like(B)
+        else:
+            X0 = jnp.stack([jnp.asarray(x) for x in x0s]) \
+                if not hasattr(x0s, "ndim") else jnp.asarray(x0s)
+        # cache key: batch geometry + which leaves carry the batch axis
+        # (the sharing signature is stable for a bucket, so a reused
+        # bucket reuses ONE trace)
+        axes_sig = (None if data_axes is None
+                    else tuple(jax.tree.leaves(
+                        data_axes, is_leaf=lambda a: a is None)))
+        key = (B.shape, str(B.dtype), axes_sig)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._build_batched_fn(data_axes)
+        t0 = time.perf_counter()
+        X, stats = jax.block_until_ready(self._jit_cache[key](data, B, X0))
+        solve_time = time.perf_counter() - t0
+        hist_len = slv.max_iters + 1
+        iters = np.zeros(nb, np.int64)
+        conv = np.zeros(nb, bool)
+        norm0, res_norm, hists = [], [], []
+        for i, row in enumerate(np.asarray(stats)):
+            it, cv, n0, rn, h = Solver.unpack_stats(row, hist_len)
+            iters[i], conv[i] = it, cv
+            norm0.append(n0)
+            res_norm.append(rn)
+            hists.append(h)
+        return BatchedSolveResult(
+            x=X, iterations=iters, converged=conv,
+            res_norm=np.asarray(res_norm), norm0=np.asarray(norm0),
+            res_history=np.asarray(hists)
+            if slv.store_res_history else None,
+            setup_time=self.setup_time, solve_time=solve_time)

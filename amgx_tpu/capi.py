@@ -123,6 +123,9 @@ class _CVector:
         self.mode = mode
         self.v: Optional[np.ndarray] = None
         self.block_dim = 1
+        # batched extension (amgx_tpu/batch/): None = plain vector; an
+        # int B means v is (B, n*block_dim) — one system per row
+        self.batch: Optional[int] = None
 
 
 class _CSolver:
@@ -479,6 +482,21 @@ def AMGX_vector_upload(vec_h, n, block_dim, data):
     v = _get(vec_h, _CVector)
     v.v = np.asarray(data, dtype=v.mode.vec_dtype).reshape(n * block_dim)
     v.block_dim = block_dim
+    v.batch = None
+    return RC.OK
+
+
+@_api
+def AMGX_vector_upload_batched(vec_h, n_batch, n, block_dim, data):
+    """Batched extension (no reference analog): upload `n_batch` systems'
+    vectors at once — one per row of a (n_batch, n*block_dim) array. A
+    batched vector pairs with AMGX_solver_solve_batched, which runs every
+    system in ONE jitted program (amgx_tpu/batch/)."""
+    v = _get(vec_h, _CVector)
+    v.v = np.asarray(data, dtype=v.mode.vec_dtype).reshape(
+        n_batch, n * block_dim)
+    v.block_dim = block_dim
+    v.batch = int(n_batch)
     return RC.OK
 
 
@@ -487,6 +505,7 @@ def AMGX_vector_set_zero(vec_h, n, block_dim):
     v = _get(vec_h, _CVector)
     v.v = np.zeros(n * block_dim, dtype=v.mode.vec_dtype)
     v.block_dim = block_dim
+    v.batch = None
     return RC.OK
 
 
@@ -500,12 +519,13 @@ def AMGX_vector_download(vec_h):
 
 
 def AMGX_vector_get_size(vec_h):
-    """rc, n, block_dim."""
+    """rc, n, block_dim (n is per system for batched vectors)."""
     try:
         v = _get(vec_h, _CVector)
         if v.v is None:
             return RC.OK, 0, v.block_dim
-        return RC.OK, len(v.v) // v.block_dim, v.block_dim
+        n = v.v.shape[-1] if v.batch is not None else len(v.v)
+        return RC.OK, n // v.block_dim, v.block_dim
     except AMGXError as e:
         return e.rc, None, None
 
@@ -590,6 +610,7 @@ def _do_solve(s, b_h, x_h, zero_guess):
                                       zero_initial_guess=zero_guess)
     x.v = np.asarray(s.result.x)
     x.block_dim = b.block_dim
+    x.batch = None
     return RC.OK
 
 
@@ -605,13 +626,63 @@ def AMGX_solver_solve_with_0_initial_guess(slv_h, b_h, x_h):
 
 
 @_api
+def AMGX_solver_solve_batched(slv_h, b_h, x_h):
+    """Batched extension (no reference analog): solve every system in a
+    batched rhs vector (AMGX_vector_upload_batched) against the set-up
+    matrix in ONE jitted program. x may hold batched initial guesses;
+    on return it holds the batched solutions. Per-system status lands in
+    the usual getters (get_status reports success only when EVERY system
+    converged; get_iterations_number reports the batch max)."""
+    from .distributed import DistributedSolver
+    s = _get(slv_h, _CSolver)
+    b = _get(b_h, _CVector)
+    x = _get(x_h, _CVector)
+    if s.solver is None or isinstance(s.solver, DistributedSolver) \
+            or s.solver.A is None:
+        raise AMGXError("batched solve needs a set-up single-device "
+                        "solver", RC.BAD_PARAMETERS)
+    if b.v is None or b.batch is None:
+        raise AMGXError("rhs is not a batched vector (use "
+                        "AMGX_vector_upload_batched)", RC.BAD_PARAMETERS)
+    if x.v is not None and x.batch != b.batch:
+        # an uploaded guess that cannot pair with the rhs batch is a
+        # caller bug — silently discarding it would hide the misuse
+        raise AMGXError(
+            f"initial-guess vector batch ({x.batch}) does not match the "
+            f"rhs batch ({b.batch}); upload it with "
+            f"AMGX_vector_upload_batched or leave it empty",
+            RC.BAD_PARAMETERS)
+    x0s = x.v
+    with s.resources.res.device_context():
+        s.result = s.solver.solve_many(b.v, x0s=x0s,
+                                       zero_initial_guess=x0s is None)
+    x.v = np.asarray(s.result.x)
+    x.block_dim = b.block_dim
+    x.batch = b.batch
+    return RC.OK
+
+
+@_api
 @_outputs(1)
 def AMGX_solver_get_status(slv_h):
     """rc, status: 0 success, 1 failed, 2 diverged (AMGX_SOLVE_*)."""
     s = _get(slv_h, _CSolver)
     if s.result is None:
         raise AMGXError("no solve performed", RC.BAD_PARAMETERS)
-    return RC.OK, (0 if s.result.converged else 1)
+    return RC.OK, (0 if bool(np.all(s.result.converged)) else 1)
+
+
+@_api
+@_outputs(1)
+def AMGX_solver_get_batch_status(slv_h):
+    """rc, per-system statuses (0 success / 1 failed) as an int array —
+    batched extension pairing AMGX_solver_solve_batched. A plain solve
+    reports a length-1 array."""
+    s = _get(slv_h, _CSolver)
+    if s.result is None:
+        raise AMGXError("no solve performed", RC.BAD_PARAMETERS)
+    conv = np.atleast_1d(np.asarray(s.result.converged))
+    return RC.OK, np.where(conv, 0, 1).astype(np.int32)
 
 
 @_api
@@ -620,7 +691,7 @@ def AMGX_solver_get_iterations_number(slv_h):
     s = _get(slv_h, _CSolver)
     if s.result is None:
         raise AMGXError("no solve performed", RC.BAD_PARAMETERS)
-    return RC.OK, s.result.iterations
+    return RC.OK, int(np.max(s.result.iterations))
 
 
 @_api
@@ -631,6 +702,18 @@ def AMGX_solver_get_iteration_residual(slv_h, it: int, idx: int = 0):
         raise AMGXError("no residual history (set store_res_history=1)",
                         RC.BAD_PARAMETERS)
     hist = np.asarray(s.result.res_history)   # (iters+1,) or (iters+1, b)
+    if hasattr(s.result, "batch_size"):       # batched: (B, hist_len[, b])
+        hist = np.moveaxis(hist, 0, 1)        # idx then selects the system
+        if hist.ndim == 3:                    # block norms: reduce the
+            hist = hist.max(axis=2)           # per-component width so idx
+                                              # stays the system selector
+        sysi = min(idx, hist.shape[1] - 1)
+        # per-system range: an early-converged system's history rows
+        # past its OWN stopping iteration are frozen zero padding, not
+        # residuals — error like the single-solve truncation does
+        if not (0 <= it <= int(np.asarray(s.result.iterations)[sysi])):
+            raise AMGXError("iteration out of range for this system",
+                            RC.BAD_PARAMETERS)
     if not (0 <= it < hist.shape[0]):
         raise AMGXError("iteration out of range", RC.BAD_PARAMETERS)
     row = np.atleast_1d(hist[it])
@@ -1312,6 +1395,7 @@ def AMGX_vector_set_random(vec_h, n):
     vector's block dimension is preserved."""
     v = _get(vec_h, _CVector)
     seed = next(_random_seed)    # call-indexed, independent of handles
+    v.batch = None
     v.v = np.random.default_rng(seed).random(n).astype(
         v.mode.vec_dtype)
     return RC.OK

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from .._compat import shard_map
 
 from ..config import Config
 from ..errors import BadParametersError
@@ -189,7 +189,7 @@ class DistributedSolver:
 
     def _value_symmetry_probe(self, signed: bool = False) -> bool:
         """Randomized on-device symmetry check: <y, A x> == <x, A y>
-        for symmetric A (two shard_mapped SpMVs + psum dots — no global
+        for symmetric A (shard_mapped SpMVs + psum dots — no global
         matrix is ever materialized, preserving the pieces path's
         contract). The sharded selectors assume value symmetry
         (setup.py module docs; the classical reverse-edge strength
@@ -198,41 +198,64 @@ class DistributedSolver:
         back to the global setup (auto) or raises (sharded). The probe
         is signed-strict, so a |.|-symmetric sign-flipped matrix also
         falls back: conservative, and correct for the Notay weights
-        which read signed values."""
+        which read signed values.
+
+        TWO independent probe pairs must both agree, and the dots
+        accumulate in f64 regardless of the value dtype: with f64
+        accumulation the probe's own rounding no longer grows with
+        sqrt(n) (only the SpMV's per-row rounding in the value dtype
+        remains), so the tolerance is a small dtype-eps multiple instead
+        of the old 100*sqrt(n)*eps — at 128^3/f32 that was ~2e-2
+        relative slack, wide enough to wave through mildly nonsymmetric
+        f32 matrices whose selector decisions then silently diverged."""
         from . import comms
         from ..ops.spmv import spmv
         del signed    # the dot probe is signed-strict for all callers
         n = self.part.n_global
         R = self.n_ranks
-        rng = np.random.default_rng(0xA317)
-        xl = partition_vector(rng.standard_normal(n), R,
-                              self.part.n_local)
-        yl = partition_vector(rng.standard_normal(n), R,
-                              self.part.n_local)
         axis = self.axis
 
         def body(M, xs, ys):
             Ml = jax.tree.map(lambda a: a[0], M)
+            x64 = xs[0].astype(jnp.float64)
+            y64 = ys[0].astype(jnp.float64)
             with comms.collective_axis(axis):
-                ax = spmv(Ml, xs[0])
-                ay = spmv(Ml, ys[0])
-                s1 = jax.lax.psum(jnp.vdot(ys[0], ax), axis)
-                s2 = jax.lax.psum(jnp.vdot(xs[0], ay), axis)
-            return jnp.stack([s1, s2])
+                ax = spmv(Ml, xs[0]).astype(jnp.float64)
+                ay = spmv(Ml, ys[0]).astype(jnp.float64)
+                s1 = jax.lax.psum(jnp.vdot(y64, ax), axis)
+                s2 = jax.lax.psum(jnp.vdot(x64, ay), axis)
+                norms2 = jax.lax.psum(jnp.stack([
+                    jnp.vdot(x64, x64), jnp.vdot(y64, y64),
+                    jnp.vdot(ax, ax), jnp.vdot(ay, ay)]), axis)
+            return jnp.concatenate([jnp.stack([s1, s2]), norms2])
 
         pspec = jax.tree.map(lambda _: P(axis), self.shard_A)
         fn = jax.jit(shard_map(
             body, mesh=self.mesh, in_specs=(pspec, P(axis), P(axis)),
             out_specs=P(), check_vma=False))
-        s1, s2 = (float(v) for v in fn(self.shard_A, xl, yl))
-        scale = max(abs(s1), abs(s2), 1e-300)
-        # dot-product rounding grows ~sqrt(n)*eps in the VALUE dtype:
-        # a fixed 1e-10 would fail genuinely symmetric f32 systems
         vdt = np.dtype(self.shard_A.va_own.dtype)
         if vdt.kind != "f":
             vdt = np.dtype(np.float64)
-        tol = max(1e-10, 100.0 * np.sqrt(n) * np.finfo(vdt).eps)
-        return abs(s1 - s2) <= tol * scale
+        tol = max(1e-12, 100.0 * np.finfo(vdt).eps)
+        for seed in (0xA317, 0x5C12):
+            rng = np.random.default_rng(seed)
+            xl = partition_vector(rng.standard_normal(n), R,
+                                  self.part.n_local)
+            yl = partition_vector(rng.standard_normal(n), R,
+                                  self.part.n_local)
+            s1, s2, nx2, ny2, nax2, nay2 = (
+                float(v) for v in fn(self.shard_A, xl, yl))
+            scale = max(abs(s1), abs(s2), 1e-300)
+            # the probe's own noise floor: the value-dtype SpMV rounding
+            # reaches the f64 dots as |y^T δ(Ax)| <~ eps_v * ||y||*||Ax||
+            # — without this term a symmetric matrix whose quadratic
+            # form happens to cancel (|s1| << ||y||*||Ax||) would be
+            # misclassified as asymmetric
+            floor = 100.0 * np.finfo(vdt).eps * max(
+                np.sqrt(ny2 * nax2), np.sqrt(nx2 * nay2))
+            if abs(s1 - s2) > max(tol * scale, floor):
+                return False
+        return True
 
     def _build_data(self):
         """Hand-build the solve-data pytree (stacked arrays); per-shard

@@ -1,0 +1,68 @@
+"""Multi-RHS sparse matrix products: Y = A @ X for X of shape (B, n).
+
+The batched-solve subsystem (amgx_tpu/batch/) drives the existing
+solver/cycle code under `jax.vmap`; most ops batch through their standard
+batching rules, but the SpMV layouts have better shapes available when
+only the *vector* carries the batch axis and the matrix is shared:
+
+- DIA: each stored diagonal multiplies a shifted (B, n) slab — the whole
+  batch is one dense multiply-add per diagonal (the batch axis rides the
+  sublane dimension for free; no per-system re-streaming of the values);
+- ELL: one (n, k) gather of X produces (B, n, k); the reduction is an
+  einsum the MXU handles as a batched matvec;
+- CSR/SWELL: fall back to `jax.vmap` of the single-vector form.
+
+These are also the implementations the Pallas kernels' `custom_vmap`
+rules route to when the matrix operand is unbatched, so a vmapped solve
+over many RHS against one matrix never pays a per-system values stream.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..matrix import CsrMatrix
+
+
+def spmv_dia_multi(A: CsrMatrix, X: jax.Array) -> jax.Array:
+    """Y = A @ X for DIA-layout A and X of shape (B, n): one shifted
+    dense multiply-add per stored diagonal, batch axis untouched."""
+    n = A.num_rows
+    offs = A.dia_offsets
+    vals = A.dia_vals.reshape(len(offs), -1)[:, :n]
+    left = max(0, -min(offs))
+    right = max(0, n - A.num_cols + max(offs))
+    Xp = jnp.pad(X, ((0, 0), (left, right)))
+    Y = jnp.zeros((X.shape[0], n), X.dtype)
+    for i, d in enumerate(offs):
+        Y = Y + vals[i][None, :] * jax.lax.dynamic_slice_in_dim(
+            Xp, left + d, n, axis=1)
+    return Y
+
+
+def spmv_ell_multi(A: CsrMatrix, X: jax.Array) -> jax.Array:
+    """Y = A @ X for padded-ELL A and X of shape (B, n)."""
+    Y = jnp.einsum("nk,bnk->bn", A.ell_vals, X[:, A.ell_cols])
+    if A.has_external_diag:
+        Y = Y + A.diag[None, :] * X[:, : A.num_rows]
+    return Y
+
+
+def spmv_multi(A: CsrMatrix, X: jax.Array) -> jax.Array:
+    """Y = A @ X with X of shape (B, num_cols): the multi-RHS form of
+    ops.spmv.spmv, dispatching on the layout chosen at init. Scalar
+    matrices only (block batching goes through jax.vmap)."""
+    from .spmv import spmv
+    if X.ndim != 2:
+        raise ValueError(f"spmv_multi: X must be (batch, n), got {X.shape}")
+    if isinstance(A, CsrMatrix) and not A.is_block:
+        if A.dia_offsets is not None and not A.has_external_diag:
+            return spmv_dia_multi(A, X)
+        if A.ell_cols is not None and A.swell_cols is None:
+            return spmv_ell_multi(A, X)
+    return jax.vmap(lambda x: spmv(A, x))(X)
+
+
+def residual_multi(A: CsrMatrix, X: jax.Array, B: jax.Array) -> jax.Array:
+    """R = B - A @ X, row per system."""
+    return B - spmv_multi(A, X)

@@ -40,6 +40,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .._compat import enable_x64
+
 LANES = 128
 SUBS = 8                      # sublane groups per super-block
 BLOCK_ROWS = SUBS * LANES     # rows per super-block
@@ -217,7 +219,7 @@ def _swell_kernel(w128, kpad, n_blocks):
                 src = jnp.broadcast_to(chunk, (rows, LANES))
                 # keep the gather's index math int32 (Mosaic has no
                 # i64; the package-level x64 default would promote)
-                with jax.enable_x64(False):
+                with enable_x64(False):
                     g = jnp.take_along_axis(src, lo, axis=1)
                 acc = jnp.where(hi == c, g, acc)
             return acc

@@ -73,17 +73,11 @@ def spmv_ell(A: CsrMatrix, x: jax.Array) -> jax.Array:
 
 
 def _spmv_dia_xla(A: CsrMatrix, x: jax.Array) -> jax.Array:
-    """XLA form of the DIA SpMV (f64/CPU/batched fallback)."""
-    n = A.num_rows
-    offs = A.dia_offsets
-    vals = A.dia_vals.reshape(len(offs), -1)[:, :n]
-    left = max(0, -min(offs))
-    right = max(0, n - A.num_cols + max(offs))
-    xp = jnp.pad(x, (left, right))
-    y = jnp.zeros((n,), x.dtype)
-    for i, d in enumerate(offs):
-        y = y + vals[i] * jax.lax.dynamic_slice(xp, (left + d,), (n,))
-    return y
+    """XLA form of the DIA SpMV (f64/CPU/batched fallback) — the
+    single-vector view of the multi-RHS slab form, so the DIA
+    padding/shift arithmetic lives in exactly one place."""
+    from .batched import spmv_dia_multi
+    return spmv_dia_multi(A, x[None])[0]
 
 
 @jax.custom_batching.custom_vmap
@@ -95,8 +89,15 @@ def _spmv_dia_pallas(A: CsrMatrix, x: jax.Array) -> jax.Array:
 @_spmv_dia_pallas.def_vmap
 def _spmv_dia_pallas_vmap(axis_size, in_batched, A, x):
     """pallas_call has no batching rule for ANY-space operands; batched
-    SpMV (AffinityStrength, eigen block solvers) takes the XLA form."""
+    SpMV (AffinityStrength, eigen block solvers, the batch/ subsystem's
+    vmapped solves) takes the XLA form. When only the vector is batched
+    (multi-RHS against one matrix — the batch subsystem's shared-pattern
+    shape) the dedicated multi-RHS slab form avoids restreaming the
+    diagonal values per system."""
     A_b, x_b = in_batched
+    if x_b and not any(jax.tree_util.tree_leaves(A_b)):
+        from .batched import spmv_dia_multi
+        return spmv_dia_multi(A, x), True
     in_axes = (jax.tree_util.tree_map(lambda b: 0 if b else None, A_b),
                0 if x_b else None)
     y = jax.vmap(_spmv_dia_xla, in_axes=in_axes,

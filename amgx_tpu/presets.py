@@ -17,3 +17,31 @@ FLAGSHIP = (
     " amg:chebyshev_polynomial_order=2, amg:presweeps=1, amg:postsweeps=1,"
     " amg:max_iters=1, amg:cycle=V, amg:max_levels=50,"
     " amg:min_coarse_rows=32")
+
+# Batched-serving presets (amgx_tpu/batch/): structure_reuse_levels=-1 is
+# load-bearing — multi-matrix batches reuse ONE hierarchy structure and
+# splice per-system values through the resetup path, and the request
+# batcher assumes a resetup never re-coarsens.
+
+# CG + aggregation-AMG V-cycle with Jacobi-L1 smoothing: every piece is
+# value-parameterized through solve_data (no trace-baked spectra), so a
+# whole bucket runs under one vmapped trace.
+BATCHED_CG = (
+    "solver(s)=PCG, s:max_iters=100, s:tolerance=1e-8,"
+    " s:convergence=RELATIVE_INI, s:norm=L2, s:monitor_residual=1,"
+    " s:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION,"
+    " amg:selector=SIZE_2, amg:smoother(sm)=JACOBI_L1, sm:max_iters=1,"
+    " amg:presweeps=1, amg:postsweeps=1, amg:cycle=V, amg:max_iters=1,"
+    " amg:coarse_solver=DENSE_LU_SOLVER, amg:min_coarse_rows=32,"
+    " amg:max_levels=20, amg:structure_reuse_levels=-1")
+
+# GMRES variant for nonsymmetric request streams (same AMG shape).
+BATCHED_GMRES = (
+    "solver(s)=GMRES, s:max_iters=100, s:tolerance=1e-8,"
+    " s:convergence=RELATIVE_INI, s:norm=L2, s:monitor_residual=1,"
+    " s:gmres_n_restart=20, s:preconditioner(amg)=AMG,"
+    " amg:algorithm=AGGREGATION, amg:selector=SIZE_2,"
+    " amg:smoother(sm)=JACOBI_L1, sm:max_iters=1, amg:presweeps=1,"
+    " amg:postsweeps=1, amg:cycle=V, amg:max_iters=1,"
+    " amg:coarse_solver=DENSE_LU_SOLVER, amg:min_coarse_rows=32,"
+    " amg:max_levels=20, amg:structure_reuse_levels=-1")

@@ -21,6 +21,7 @@ State is a plain dict pytree; the base manages the keys `x`, `r`,
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Dict, Optional
 
@@ -118,6 +119,11 @@ class Solver:
     uses_preconditioner = False
     # smoothers can be used by AMG levels; they expose smooth()
     is_smoother = False
+    # True when solve_iteration bakes VALUE-derived Python scalars into
+    # the trace as constants (CHEBYSHEV's _d/_c): such a solver cannot
+    # serve per-system coefficients from ONE trace, so the batched
+    # multi-matrix path (batch/core.py) refuses it up front
+    trace_bakes_values = False
     # True when solve-phase code only SpMVs against data["A"], so a
     # layout-only slim view may replace it (KACZMARZ reads COO structure
     # per sweep and opts out)
@@ -191,6 +197,7 @@ class Solver:
 
     def __setup_impl(self, A: CsrMatrix, reuse: bool):
         t0 = time.perf_counter()
+        snap = self._resetup_debug_snapshot() if reuse else None
         if not A.initialized:
             A = A.init()
         if self._owns_scaling and self.scaling not in ("NONE", ""):
@@ -217,6 +224,17 @@ class Solver:
         # would force a full Python re-trace per coefficient cycle
         if not (reuse and self._resetup_kept_static()):
             self._jit_cache.clear()
+            # batched wrappers close over this tree's traces, so they
+            # go stale together (same-structure replays would serve
+            # stale baked constants — Chebyshev spectra, color counts).
+            # A wrapper suppresses this during its own multi-matrix
+            # resetup loop, where structure reuse is enforced and
+            # trace-baking solvers are rejected (batch/core.py).
+            for b in tuple(getattr(self, "_batched_wrappers", ())):
+                if not b._suppress_invalidation:
+                    b._jit_cache.clear()
+        elif snap is not None:
+            self._assert_resetup_contract(snap)
         self.setup_time = time.perf_counter() - t0
         return self
 
@@ -227,9 +245,79 @@ class Solver:
         widths), which replace_coefficients keeps by contract — so the
         default is True and the question recurses down the chain. The
         AMG wrapper overrides: its hierarchy depth/level shapes depend
-        on the VALUES unless the fused value-only resetup ran."""
+        on the VALUES unless the fused value-only resetup ran.
+
+        CONTRACT (load-bearing for resetup trace reuse AND for the
+        batched subsystem's per-system value splice, batch/core.py):
+        when this returns True after a resetup, the cached jitted solve
+        functions are replayed with the NEW solve_data() as arguments —
+        so every value-derived quantity `solve_iteration` reads must
+        flow through `solve_data()` leaves. A solver that bakes
+        value-derived Python scalars into its trace (CHEBYSHEV's _d/_c)
+        must override this to return False, or the replayed trace serves
+        stale coefficients. Debug builds verify the observable half of
+        the contract (set AMGX_TPU_DEBUG_RESETUP=1): solve_data's pytree
+        structure/shapes/dtypes must survive a static-kept resetup
+        unchanged, and new coefficients must surface as new leaves."""
         return (self.preconditioner is None
                 or self.preconditioner._resetup_kept_static())
+
+    # -- resetup contract checking (AMGX_TPU_DEBUG_RESETUP=1) ------------
+    @staticmethod
+    def _debug_resetup_enabled() -> bool:
+        return os.environ.get("AMGX_TPU_DEBUG_RESETUP", "0") not in (
+            "", "0", "false", "False")
+
+    def _resetup_debug_snapshot(self):
+        """Pre-resetup snapshot of the solve_data pytree (debug mode
+        only): treedef + per-leaf (shape, dtype) + leaf ids + the old
+        coefficient array's id."""
+        if not self._debug_resetup_enabled() or self.A is None:
+            return None
+        leaves, treedef = jax.tree_util.tree_flatten(self.solve_data())
+        # the snapshot RETAINS the leaf objects (not just their ids):
+        # holding them alive is what makes the post-resetup id
+        # comparison sound — a freed array's address can be reused by a
+        # new allocation, which would both mask real violations and
+        # fire spurious ones
+        return {
+            "treedef": treedef,
+            "shapes": [(getattr(l, "shape", None),
+                        str(getattr(l, "dtype", ""))) for l in leaves],
+            "leaves": leaves,
+            "values": self.A.values,
+        }
+
+    def _assert_resetup_contract(self, snap):
+        """After a resetup that kept the traced solves (jit cache NOT
+        cleared), the new solve_data must be a drop-in argument for the
+        cached traces: identical treedef and per-leaf shapes/dtypes.
+        Additionally, if the coefficients changed, at least one leaf
+        must be a NEW array — an id-identical leaf set means the new
+        values never reached solve_data and the replayed trace would
+        serve stale coefficients (the failure mode the
+        _resetup_kept_static contract exists to prevent)."""
+        leaves, treedef = jax.tree_util.tree_flatten(self.solve_data())
+        if treedef != snap["treedef"]:
+            raise AssertionError(
+                f"solver {self.name}: resetup kept the traced solves but "
+                f"changed the solve_data pytree structure")
+        shapes = [(getattr(l, "shape", None),
+                   str(getattr(l, "dtype", ""))) for l in leaves]
+        if shapes != snap["shapes"]:
+            bad = [i for i, (a, b) in enumerate(zip(shapes,
+                                                    snap["shapes"]))
+                   if a != b][:5]
+            raise AssertionError(
+                f"solver {self.name}: resetup kept the traced solves but "
+                f"changed solve_data leaf shapes/dtypes at flat indices "
+                f"{bad}")
+        if self.A.values is not snap["values"] and \
+                {id(l) for l in leaves} == {id(l) for l in snap["leaves"]}:
+            raise AssertionError(
+                f"solver {self.name}: coefficients changed on resetup "
+                f"but every solve_data leaf is the pre-resetup object — "
+                f"value-derived state is not flowing through solve_data")
 
     def precond_operator(self, A: CsrMatrix) -> CsrMatrix:
         """The operator the preconditioner tree is set up against
@@ -237,6 +325,16 @@ class Solver:
         return A
 
     def solver_setup(self):
+        """Build solver-specific state for self.A.
+
+        _resetup_kept_static contract: anything computed here from the
+        matrix VALUES (diagonal inverses, factors, eigen estimates) that
+        the solve phase reads must be stored so `solve_data()` exposes it
+        as a pytree leaf — a value-only resetup then reruns this method
+        and the refreshed leaves flow into the CACHED jitted solve as
+        arguments. Value-derived state kept as Python scalars (baked
+        into the trace as constants) breaks that replay; such solvers
+        must override `_resetup_kept_static` to return False."""
         pass
 
     def solver_resetup(self):
@@ -261,6 +359,16 @@ class Solver:
         return {}
 
     def solve_iteration(self, data, b, state) -> Dict[str, Any]:
+        """One iteration as a pure function of (data, b, state).
+
+        _resetup_kept_static contract: read value-derived quantities
+        from `data` (the solve_data pytree), never from `self` — self
+        attributes trace as compile-time constants, which is only sound
+        for PATTERN-derived state (shapes, colorings, sweep counts).
+        The iteration must also be `jax.vmap`-compatible (no host
+        round-trips, no shape-dependent Python branching on values) —
+        the batched subsystem (batch/core.py) maps it over a leading
+        system axis."""
         raise NotImplementedError
 
     def computes_residual(self) -> bool:
@@ -452,6 +560,24 @@ class Solver:
         if self.obtain_timings:
             amgx_printf(f"    Setup Time: {res.setup_time:.4f}s")
             amgx_printf(f"    Solve Time: {res.solve_time:.4f}s")
+
+    # -- batched solves ---------------------------------------------------
+    def solve_many(self, bs, matrices=None, x0s=None,
+                   zero_initial_guess: bool = False):
+        """Solve many systems in ONE jitted program (batch/core.py):
+        `bs` stacks the right-hand sides along a leading batch axis.
+        With matrices=None this is multi-RHS against the set-up matrix;
+        with a list of same-pattern matrices each system gets its own
+        coefficients (hierarchy structure reused, values spliced via the
+        resetup path). Returns a BatchedSolveResult. The wrapped batched
+        state is cached on the solver, so repeat calls with the same
+        batch geometry reuse one trace."""
+        if getattr(self, "_batched", None) is None:
+            from ..batch import BatchedSolver
+            self._batched = BatchedSolver(solver=self)
+        return self._batched.solve_many(
+            bs, matrices=matrices, x0s=x0s,
+            zero_initial_guess=zero_initial_guess)
 
     # -- smoother interface (AMG levels) ---------------------------------
     def smooth(self, data, b, x, sweeps: int):

@@ -171,6 +171,10 @@ class ChebyshevSolver(_KrylovBase):
 
     uses_preconditioner = True
     is_smoother = True
+    # _d/_c are Python floats baked into the trace (see
+    # _resetup_kept_static below) — one trace cannot serve per-system
+    # spectra, so multi-matrix batching rejects this solver
+    trace_bakes_values = True
 
     def __init__(self, cfg, scope="default", name="CHEBYSHEV"):
         super().__init__(cfg, scope, name)

@@ -236,6 +236,38 @@ def bench_classical(n: int = 64):
     return setup_s, breakdown, solve_s, int(res.iterations), rel
 
 
+def bench_batched(n: int = 32, batch_sizes=(1, 8, 32), reps: int = 3):
+    """Batched-serving phase (amgx_tpu/batch/): per-system throughput of
+    the vmapped multi-RHS solve at several batch sizes on the n^3 7-pt
+    Poisson gallery. The figure of merit is solves/s per batch size —
+    the curve shows how much of a single solve's cost the batch
+    amortizes (one trace, one dispatch, shared matrix data). Returns
+    {batch: {"solves_per_s": ..., "solve_s": ..., "iters": ...}}."""
+    from amgx_tpu.batch import BatchedSolver
+    from amgx_tpu.presets import BATCHED_CG
+    A = amgx.gallery.poisson("7pt", n, n, n).init()
+    rng = np.random.default_rng(7)
+    out = {}
+    bs = BatchedSolver(Config.from_string(BATCHED_CG))
+    bs.setup(A)
+    for nb in batch_sizes:
+        B = jnp.asarray(rng.standard_normal((nb, A.num_rows)))
+        res = bs.solve_many(B)                    # compile
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            res = bs.solve_many(B)
+            times.append(time.perf_counter() - t0)
+        dt = sorted(times)[len(times) // 2]
+        out[str(nb)] = {
+            "solves_per_s": round(nb / dt, 2),
+            "solve_s": round(dt, 4),
+            "iters_max": int(np.max(res.iterations)),
+            "all_converged": bool(res.all_converged),
+        }
+    return out
+
+
 def main():
     t_start = time.perf_counter()
     amgx.initialize()
@@ -296,6 +328,21 @@ def main():
             break
     gc.collect()
 
+    # batched-serving phase: cheap (32^3, f64 CG+AggAMG), guarded like
+    # the other optional phases so the JSON line always prints
+    try:
+        old = signal.signal(signal.SIGALRM, _on_alarm)
+        signal.alarm(240)
+        try:
+            extra["batched_32^3_per_system"] = bench_batched()
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)
+    except _Budget:  # pragma: no cover - timing dependent
+        extra["batched_error"] = "wall-clock budget exceeded"
+    except Exception as e:  # pragma: no cover - bench robustness
+        extra["batched_error"] = str(e)[:200]
+    gc.collect()
 
     try:
         (setup_cold, setup_s, resetup_s, resetup_first, breakdown,

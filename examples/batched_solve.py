@@ -1,0 +1,75 @@
+"""Batched solves: many systems, one jitted program.
+
+Demonstrates the three entry points of the batch subsystem
+(amgx_tpu/batch/):
+
+1. multi-RHS      — many right-hand sides against one matrix;
+2. multi-matrix   — many same-pattern matrices (perturbed coefficients),
+                    hierarchy structure built once, values spliced per
+                    system;
+3. RequestBatcher — a serving-style queue that buckets a mixed request
+                    stream by sparsity-pattern fingerprint and pads each
+                    bucket to a bounded ladder of batch sizes.
+
+Run: python examples/batched_solve.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), os.pardir))
+
+import amgx_tpu as amgx  # noqa: E402
+from amgx_tpu.batch import BatchedSolver, RequestBatcher  # noqa: E402
+from amgx_tpu.config import Config  # noqa: E402
+from amgx_tpu.presets import BATCHED_CG  # noqa: E402
+
+
+def main():
+    amgx.initialize()
+    rng = np.random.default_rng(0)
+    cfg = Config.from_string(BATCHED_CG)
+
+    # -- 1. multi-RHS: 8 load cases against one stiffness matrix --------
+    A = amgx.gallery.poisson("7pt", 16, 16, 16).init()
+    solver = BatchedSolver(cfg)
+    solver.setup(A)
+    B = rng.standard_normal((8, A.num_rows))
+    res = solver.solve_many(B)
+    print(f"multi-RHS:    {res.batch_size} systems, "
+          f"iters={res.iterations.tolist()}, "
+          f"all converged={res.all_converged}, "
+          f"{solver.trace_count} trace(s)")
+
+    # -- 2. multi-matrix: same pattern, per-system coefficients ---------
+    # (e.g. one mesh, 8 users' material parameters). The hierarchy
+    # structure is reused; only Galerkin values differ per system.
+    dix = np.asarray(A.diag_idx)
+    mats = []
+    for i in range(8):
+        vals = np.asarray(A.values).copy()
+        vals[dix] += 0.5 * i          # SPD shift, pattern unchanged
+        mats.append(A.with_values(vals))
+    res = solver.solve_many(B, matrices=mats)
+    print(f"multi-matrix: iters={res.iterations.tolist()} "
+          f"(better-conditioned systems freeze earlier), "
+          f"{solver.trace_count} trace(s) total")
+
+    # -- 3. request batcher: a mixed stream, bucketed + padded ----------
+    A2 = amgx.gallery.poisson("5pt", 32, 32).init()
+    batcher = RequestBatcher(cfg)
+    tickets = [batcher.submit(M, rng.standard_normal(M.num_rows))
+               for M in (mats[0], mats[1], mats[2], A2, A2)]
+    batcher.drain()
+    print("batcher dispatches (bucket, requests, padded-to):")
+    for key, real, padded in batcher.dispatch_log:
+        print(f"  {key[:12]}...  {real} -> {padded}")
+    for t in tickets:
+        assert t.result.converged
+    print("all tickets solved")
+
+
+if __name__ == "__main__":
+    main()

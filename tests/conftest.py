@@ -27,6 +27,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight tests excluded from the tier-1 budgeted run "
+        "(`-m 'not slow'`); run them with `-m slow` on a capable rig")
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(1234)

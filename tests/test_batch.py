@@ -1,0 +1,443 @@
+"""Batched solve subsystem tests (amgx_tpu/batch/): batched-vs-loop
+parity, per-system convergence masks, request bucketing/padding, and
+the single-trace acceptance contract. No reference analog — the
+reference serves one matrix/RHS per solve handle (amgx_c.h)."""
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import amgx_tpu as amgx
+from amgx_tpu import gallery, ops
+from amgx_tpu.batch import (BatchedSolver, RequestBatcher,
+                            pattern_fingerprint)
+from amgx_tpu.batch.queue import pad_to_bucket_size
+from amgx_tpu.config import Config
+from amgx_tpu.errors import BadParametersError
+from amgx_tpu.presets import BATCHED_CG, BATCHED_GMRES
+
+amgx.initialize()
+
+
+@pytest.fixture(scope="module")
+def poisson16():
+    return gallery.poisson("5pt", 16, 16).init()
+
+
+def _diag_shift(A, c):
+    """Same-pattern SPD perturbation: A + c*I through the values array."""
+    vals = np.asarray(A.values).copy()
+    vals[np.asarray(A.diag_idx)] += c
+    return A.with_values(vals)
+
+
+def _rhs(A, n_sys, seed=0):
+    return np.random.default_rng(seed).standard_normal((n_sys, A.num_rows))
+
+
+# ---------------------------------------------------------------------------
+# batched-vs-loop parity
+# ---------------------------------------------------------------------------
+
+
+def test_multi_rhs_parity_cg_amg(poisson16):
+    """Batched multi-RHS Jacobi-L1 V-cycle CG matches N sequential
+    solves in iteration counts and solutions."""
+    bs = BatchedSolver(Config.from_string(BATCHED_CG))
+    bs.setup(poisson16)
+    B = _rhs(poisson16, 4, seed=1)
+    res = bs.solve_many(B)
+    assert res.all_converged
+    for i in range(4):
+        ref = bs.solver.solve(B[i])
+        assert int(res.iterations[i]) == ref.iterations
+        np.testing.assert_allclose(np.asarray(res.x[i]), np.asarray(ref.x),
+                                   rtol=1e-12, atol=1e-12)
+        np.testing.assert_allclose(res.res_norm[i], ref.res_norm,
+                                   rtol=1e-10)
+
+
+def test_multi_matrix_parity(poisson16):
+    """Same-pattern matrices with per-system values: batched solve
+    matches the sequential resetup+solve loop (same reused hierarchy
+    structure on both sides)."""
+    mats = [_diag_shift(poisson16, 0.3 * i) for i in range(4)]
+    bs = BatchedSolver(Config.from_string(BATCHED_CG))
+    bs.setup(mats[0])
+    B = _rhs(poisson16, 4, seed=2)
+    res = bs.solve_many(B, matrices=mats)
+    assert res.all_converged
+    for i in range(4):
+        bs.solver.resetup(mats[i])
+        ref = bs.solver.solve(B[i])
+        assert int(res.iterations[i]) == ref.iterations
+        np.testing.assert_allclose(np.asarray(res.x[i]), np.asarray(ref.x),
+                                   rtol=1e-12, atol=1e-12)
+
+
+def test_gmres_multi_rhs_parity(poisson16):
+    bs = BatchedSolver(Config.from_string(BATCHED_GMRES))
+    bs.setup(poisson16)
+    B = _rhs(poisson16, 3, seed=3)
+    res = bs.solve_many(B)
+    assert res.all_converged
+    for i in range(3):
+        ref = bs.solver.solve(B[i])
+        assert int(res.iterations[i]) == ref.iterations
+        np.testing.assert_allclose(np.asarray(res.x[i]), np.asarray(ref.x),
+                                   rtol=1e-10, atol=1e-12)
+
+
+def test_convergence_masks_freeze_early_systems(poisson16):
+    """Systems conditioned differently converge at different iteration
+    counts inside ONE batched program; each frozen system's state is
+    what its solo solve would have produced."""
+    mats = [_diag_shift(poisson16, c) for c in (0.0, 0.5, 4.0)]
+    bs = BatchedSolver(Config.from_string(BATCHED_CG))
+    bs.setup(mats[0])
+    B = _rhs(poisson16, 3, seed=4)
+    res = bs.solve_many(B, matrices=mats)
+    assert res.all_converged
+    it = res.iterations
+    assert it[0] > it[2], f"shifted system should converge first: {it}"
+    assert len(set(it.tolist())) > 1, f"expected distinct counts: {it}"
+    for i in range(3):
+        bs.solver.resetup(mats[i])
+        ref = bs.solver.solve(B[i])
+        assert int(it[i]) == ref.iterations
+        np.testing.assert_allclose(np.asarray(res.x[i]), np.asarray(ref.x),
+                                   rtol=1e-12, atol=1e-12)
+        # the frozen per-system residual is the one its own stopping
+        # iteration produced, not the batch's last iteration's
+        np.testing.assert_allclose(res.res_norm[i], ref.res_norm,
+                                   rtol=1e-10)
+
+
+def test_solver_solve_many_method(poisson16):
+    """Solver.solve_many: the batched entry point on any solver tree."""
+    s = amgx.create_solver(Config.from_string(
+        "solver=CG, max_iters=400, monitor_residual=1, tolerance=1e-10"))
+    s.setup(poisson16)
+    B = _rhs(poisson16, 3, seed=5)
+    res = s.solve_many(B)
+    assert res.all_converged
+    ref = s.solve(B[1])
+    assert int(res.iterations[1]) == ref.iterations
+    np.testing.assert_allclose(np.asarray(res.x[1]), np.asarray(ref.x),
+                               rtol=1e-12, atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: 32^3 bucket, one trace
+# ---------------------------------------------------------------------------
+
+
+def test_batched_32cubed_bucket_single_trace():
+    """ISSUE acceptance: solve_many over N=8 stacked 32^3 Poisson
+    systems (shared pattern, perturbed values) matches sequential solves
+    in iteration counts and final residuals within dtype tolerance, and
+    ONE jit trace serves the bucket across repeat batches."""
+    A = gallery.poisson("7pt", 32, 32, 32).init()
+    mats = [_diag_shift(A, 0.15 * i) for i in range(8)]
+    bs = BatchedSolver(Config.from_string(BATCHED_CG))
+    bs.setup(mats[0])
+    B = _rhs(A, 8, seed=6)
+    res = bs.solve_many(B, matrices=mats)
+    assert res.all_converged
+    assert bs.trace_count == 1
+    # a second batch in the same bucket (new values, same pattern)
+    # reuses the trace — the serving contract
+    mats2 = [_diag_shift(A, 0.1 + 0.2 * i) for i in range(8)]
+    res2 = bs.solve_many(B, matrices=mats2)
+    assert res2.all_converged
+    assert bs.trace_count == 1, "bucket re-traced on a value-only change"
+    # parity of the first batch against the sequential loop
+    for i in range(0, 8, 3):
+        bs.solver.resetup(mats[i])
+        ref = bs.solver.solve(B[i])
+        assert int(res.iterations[i]) == ref.iterations
+        np.testing.assert_allclose(res.res_norm[i], ref.res_norm,
+                                   rtol=1e-9)
+        tr = np.linalg.norm(np.asarray(
+            ops.residual(mats[i].init(), res.x[i], jnp.asarray(B[i]))))
+        assert tr <= 1e-7 * np.linalg.norm(B[i])
+
+
+def test_multi_matrix_rejects_trace_baking_solver(poisson16):
+    """CHEBYSHEV bakes its spectrum into the trace as Python floats —
+    one batched trace cannot serve per-system spectra, so multi-matrix
+    batching must refuse it instead of silently using the last
+    system's."""
+    bs = BatchedSolver(Config.from_string(
+        "solver(s)=PCG, s:max_iters=100, s:monitor_residual=1,"
+        " s:tolerance=1e-8, s:preconditioner(c)=CHEBYSHEV,"
+        " c:max_iters=2, c:chebyshev_lambda_estimate_mode=2,"
+        " c:preconditioner=NOSOLVER"))
+    bs.setup(poisson16)
+    with pytest.raises(BadParametersError, match="bakes"):
+        bs.solve_many(_rhs(poisson16, 2), matrices=[
+            poisson16, _diag_shift(poisson16, 1.0)])
+
+
+def test_batched_cache_invalidated_with_solver_traces(poisson16):
+    """A resetup that invalidates the wrapped solver's traces (value-
+    baking CHEBYSHEV) must also invalidate the batched wrapper's cache,
+    or solve_many would replay the OLD spectrum."""
+    s = amgx.create_solver(Config.from_string(
+        "solver=CHEBYSHEV, max_iters=150, monitor_residual=1,"
+        " tolerance=1e-8, chebyshev_lambda_estimate_mode=2"))
+    s.setup(poisson16)
+    B = _rhs(poisson16, 2, seed=11)
+    s.solve_many(B)
+    A2 = _diag_shift(poisson16, 3.0)
+    s.resetup(A2)                     # re-bakes the spectrum
+    res = s.solve_many(B)
+    s2 = amgx.create_solver(Config.from_string(
+        "solver=CHEBYSHEV, max_iters=150, monitor_residual=1,"
+        " tolerance=1e-8, chebyshev_lambda_estimate_mode=2"))
+    s2.setup(A2)
+    for i in range(2):
+        ref = s2.solve(B[i])
+        assert int(res.iterations[i]) == ref.iterations
+        np.testing.assert_allclose(np.asarray(res.x[i]), np.asarray(ref.x),
+                                   rtol=1e-12, atol=1e-12)
+
+
+def test_multi_matrix_requires_structure_reuse(poisson16):
+    """Multi-matrix batching without structure_reuse_levels=-1 would
+    re-coarsen per system; it must be rejected up front."""
+    cfg = Config.from_string(
+        BATCHED_CG.replace("amg:structure_reuse_levels=-1",
+                           "amg:structure_reuse_levels=0"))
+    bs = BatchedSolver(cfg)
+    bs.setup(poisson16)
+    with pytest.raises(BadParametersError, match="structure_reuse"):
+        bs.solve_many(_rhs(poisson16, 2), matrices=[
+            poisson16, _diag_shift(poisson16, 1.0)])
+
+
+# ---------------------------------------------------------------------------
+# multi-RHS SpMV paths
+# ---------------------------------------------------------------------------
+
+
+def test_spmv_multi_matches_loop():
+    from amgx_tpu.ops.batched import spmv_multi
+    A = gallery.poisson("5pt", 12, 12)
+    X = np.random.default_rng(7).standard_normal((5, A.num_rows))
+    for layout in ("auto", "always", "never"):
+        M = A.init(ell=layout)
+        Y = np.asarray(spmv_multi(M, jnp.asarray(X)))
+        for i in range(5):
+            np.testing.assert_allclose(
+                Y[i], np.asarray(ops.spmv(M, jnp.asarray(X[i]))),
+                rtol=1e-13, atol=1e-13)
+
+
+def test_spmv_multi_layout_coverage():
+    """The dispatch must actually exercise the DIA and ELL fast paths."""
+    A = gallery.poisson("5pt", 12, 12)
+    dia = A.init(ell="auto")
+    assert dia.dia_offsets is not None
+    ell = A.init(ell="always")
+    assert ell.ell_cols is not None and ell.dia_offsets is None
+
+
+# ---------------------------------------------------------------------------
+# request batcher
+# ---------------------------------------------------------------------------
+
+
+def test_pattern_fingerprint(poisson16):
+    other = gallery.poisson("7pt", 6, 6, 6).init()
+    fp = pattern_fingerprint(poisson16)
+    assert fp == pattern_fingerprint(
+        poisson16.with_values(np.asarray(poisson16.values) * 3.0))
+    assert fp == pattern_fingerprint(_diag_shift(poisson16, 2.0))
+    assert fp != pattern_fingerprint(other)
+
+
+def test_pad_ladder():
+    assert [pad_to_bucket_size(n) for n in (1, 2, 3, 5, 8, 9, 31, 32, 99)] \
+        == [1, 2, 4, 8, 8, 16, 32, 32, 32]
+
+
+def test_request_batcher_buckets_and_pads(poisson16):
+    """Mixed-pattern stream: one drain dispatches one padded batch per
+    (pattern, dtype) bucket and every ticket gets its own solution."""
+    other = gallery.poisson("7pt", 6, 6, 6).init()
+    rb = RequestBatcher(Config.from_string(BATCHED_CG))
+    rng = np.random.default_rng(8)
+    reqs = [rb.submit(poisson16, rng.standard_normal(poisson16.num_rows))
+            for _ in range(3)]
+    reqs += [rb.submit(other, rng.standard_normal(other.num_rows))
+             for _ in range(2)]
+    assert rb.pending_count() == 5
+    done = rb.drain()
+    assert len(done) == 5 and rb.pending_count() == 0
+    # two buckets; 3 requests pad to 4, 2 to 2
+    sizes = sorted((real, padded) for _, real, padded in rb.dispatch_log)
+    assert sizes == [(2, 2), (3, 4)]
+    for r in reqs:
+        assert r.done and r.result.converged
+        tr = np.linalg.norm(np.asarray(
+            ops.residual(r.A, r.result.x, jnp.asarray(r.b))))
+        assert tr <= 1e-6 * np.linalg.norm(r.b)
+
+
+def test_request_batcher_same_pattern_values_differ(poisson16):
+    """Same-pattern different-values requests land in ONE bucket and run
+    as a multi-matrix batch."""
+    rb = RequestBatcher(Config.from_string(BATCHED_CG))
+    rng = np.random.default_rng(9)
+    mats = [_diag_shift(poisson16, 0.5 * i) for i in range(3)]
+    reqs = [rb.submit(M, rng.standard_normal(M.num_rows)) for M in mats]
+    rb.drain()
+    assert len(rb.dispatch_log) == 1 and rb.dispatch_log[0][2] == 4
+    for i, r in enumerate(reqs):
+        assert r.result.converged
+        tr = np.linalg.norm(np.asarray(
+            ops.residual(mats[i], r.result.x, jnp.asarray(r.b))))
+        assert tr <= 1e-6 * np.linalg.norm(r.b)
+    # shifted systems are better conditioned: counts must be per-system
+    its = [r.result.iterations for r in reqs]
+    assert its[0] >= its[-1]
+
+
+def test_request_batcher_template_not_stale_after_duplicates(poisson16):
+    """Interleaved duplicate matrices in a multi-matrix dispatch leave
+    the solver holding the last FIRST-seen system's values; a following
+    single-matrix drain must not trust stale template bookkeeping."""
+    rb = RequestBatcher(Config.from_string(BATCHED_CG))
+    rng = np.random.default_rng(12)
+    A1 = _diag_shift(poisson16, 5.0)
+    A2 = poisson16
+    for M in (A2, A1, A2):                      # duplicate interleaved
+        rb.submit(M, rng.standard_normal(M.num_rows))
+    rb.drain()
+    b = rng.standard_normal(A2.num_rows)
+    reqs = [rb.submit(A2, b), rb.submit(A2, rng.standard_normal(
+        A2.num_rows))]
+    rb.drain()
+    # solved against A2, not the leftover A1 coefficients
+    tr = np.linalg.norm(np.asarray(
+        ops.residual(A2, reqs[0].result.x, jnp.asarray(b))))
+    assert tr <= 1e-6 * np.linalg.norm(b)
+
+
+# ---------------------------------------------------------------------------
+# C-API surface
+# ---------------------------------------------------------------------------
+
+
+def test_capi_batched_solve(poisson16):
+    from amgx_tpu import capi
+    from amgx_tpu.errors import RC
+    rc, cfg_h = capi.AMGX_config_create(BATCHED_CG)
+    assert rc == RC.OK
+    rc, rs_h = capi.AMGX_resources_create_simple(cfg_h)
+    rc, m_h = capi.AMGX_matrix_create(rs_h, "dDDI")
+    n = poisson16.num_rows
+    assert capi.AMGX_matrix_upload_all(
+        m_h, n, poisson16.nnz, 1, 1,
+        np.asarray(poisson16.row_offsets), np.asarray(poisson16.col_indices),
+        np.asarray(poisson16.values), None) == RC.OK
+    rc, s_h = capi.AMGX_solver_create(rs_h, "dDDI", cfg_h)
+    rc, b_h = capi.AMGX_vector_create(rs_h, "dDDI")
+    rc, x_h = capi.AMGX_vector_create(rs_h, "dDDI")
+    B = np.random.default_rng(10).standard_normal((4, n))
+    assert capi.AMGX_vector_upload_batched(b_h, 4, n, 1, B) == RC.OK
+    rc, nn, bd = capi.AMGX_vector_get_size(b_h)
+    assert (nn, bd) == (n, 1)
+    assert capi.AMGX_solver_setup(s_h, m_h) == RC.OK
+    assert capi.AMGX_solver_solve_batched(s_h, b_h, x_h) == RC.OK
+    rc, status = capi.AMGX_solver_get_status(s_h)
+    assert (rc, status) == (RC.OK, 0)
+    rc, statuses = capi.AMGX_solver_get_batch_status(s_h)
+    assert rc == RC.OK and statuses.tolist() == [0, 0, 0, 0]
+    rc, X = capi.AMGX_vector_download(x_h)
+    assert rc == RC.OK and X.shape == (4, n)
+    for i in range(4):
+        tr = np.linalg.norm(np.asarray(
+            ops.residual(poisson16, jnp.asarray(X[i]), jnp.asarray(B[i]))))
+        assert tr <= 1e-6 * np.linalg.norm(B[i])
+    # a plain (unbatched) rhs must be rejected by the batched entry
+    rc, b2_h = capi.AMGX_vector_create(rs_h, "dDDI")
+    capi.AMGX_vector_upload(b2_h, n, 1, B[0])
+    assert capi.AMGX_solver_solve_batched(s_h, b2_h, x_h) == \
+        RC.BAD_PARAMETERS
+
+
+# ---------------------------------------------------------------------------
+# resetup-contract satellites
+# ---------------------------------------------------------------------------
+
+
+def test_value_resetup_plan_rejects_ell_swell_cache():
+    """amg/value_resetup.py invariant: the fused splice rewrites only
+    values/dia_vals, so a hierarchy whose matrices carry ELL/SWELL
+    caches must be ineligible (they would keep serving old values)."""
+    from amgx_tpu.amg.value_resetup import build_plan
+    from amgx_tpu.presets import FLAGSHIP
+    A = gallery.poisson("7pt", 16, 16, 16).init()
+    s = amgx.create_solver(Config.from_string(
+        FLAGSHIP + ", amg:structure_reuse_levels=-1"))
+    s.setup(A)
+    amg = s.preconditioner.preconditioner.amg
+    assert build_plan(amg) is not None, "flagship 16^3 should be eligible"
+    lv = amg.levels[1]
+    nr = lv.A.num_rows
+    lv.A = dataclasses.replace(
+        lv.A, ell_cols=jnp.zeros((nr, 1), jnp.int32),
+        ell_vals=jnp.zeros((nr, 1), lv.A.dtype))
+    assert build_plan(amg) is None, \
+        "ELL cache on a level matrix must disqualify the fused splice"
+
+
+def test_debug_resetup_contract_ok(monkeypatch, poisson16):
+    """AMGX_TPU_DEBUG_RESETUP: a conforming solver resetups cleanly with
+    the contract checks on."""
+    monkeypatch.setenv("AMGX_TPU_DEBUG_RESETUP", "1")
+    s = amgx.create_solver(Config.from_string(
+        "solver=PCG, max_iters=200, monitor_residual=1, tolerance=1e-8,"
+        " preconditioner(j)=BLOCK_JACOBI, j:max_iters=2"))
+    s.setup(poisson16)
+    r1 = s.solve(np.ones(poisson16.num_rows))
+    s.resetup(_diag_shift(poisson16, 1.0))
+    r2 = s.solve(np.ones(poisson16.num_rows))
+    assert r1.converged and r2.converged
+    assert r2.iterations < r1.iterations   # new values really applied
+
+
+def test_debug_resetup_contract_catches_stale_solve_data(monkeypatch,
+                                                         poisson16):
+    """A solver that caches value-derived state outside solve_data
+    violates the _resetup_kept_static contract; the debug assertion
+    must catch it at resetup time."""
+    from amgx_tpu.solvers.base import Solver
+
+    class StaleDataSolver(Solver):
+        def solver_setup(self):
+            if not hasattr(self, "_data"):      # BUG: cached across
+                self._data = {"A": self.A,      # resetups — new values
+                              "dinv": 1.0 / self.A.diagonal()}  # never
+                                                # reach the solve
+
+        def solve_data(self):
+            return self._data
+
+        def computes_residual(self):
+            return False
+
+        def solve_iteration(self, data, b, st):
+            out = dict(st)
+            out["x"] = st["x"] + data["dinv"] * (b - ops.spmv(
+                data["A"], st["x"]))
+            return out
+
+    monkeypatch.setenv("AMGX_TPU_DEBUG_RESETUP", "1")
+    s = StaleDataSolver(Config.from_string("max_iters=2"), name="STALE")
+    s.setup(poisson16)
+    with pytest.raises(AssertionError, match="solve_data"):
+        s.resetup(_diag_shift(poisson16, 1.0))

@@ -58,6 +58,7 @@ def system():
 
 
 class TestUploadDistributed:
+    @pytest.mark.slow
     def test_pieces_reproduce_global_solve(self, system):
         A, b = system
         n = A.num_rows
@@ -154,7 +155,7 @@ class TestUploadDistributed:
         physical layout (pure slicing, no renumbering)."""
         A, b = system
         import jax
-        from jax import shard_map
+        from amgx_tpu._compat import shard_map
         from jax.sharding import Mesh, PartitionSpec as P
         from amgx_tpu.distributed.partition import (
             partition_from_pieces, partition_vector, unpartition_vector)
@@ -211,6 +212,7 @@ class TestUploadDistributed:
         assert capi._get(mtx).part is not None
 
 
+@pytest.mark.slow
 def test_replace_coefficients_pieces_path(system):
     """Coefficient replacement on the pieces path: per-rank value
     updates re-run the arranger against the stored structure; resetup
@@ -250,6 +252,7 @@ def test_replace_coefficients_pieces_path(system):
     assert np.linalg.norm(r2) / np.linalg.norm(b) < 1e-7
 
 
+@pytest.mark.slow
 def test_replace_coefficients_pieces_with_diag(system):
     """Pieces uploaded WITH external diag_data: replacement re-folds
     per rank against the stored pre-fold structure."""
@@ -338,6 +341,7 @@ CLS_CFG = ("config_version=2, solver(s)=FGMRES, s:max_iters=60,"
            " amg:amg_host_setup=never")
 
 
+@pytest.mark.slow
 def test_classical_pieces_path_parity(system):
     """CLASSICAL from per-rank pieces: the sharded PMIS+D1 setup
     (distributed/setup_classical.py) makes the pieces path work for

@@ -36,8 +36,9 @@ def dist_spmv_global(A, n_ranks, mesh, x):
         return local.spmv(xs[0])[None]
 
     pspec = jax.tree.map(lambda _: P("p"), sm)
-    mapped = jax.shard_map(fn, mesh=mesh, in_specs=(pspec, P("p")),
-                           out_specs=P("p"), check_vma=False)
+    from amgx_tpu._compat import shard_map
+    mapped = shard_map(fn, mesh=mesh, in_specs=(pspec, P("p")),
+                       out_specs=P("p"), check_vma=False)
     yl = mapped(sm, xl)
     return np.asarray(unpartition_vector(yl, A.num_rows)), part
 
@@ -244,6 +245,7 @@ def _single_device_iters(cfg_str, A, b):
      " amg:distributed_setup_mode=global"),
     ("CLASSICAL", ", amg:smoother=BLOCK_JACOBI, amg:relaxation_factor=0.9"),
 ])
+@pytest.mark.slow
 def test_distributed_amg_matches_single_device(mesh, algo, extra):
     """Distributed FGMRES+AMG must converge with iteration counts equal
     to the single-device run (the hierarchy and smoother math are

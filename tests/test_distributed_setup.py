@@ -63,6 +63,7 @@ def _n_sharded_levels(d):
                for lv in amg.levels)
 
 
+@pytest.mark.slow
 class TestShardedSetupParity:
     def test_iteration_and_hierarchy_parity(self):
         A = _poisson()
@@ -182,6 +183,7 @@ class TestShardedSetupFallback:
         assert bool(r.converged)
 
 
+@pytest.mark.slow
 class TestShardedMultipass:
     """SIZE_4/SIZE_8/MULTI_PAIRWISE sharded: later matching passes run
     on the coarse weight graph (its own device-built halo maps), the
@@ -216,6 +218,7 @@ class TestShardedMultipass:
         assert _n_sharded_levels(d) >= 1
 
 
+@pytest.mark.slow
 def test_sharded_chebyshev_poly_smoother():
     """CHEBYSHEV_POLY in the sharded setup: the taus come from the
     global (psum'd via stacked max) Gershgorin bound — iteration parity
@@ -230,6 +233,7 @@ def test_sharded_chebyshev_poly_smoother():
     assert _n_sharded_levels(d) >= 1
 
 
+@pytest.mark.slow
 class TestShardedStrongSmoothers:
     """MULTICOLOR_DILU / MULTICOLOR_GS built per-shard (VERDICT-r4 #1):
     the sharded JPL coloring hashes SEMANTIC global ids with a halo
@@ -296,6 +300,7 @@ CLS_BASE = ("config_version=2, solver(s)=FGMRES, s:max_iters=60,"
             " amg:max_levels=12, amg:amg_host_setup=never")
 
 
+@pytest.mark.slow
 class TestShardedClassicalSetup:
     """Sharded classical PMIS+D1 build (distributed/setup_classical.py
     — the classical_amg_level.cu:254-341 per-rank analog)."""

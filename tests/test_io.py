@@ -1,6 +1,9 @@
 """IO tests (analogs of capi_upload_tests.cu / matrix IO paths)."""
+import os
+
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 from amgx_tpu import gallery
 from amgx_tpu.io import read_system, write_system
@@ -11,6 +14,9 @@ def dense(A):
     return np.asarray(A.to_dense())
 
 
+@pytest.mark.skipif(
+    not os.path.exists("/root/reference/examples/matrix.mtx"),
+    reason="reference checkout not present on this machine")
 def test_reference_example_matrix():
     # the 12-row demo matrix shipped with the reference (examples/matrix.mtx)
     A, b, x = read_system("/root/reference/examples/matrix.mtx")

@@ -217,6 +217,7 @@ class TestMultipass:
         assert np.all(rowsums[chk] >= 0.5)
         assert (np.abs(rowsums[chk] - 1.0) < 1e-10).mean() > 0.9
 
+    @pytest.mark.slow
     def test_aggressive_multipass_amg_converges(self):
         A = gallery.poisson("27pt", 10, 10, 10).init()
         cfg = Config.from_string(

@@ -191,6 +191,7 @@ def test_res_history_monotone_cg(poisson32, rhs32):
     assert hist.shape[0] == res.iterations + 1
 
 
+@pytest.mark.slow
 def test_chebyshev_resetup_rebakes_spectrum(poisson32, rhs32):
     """CHEBYSHEV bakes its lambda estimates into the trace as Python
     floats; a value-only resetup must re-trace (base.py jit-cache gate
