@@ -61,19 +61,29 @@ def finalize():
 
 
 def create_solver(cfg: Config, scope: str = "default"):
-    """Build the root solver tree from a config (AMG_Solver analog)."""
+    """Build the root solver tree from a config (AMG_Solver analog).
+    A non-empty `fallback_policy` wraps the tree in a ResilientSolver
+    (resilience/policy.py) so failed solves run their configured
+    recovery chains transparently."""
     initialize()
     from .solvers.base import make_solver
     name, child_scope = cfg.get_solver("solver", scope)
-    return make_solver(name, cfg, child_scope)
+    slv = make_solver(name, cfg, child_scope)
+    if str(cfg.get("fallback_policy", child_scope)).strip():
+        from .resilience.policy import ResilientSolver
+        return ResilientSolver(cfg, child_scope, solver=slv)
+    return slv
 
 
 def __getattr__(name):
-    # lazy: batch pulls in the solver registry, which stays an
-    # initialize()-time side effect for plain `import amgx_tpu`
+    # lazy: batch/resilience pull in the solver registry, which stays
+    # an initialize()-time side effect for plain `import amgx_tpu`
     if name == "batch":
         from . import batch
         return batch
+    if name == "resilience":
+        from . import resilience
+        return resilience
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 

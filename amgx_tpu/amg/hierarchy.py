@@ -359,6 +359,11 @@ class AMG:
                 break
             with trace_region(f"amg.L{lvl}.galerkin"):
                 Ac = level.create_coarse_matrix()
+            # resilience fault harness: a `galerkin_perturb` spec scales
+            # this level's coarse values (host-orchestrated — no cached
+            # trace can replay it); inert when nothing is armed
+            from ..resilience import faultinject as _fault
+            Ac = _fault.perturb_galerkin(Ac, lvl)
             self.levels.append(level)
             self._prefetch_level(level)
             with trace_region(f"amg.L{lvl}.layout"):

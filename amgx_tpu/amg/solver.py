@@ -6,6 +6,8 @@ solve iteration is one multigrid cycle.
 """
 from __future__ import annotations
 
+import jax.numpy as jnp
+
 from .. import registry
 from ..solvers.base import Solver
 from .hierarchy import AMG
@@ -38,9 +40,19 @@ class AlgebraicMultigridSolver(Solver):
     def computes_residual(self):
         return False
 
+    def solve_init(self, data, b, x, r):
+        return self._guard_init()
+
     def solve_iteration(self, data, b, st):
         out = dict(st)
-        out["x"] = self.amg.cycle(data["amg"], b, st["x"])
+        x_new = self.amg.cycle(data["amg"], b, st["x"])
+        out["x"] = x_new
+        if self.health_guards:
+            # a non-finite cycle output means the hierarchy itself is
+            # broken (singular coarse factor, corrupted Galerkin
+            # values): BREAKDOWN, not a NaN storm at max_iters. Unused
+            # (and DCE'd by XLA) when AMG runs as a preconditioner.
+            out["breakdown"] = ~jnp.all(jnp.isfinite(x_new))
         return out
 
     def grid_stats(self):

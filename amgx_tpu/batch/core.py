@@ -64,9 +64,15 @@ class BatchedSolveResult:
     converged: np.ndarray           # (B,) bool
     res_norm: np.ndarray            # (B,) or (B, block)
     norm0: np.ndarray               # (B,) or (B, block)
-    res_history: Optional[np.ndarray] = None   # (B, hist_len[, block])
+    res_history: Optional[np.ndarray] = None   # (B, hist_len[, block]);
+    #                                 entries past a system's own stop
+    #                                 iteration are NaN-masked
     setup_time: float = 0.0
     solve_time: float = 0.0
+    # per-system SolveStatus codes (resilience/status.py), (B,) int —
+    # one system's NaN storm or breakdown is distinguishable from a
+    # neighbor's honest max-iters exit
+    status: Optional[np.ndarray] = None
 
     @property
     def batch_size(self) -> int:
@@ -89,7 +95,9 @@ class BatchedSolveResult:
                 converged=bool(self.converged[i]),
                 res_norm=self.res_norm[i], norm0=self.norm0[i],
                 res_history=hist, setup_time=self.setup_time,
-                solve_time=self.solve_time))
+                solve_time=self.solve_time,
+                status_code=int(self.status[i])
+                if self.status is not None else 1))
         return out
 
 
@@ -306,8 +314,10 @@ class BatchedSolver:
         axes_sig = (None if data_axes is None
                     else tuple(jax.tree.leaves(
                         data_axes, is_leaf=lambda a: a is None)))
-        key = (B.shape, str(B.dtype), axes_sig)
+        from ..resilience import faultinject as _fi
+        key = (B.shape, str(B.dtype), axes_sig, _fi.epoch())
         if key not in self._jit_cache:
+            _fi.evict_stale_epochs(self._jit_cache, key[-1])
             self._jit_cache[key] = self._build_batched_fn(data_axes)
         t0 = time.perf_counter()
         X, stats = jax.block_until_ready(self._jit_cache[key](data, B, X0))
@@ -315,16 +325,24 @@ class BatchedSolver:
         hist_len = slv.max_iters + 1
         iters = np.zeros(nb, np.int64)
         conv = np.zeros(nb, bool)
+        status = np.zeros(nb, np.int32)
         norm0, res_norm, hists = [], [], []
         for i, row in enumerate(np.asarray(stats)):
-            it, cv, n0, rn, h = Solver.unpack_stats(row, hist_len)
-            iters[i], conv[i] = it, cv
+            it, cv, sc, n0, rn, h = Solver.unpack_stats(row, hist_len)
+            iters[i], conv[i], status[i] = it, cv, sc
             norm0.append(n0)
             res_norm.append(rn)
-            hists.append(h)
+            # unpack_stats trims to each system's own stop iteration;
+            # re-pad with NaN so the batch stacks rectangular while
+            # post-exit garbage stays unmistakably masked
+            h = np.asarray(h)
+            pad = np.full((hist_len,) + h.shape[1:], np.nan, h.dtype)
+            pad[: h.shape[0]] = h
+            hists.append(pad)
         return BatchedSolveResult(
             x=X, iterations=iters, converged=conv,
             res_norm=np.asarray(res_norm), norm0=np.asarray(norm0),
             res_history=np.asarray(hists)
             if slv.store_res_history else None,
-            setup_time=self.setup_time, solve_time=solve_time)
+            setup_time=self.setup_time, solve_time=solve_time,
+            status=status)

@@ -32,6 +32,9 @@ from .config import Config
 from .errors import AMGXError, RC, get_error_string
 from .matrix import CsrMatrix
 from .modes import parse_mode
+from .resilience.status import (AMGX_SOLVE_DIVERGED, AMGX_SOLVE_FAILED,
+                                AMGX_SOLVE_NOT_CONVERGED,
+                                AMGX_SOLVE_SUCCESS, to_amgx_status)
 
 # ---------------------------------------------------------------------------
 # handle registry (CWrap analog, src/amgx_c_common.cu)
@@ -137,9 +140,10 @@ class _CSolver:
         self.result = None
 
     def build(self):
-        from .solvers.base import make_solver
-        name, scope = self.cfg.get_solver("solver", "default")
-        self.solver = make_solver(name, self.cfg, scope)
+        # the package-level factory owns the tree build AND the
+        # ResilientSolver wrapping rule (fallback_policy) — one site
+        from . import create_solver
+        self.solver = create_solver(self.cfg)
 
 
 class _CEigenSolver:
@@ -662,27 +666,47 @@ def AMGX_solver_solve_batched(slv_h, b_h, x_h):
     return RC.OK
 
 
+def _result_status_codes(result) -> np.ndarray:
+    """Per-system SolveStatus codes of a solve result (length 1 for a
+    plain solve). Falls back to the converged bools for result types
+    that predate status plumbing."""
+    codes = getattr(result, "status_code", None)
+    if codes is None:
+        codes = getattr(result, "status", None)       # batched results
+    if codes is None or isinstance(codes, str):
+        conv = np.atleast_1d(np.asarray(result.converged))
+        return np.where(conv, 0, 1).astype(np.int32)
+    return np.atleast_1d(np.asarray(codes)).astype(np.int32)
+
+
 @_api
 @_outputs(1)
 def AMGX_solver_get_status(slv_h):
-    """rc, status: 0 success, 1 failed, 2 diverged (AMGX_SOLVE_*)."""
+    """rc, status: real AMGX_SOLVE_* codes (include/amgx_c.h) —
+    AMGX_SOLVE_SUCCESS(0) / FAILED(1) / DIVERGED(2) /
+    NOT_CONVERGED(3), mapped from the in-trace SolveStatus
+    classification (resilience/status.py). A batched solve reports the
+    WORST system (severity-ordered codes)."""
     s = _get(slv_h, _CSolver)
     if s.result is None:
         raise AMGXError("no solve performed", RC.BAD_PARAMETERS)
-    return RC.OK, (0 if bool(np.all(s.result.converged)) else 1)
+    return RC.OK, to_amgx_status(int(np.max(
+        _result_status_codes(s.result))))
 
 
 @_api
 @_outputs(1)
 def AMGX_solver_get_batch_status(slv_h):
-    """rc, per-system statuses (0 success / 1 failed) as an int array —
-    batched extension pairing AMGX_solver_solve_batched. A plain solve
-    reports a length-1 array."""
+    """rc, per-system AMGX_SOLVE_* statuses as an int array — batched
+    extension pairing AMGX_solver_solve_batched (0 success / 1 failed /
+    2 diverged / 3 not converged). A plain solve reports a length-1
+    array."""
     s = _get(slv_h, _CSolver)
     if s.result is None:
         raise AMGXError("no solve performed", RC.BAD_PARAMETERS)
-    conv = np.atleast_1d(np.asarray(s.result.converged))
-    return RC.OK, np.where(conv, 0, 1).astype(np.int32)
+    return RC.OK, np.asarray(
+        [to_amgx_status(c) for c in _result_status_codes(s.result)],
+        np.int32)
 
 
 @_api
@@ -709,8 +733,9 @@ def AMGX_solver_get_iteration_residual(slv_h, it: int, idx: int = 0):
                                               # stays the system selector
         sysi = min(idx, hist.shape[1] - 1)
         # per-system range: an early-converged system's history rows
-        # past its OWN stopping iteration are frozen zero padding, not
-        # residuals — error like the single-solve truncation does
+        # past its OWN stopping iteration are NaN-masked padding
+        # (batch/core.py), not residuals — error like the single-solve
+        # truncation does
         if not (0 <= it <= int(np.asarray(s.result.iterations)[sysi])):
             raise AMGXError("iteration out of range for this system",
                             RC.BAD_PARAMETERS)

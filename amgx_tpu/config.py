@@ -299,6 +299,22 @@ def _register_default_parameters():
     # TPU-specific additions (new surface; no reference analog)
     R("spmv_impl", str, "SpMV implementation <AUTO|CSR_SEGSUM|ELL|PALLAS>", "AUTO")
     R("tpu_dtype", str, "override compute dtype <float32|float64|bfloat16>", "")
+    # resilience subsystem (amgx_tpu/resilience/)
+    R("health_guards", int, "in-trace NaN/breakdown guards in the solve "
+      "loop (status classification rides the existing residual check; "
+      "0 restores the bare converged/diverged monitor)", 1, BOOL01)
+    R("stall_detection_window", int, "flag STALLED when the residual "
+      "norm fails to improve over this many iterations (0 = off)", 0,
+      None, 0)
+    R("stall_tolerance", float, "minimum relative residual decrease "
+      "over the stall window; 0 = any non-decrease stalls", 0.0, None,
+      0.0, 1.0)
+    R("fallback_policy", str, "resilience chains "
+      "'STATUS>action[=arg]|...' (actions: retry, rescale_retry, "
+      "switch_solver=<NAME>, escalate_sweeps), applied host-side by "
+      "ResilientSolver when a solve ends in that status", "")
+    R("max_fallback_attempts", int, "bound on total fallback/retry "
+      "attempts per solve", 2, None, 0)
 
 
 _register_default_parameters()
@@ -431,7 +447,10 @@ class Config:
     def _set(self, scope: str, name: str, value: Any, new_scope: Optional[str]):
         desc = _REGISTRY.get(name)
         if desc is None:
-            raise BadConfigurationError(f"unknown parameter {name!r}")
+            from .errors import did_you_mean
+            raise BadConfigurationError(
+                f"unknown parameter {name!r}"
+                f"{did_you_mean(name, _REGISTRY)}")
         self.values[(scope, name)] = self._convert(desc, value)
         if new_scope:
             if name not in SOLVER_ROLE_PARAMS:
@@ -452,7 +471,10 @@ class Config:
             return self.values[("default", name)]
         desc = _REGISTRY.get(name)
         if desc is None:
-            raise BadParametersError(f"unknown parameter {name!r}")
+            from .errors import did_you_mean
+            raise BadParametersError(
+                f"unknown parameter {name!r}"
+                f"{did_you_mean(name, _REGISTRY)}")
         return desc.default
 
     def get_scope(self, name: str, scope: str = "default") -> str:

@@ -112,7 +112,12 @@ class ShardMatrix:
 
     def exchange_halo(self, x):
         """Fill the halo buffer from remote shards (exchange_halo analog).
-        `x` is the shard-local owned column-side vector (n_local_cols,)."""
+        `x` is the shard-local owned column-side vector (n_local_cols,).
+
+        The resilience fault harness hooks the received buffer
+        (`halo_corrupt` — the link-fault model, faultinject.py): a
+        trace-time no-op unless armed inside a solve-loop iteration."""
+        from ..resilience import faultinject as _fault
         if self.n_ranks == 1:
             return jnp.zeros((self.n_halo,), x.dtype)
         ax = self.axis_name
@@ -128,7 +133,7 @@ class ShardMatrix:
             halo = jnp.zeros((self.n_halo + 1,), x.dtype)
             halo = halo.at[self.recv_prev].set(from_prev)
             halo = halo.at[self.recv_next].set(from_next)
-            return halo[: self.n_halo]
+            return _fault.corrupt_halo(halo[: self.n_halo])
         if self.exchange_mode == "a2a":
             xp = jnp.concatenate([x, jnp.zeros((1,), x.dtype)])
             bufs = xp[self.a2a_send]            # (n_ranks, max_pair)
@@ -136,10 +141,10 @@ class ShardMatrix:
                                       concat_axis=0, tiled=True)
             halo = jnp.zeros((self.n_halo + 1,), x.dtype)
             halo = halo.at[self.a2a_recv].set(recv)
-            return halo[: self.n_halo]
+            return _fault.corrupt_halo(halo[: self.n_halo])
         x_all = jax.lax.all_gather(x, ax, tiled=True)   # padded global
         idx = jnp.clip(self.halo_src, 0, x_all.shape[0] - 1)
-        return x_all[idx]
+        return _fault.corrupt_halo(x_all[idx])
 
     def spmv(self, x):
         """Distributed y = A x with the interior/boundary overlap split

@@ -301,6 +301,18 @@ class DistributedSolver:
             local = jax.tree.map(lambda a: a[0], data)
             with comms.collective_axis(axis):
                 x, stats = raw(local, b[0], x0[0])
+                # all-reduce the SolveStatus (packed at stats[2]) so
+                # every shard reports the same outcome: the codes are
+                # severity-ordered (resilience/status.py), so pmax
+                # picks the worst — e.g. one shard's corrupted halo
+                # NaN beats a neighbor's locally-converged view. The
+                # converged flag (stats[1]) is re-derived from the
+                # reduced code: a shard-local converged=1 must not
+                # survive a peer's failure (SolveResult treats
+                # converged as authoritative)
+                worst = jax.lax.pmax(stats[2], axis)
+                stats = stats.at[2].set(worst).at[1].set(
+                    (worst == 0).astype(stats.dtype))
             return x[None], stats
 
         pspec = jax.tree.map(lambda _: P(axis), self._data)
@@ -312,26 +324,32 @@ class DistributedSolver:
         return jax.jit(mapped)
 
     def solve(self, b, x0=None) -> SolveResult:
+        from ..resilience import faultinject as _fi
         n = self.part.n_global
         bl = partition_vector(np.asarray(b), self.n_ranks,
                               self.part.n_local)
         xl = partition_vector(
             np.zeros(n, bl.dtype) if x0 is None else np.asarray(x0),
             self.n_ranks, self.part.n_local)
-        if self._fn is None:
+        if self._fn is None or getattr(self, "_fn_epoch", 0) != \
+                _fi.epoch():
+            # the faultinject epoch invalidates the cached shard_map
+            # program (same contract as the base solver's jit key)
             self._fn = self._build_fn()
+            self._fn_epoch = _fi.epoch()
         t0 = time.perf_counter()
         x, stats = jax.block_until_ready(self._fn(self._data, bl, xl))
         solve_time = time.perf_counter() - t0
-        iters_i, conv, n0, rn, hist = self.solver.unpack_stats(
+        iters_i, conv, status, n0, rn, hist = self.solver.unpack_stats(
             stats, self.solver.max_iters + 1)
         return SolveResult(
             x=unpartition_vector(x, n), iterations=iters_i,
             converged=conv, res_norm=np.asarray(rn),
             norm0=np.asarray(n0),
-            res_history=np.asarray(hist)[: iters_i + 1]
+            res_history=np.asarray(hist)
             if self.solver.store_res_history else None,
-            setup_time=self.setup_time, solve_time=solve_time)
+            setup_time=self.setup_time, solve_time=solve_time,
+            status_code=status)
 
 
 def _dinv(diag):

@@ -91,6 +91,20 @@ class NotImplementedError_(AMGXError):
         super().__init__(message, RC.NOT_IMPLEMENTED)
 
 
+def did_you_mean(name: str, candidates) -> str:
+    """A ' (did you mean ...?)' suffix for unknown-key errors, or ''
+    when nothing is close. Used by the config registry and the
+    component factories so a typo'd parameter or solver name fails
+    with a suggestion instead of a bare rejection."""
+    import difflib
+    matches = difflib.get_close_matches(
+        str(name), [str(c) for c in candidates], n=2, cutoff=0.6)
+    if not matches:
+        return ""
+    return " (did you mean " + " or ".join(
+        repr(m) for m in matches) + "?)"
+
+
 def fatal_error(message: str, rc: RC = RC.INTERNAL):
     """FatalError analog (include/error.h): raise an AMGXError."""
     raise AMGXError(message, rc)

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from ..matrix import CsrMatrix
+from ..resilience import faultinject as _fault
 
 
 def _ensure_init(A: CsrMatrix, x: jax.Array) -> CsrMatrix:
@@ -152,17 +153,21 @@ def spmv(A, x: jax.Array) -> jax.Array:
     """y = A @ x; dispatches on the layout chosen at init
     (multiply_block_size analog, src/multiply.cu:50). Non-CsrMatrix
     operands (distributed shard matrices, solve-operators) provide their
-    own .spmv — the Operator abstraction of include/operators/operator.h."""
+    own .spmv — the Operator abstraction of include/operators/operator.h.
+
+    The resilience fault harness hooks the output here: a trace-time
+    no-op unless an `spmv_nan` fault is armed AND a solve-loop
+    iteration scope is active (resilience/faultinject.py)."""
     if not isinstance(A, CsrMatrix):
-        return A.spmv(x)
+        return _fault.corrupt_spmv(A.spmv(x))
     _ensure_init(A, x)
     if A.dia_offsets is not None:
-        return spmv_dia(A, x)
+        return _fault.corrupt_spmv(spmv_dia(A, x))
     if A.swell_cols is not None:
-        return spmv_swell(A, x)
+        return _fault.corrupt_spmv(spmv_swell(A, x))
     if A.ell_cols is not None:
-        return spmv_ell(A, x)
-    return spmv_csr_segsum(A, x)
+        return _fault.corrupt_spmv(spmv_ell(A, x))
+    return _fault.corrupt_spmv(spmv_csr_segsum(A, x))
 
 
 def multiply(A: CsrMatrix, x: jax.Array, view: str = "OWNED") -> jax.Array:

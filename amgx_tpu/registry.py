@@ -41,8 +41,10 @@ class Factory:
         try:
             return self._ctors[name.upper()]
         except KeyError:
+            from .errors import did_you_mean
             raise BadParametersError(
-                f"{self.kind} factory: unknown name {name!r}; "
+                f"{self.kind} factory: unknown name {name!r}"
+                f"{did_you_mean(name.upper(), self._ctors)}; "
                 f"registered: {sorted(self._ctors)}") from None
 
     def create(self, name: str, *args, **kwargs):

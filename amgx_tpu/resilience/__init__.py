@@ -1,0 +1,35 @@
+"""Solver resilience subsystem.
+
+Production serving needs solves that fail *diagnosably* and degrade
+*gracefully*. This package supplies the three layers:
+
+- `status`: the `SolveStatus` vocabulary (CONVERGED / MAX_ITERS /
+  STALLED / DIVERGED / BREAKDOWN / NAN_DETECTED), carried in-trace by
+  the solve loop (solvers/base.py) at zero extra device->host syncs,
+  plus the AMGX_SOLVE_* mapping for the C API;
+- `policy`: the declarative, bounded fallback/retry engine
+  (`ResilientSolver`), configured via the `fallback_policy` config
+  parameter;
+- `faultinject`: the deterministic fault harness (SpMV NaNs, Galerkin
+  perturbation, halo corruption) that proves every status code and
+  every fallback edge is reachable.
+
+`policy` is imported lazily: it pulls in the solver tree, while
+`status`/`faultinject` are dependency-free and are imported by low
+layers (ops/spmv.py, solvers/base.py).
+"""
+from __future__ import annotations
+
+from . import faultinject  # noqa: F401
+from .status import (  # noqa: F401
+    AMGX_SOLVE_DIVERGED, AMGX_SOLVE_FAILED, AMGX_SOLVE_NOT_CONVERGED,
+    AMGX_SOLVE_SUCCESS, SolveStatus, status_string, to_amgx_status)
+
+
+def __getattr__(name):
+    if name in ("policy", "ResilientSolver", "parse_fallback_policy"):
+        from . import policy
+        if name == "policy":
+            return policy
+        return getattr(policy, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,251 @@
+"""Deterministic fault injection for resilience testing.
+
+Proves every `SolveStatus` code and every fallback edge actually fires
+(tests/test_resilience.py) by corrupting the numerics at three seams:
+
+- **SpMV output** (`corrupt_spmv`, hooked in ops/spmv.py): poison one
+  element of y = A x with a non-finite value at a configured solve
+  iteration — the NaN then storms through the Krylov state and must be
+  caught by the in-trace health guards.
+- **Galerkin values** (`perturb_galerkin`, hooked in amg/hierarchy.py):
+  scale one level's coarse operator during the hierarchy build,
+  wrecking the AMG preconditioner without touching the fine system.
+- **Halo exchange** (`corrupt_halo`, hooked in
+  distributed/dist_matrix.py): poison one received halo entry at a
+  configured iteration — the distributed analog of a link fault; every
+  shard must agree on the resulting status.
+
+Injection is TRACE-TIME: an armed spec bakes the (iteration-gated)
+corruption into the next trace that crosses a hook, then `fires`
+decrements. The injection `epoch()` participates in the solver-side jit
+cache keys, so arming/consuming/disarming naturally invalidates traces
+— a consumed spec's retry gets a CLEAN fresh trace (the transient-fault
+model the fallback engine's plain `retry` action exploits), and a
+never-armed process pays nothing (epoch stays 0 forever).
+
+The in-loop hooks fire only while an iteration scope is active (set by
+the solve-loop body around `solve_iteration`), so setup-phase SpMVs and
+halo exchanges are never corrupted by a loop-targeted spec.
+
+Arm programmatically::
+
+    with faultinject.inject("spmv_nan", iteration=3):
+        res = slv.solve(b)          # status == NAN_DETECTED
+
+or via the environment (AMGX_TPU_DEBUG_RESETUP-style toggle)::
+
+    AMGX_TPU_FAULT_INJECT="spmv_nan:iteration=3:fires=1"
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import math
+import os
+from typing import Optional
+
+KINDS = ("spmv_nan", "halo_corrupt", "galerkin_perturb")
+
+_ENV_VAR = "AMGX_TPU_FAULT_INJECT"
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    kind: str              # one of KINDS
+    iteration: int = 0     # 0-based solve iteration the fault fires at
+    index: int = 0         # flat element (spmv/halo) or level (galerkin)
+    value: float = math.nan  # poison value for spmv/halo corruption
+    scale: float = 100.0   # multiplicative perturbation for galerkin
+    fires: Optional[int] = 1  # armed traces/applications left; None = always
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"faultinject: unknown kind {self.kind!r} "
+                f"(choose from {KINDS})")
+
+
+_SPEC: Optional[FaultSpec] = None
+_EPOCH = 0
+_ENV_CHECKED = False
+# traced iteration counter of the solve loop currently being traced
+# (None outside a loop body — setup-phase hooks then stay inert)
+_ITER = None
+
+
+def epoch() -> int:
+    """Monotone counter bumped on every arm/consume/disarm. Folded into
+    solve-side jit cache keys so injection state changes retrace."""
+    _check_env()
+    return _EPOCH
+
+
+def evict_stale_epochs(cache: dict, current_epoch: int):
+    """Drop cache entries keyed under older injection epochs (the epoch
+    is the LAST element of every participating cache key). They are
+    unreachable — the epoch only moves forward — and may be
+    deliberately poisoned traces; periodic fault drills must not grow
+    the solve caches without bound. Owned here so every epoch-keyed
+    cache (solvers/base.py, batch/core.py) evicts by the same rule."""
+    for k in [k for k in cache if k[-1] != current_epoch]:
+        del cache[k]
+
+
+def _bump():
+    global _EPOCH
+    _EPOCH += 1
+
+
+def _check_env():
+    """Arm a spec from AMGX_TPU_FAULT_INJECT on first use:
+    `kind[:key=value[:key=value...]]` with keys iteration/index/value/
+    scale/fires (fires=none for an always-on fault)."""
+    global _ENV_CHECKED, _SPEC
+    if _ENV_CHECKED:
+        return
+    _ENV_CHECKED = True
+    raw = os.environ.get(_ENV_VAR, "").strip()
+    if not raw or _SPEC is not None:
+        return
+    parts = raw.split(":")
+    kw = {}
+    for item in parts[1:]:
+        k, _, v = item.partition("=")
+        k = k.strip()
+        if k == "fires":
+            kw[k] = None if v.strip().lower() in ("none", "inf") else int(v)
+        elif k in ("iteration", "index"):
+            kw[k] = int(v)
+        elif k in ("value", "scale"):
+            kw[k] = float(v)
+    _SPEC = FaultSpec(parts[0].strip(), **kw)
+    _bump()
+
+
+def arm(spec: FaultSpec):
+    """Install `spec` as the active fault (replacing any previous)."""
+    global _SPEC, _ENV_CHECKED
+    _ENV_CHECKED = True          # explicit arming overrides the env
+    _SPEC = spec
+    _bump()
+
+
+def disarm():
+    global _SPEC
+    if _SPEC is not None:
+        _SPEC = None
+        _bump()
+
+
+@contextlib.contextmanager
+def inject(kind: str, **kw):
+    """Arm a fault for the duration of the block (disarmed on exit even
+    if already consumed)."""
+    arm(FaultSpec(kind, **kw))
+    try:
+        yield
+    finally:
+        disarm()
+
+
+def active(kind: str) -> Optional[FaultSpec]:
+    """The armed spec for `kind`, if it has fires left."""
+    _check_env()
+    s = _SPEC
+    if s is None or s.kind != kind:
+        return None
+    if s.fires is not None and s.fires <= 0:
+        return None
+    return s
+
+
+def consume(kind: str):
+    """Record one firing (one poisoned trace, or one applied galerkin
+    perturbation). Called at trace/apply time by the hooks' owners."""
+    s = active(kind)
+    if s is not None and s.fires is not None:
+        s.fires -= 1
+        _bump()
+
+
+# kinds whose corruption hooks were actually reached while tracing the
+# current solve loop — a fires-limited fault must only be spent by a
+# trace that really contains its injection site (an armed halo fault
+# must survive unrelated single-device solves untouched)
+_HOOK_HITS = set()
+
+
+def any_loop_fault_armed() -> bool:
+    """Is an in-loop fault (spmv/halo) armed? The solve-loop tracer
+    consumes one firing per trace when this is true."""
+    return active("spmv_nan") is not None or \
+        active("halo_corrupt") is not None
+
+
+def consume_loop_faults():
+    """Spend one firing for each in-loop kind whose hook fired during
+    the trace that just completed."""
+    for kind in ("spmv_nan", "halo_corrupt"):
+        if kind in _HOOK_HITS:
+            consume(kind)
+    _HOOK_HITS.clear()
+
+
+# -- iteration scope (links the loop counter to the deep hooks) ---------
+
+
+@contextlib.contextmanager
+def iteration_scope(it):
+    """Declare the traced iteration counter while `solve_iteration` is
+    being traced, so hooks buried under spmv/halo can gate on it."""
+    global _ITER
+    prev = _ITER
+    _ITER = it
+    try:
+        yield
+    finally:
+        _ITER = prev
+
+
+# -- hooks (trace-time no-ops when nothing is armed) --------------------
+
+
+def corrupt_spmv(y):
+    """Poison y[index] with `value` at the configured iteration. Inert
+    outside a solve loop (no iteration scope)."""
+    spec = active("spmv_nan")
+    if spec is None or _ITER is None:
+        return y
+    import jax.numpy as jnp
+    _HOOK_HITS.add("spmv_nan")
+    hit = _ITER == spec.iteration
+    return y.at[spec.index].set(
+        jnp.where(hit, jnp.asarray(spec.value, y.dtype), y[spec.index]))
+
+
+def corrupt_halo(halo):
+    """Poison one received halo entry at the configured iteration."""
+    spec = active("halo_corrupt")
+    if spec is None or _ITER is None or halo.shape[0] == 0:
+        return halo
+    import jax.numpy as jnp
+    _HOOK_HITS.add("halo_corrupt")
+    idx = min(spec.index, halo.shape[0] - 1)
+    hit = _ITER == spec.iteration
+    return halo.at[idx].set(
+        jnp.where(hit, jnp.asarray(spec.value, halo.dtype), halo[idx]))
+
+
+def perturb_galerkin(Ac, level: int):
+    """Scale a coarse-level operator's values during the hierarchy
+    build (spec.index selects the level). Consumes one firing per
+    applied perturbation — host-orchestrated, so no trace caching can
+    replay it."""
+    spec = active("galerkin_perturb")
+    if spec is None or level != spec.index:
+        return Ac
+    consume("galerkin_perturb")
+    diag = None
+    if getattr(Ac, "has_external_diag", False):
+        diag = Ac.diag * spec.scale
+    return Ac.with_values(Ac.values * spec.scale, diag)

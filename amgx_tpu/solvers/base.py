@@ -36,6 +36,9 @@ from ..matrix import CsrMatrix
 from ..ops import blas
 from ..ops.spmv import residual as _residual
 from ..output import amgx_printf
+from ..resilience import faultinject as _fi
+from ..resilience.status import RUNNING as _ST_RUNNING
+from ..resilience.status import SolveStatus, status_string
 
 # ---------------------------------------------------------------------------
 # convergence criteria (src/convergence/, registry src/core.cu:680-685)
@@ -97,10 +100,19 @@ class SolveResult:
     res_history: Optional[np.ndarray] = None
     setup_time: float = 0.0
     solve_time: float = 0.0
+    # structured status (resilience/status.py SolveStatus; mirrors
+    # AMGX_SOLVE_*): the in-trace health guards classify NaN storms,
+    # Krylov breakdowns, stalls and divergence instead of collapsing
+    # every failure into one bool
+    status_code: int = int(SolveStatus.MAX_ITERS)
+
+    def __post_init__(self):
+        if self.converged:
+            self.status_code = int(SolveStatus.CONVERGED)
 
     @property
     def status(self) -> str:
-        return "success" if self.converged else "diverged_or_max_iters"
+        return status_string(self.status_code)
 
 
 # ---------------------------------------------------------------------------
@@ -143,6 +155,11 @@ class Solver:
         self.print_solve_stats = bool(cfg.get("print_solve_stats", scope))
         self.obtain_timings = bool(cfg.get("obtain_timings", scope))
         self.rel_div_tolerance = float(cfg.get("rel_div_tolerance", scope))
+        # resilience guards (resilience/): classification rides the
+        # residual already computed by the monitor — zero extra syncs
+        self.health_guards = bool(int(cfg.get("health_guards", scope)))
+        self.stall_window = int(cfg.get("stall_detection_window", scope))
+        self.stall_tolerance = float(cfg.get("stall_tolerance", scope))
         self.scaling = str(cfg.get("scaling", scope)).upper()
         self.scaler = None
         # Only the tree ROOT applies equation scaling: children receive
@@ -358,6 +375,16 @@ class Solver:
         """Extra solver state (beyond x/r) before the first iteration."""
         return {}
 
+    def _guard_init(self) -> Dict[str, Any]:
+        """Initial breakdown flag for the health guards: solvers that
+        classify recurrence breakdowns set state['breakdown'] each
+        iteration and the driver folds it into SolveStatus, exiting
+        the loop cleanly instead of propagating NaNs. The key exists
+        only when guards are on, so the guard-off trace carries no
+        dead state. Call from solve_init and merge into the state."""
+        return {"breakdown": jnp.asarray(False)} if self.health_guards \
+            else {}
+
     def solve_iteration(self, data, b, state) -> Dict[str, Any]:
         """One iteration as a pure function of (data, b, state).
 
@@ -403,12 +430,24 @@ class Solver:
     # -- the jitted driver ----------------------------------------------
     def _build_solve_fn(self):
         """Return the raw (unjitted) solve function; jit happens in
-        solve(), and the distributed layer shard_maps it instead."""
+        solve(), and the distributed layer shard_maps it instead.
+
+        Health guards (resilience/): the convergence check folds NaN
+        detection, breakdown classification, divergence and stall
+        detection into ONE int32 `status` carried in the while_loop
+        state — everything derives from the residual norm the monitor
+        already computed (plus the solver-maintained `breakdown` flag),
+        so guarded solves add no device->host synchronization per
+        iteration."""
         max_iters = self.max_iters
         monitor = self.monitor_residual
         hist_len = max_iters + 1
         div_tol = self.rel_div_tolerance
         conv = self.convergence
+        guards = self.health_guards
+        stall_w = self.stall_window if guards else 0
+        stall_tol = self.stall_tolerance
+        S = SolveStatus
 
         def solve_fn(data, b, x0):
             A = data["A"]
@@ -417,9 +456,17 @@ class Solver:
             state = {"x": x0, "r": r0}
             state.update(self.solve_init(data, b, x0, r0))
             state["iters"] = jnp.asarray(0, jnp.int32)
-            state["done"] = conv.check(norm0, norm0) if monitor \
+            # zero RHS / zero initial residual: x0 solves the system
+            # exactly — CONVERGED at 0 iterations instead of feeding
+            # norm0 == 0 into the relative-tolerance arithmetic
+            zero0 = jnp.all(norm0 == 0)
+            conv0 = conv.check(norm0, norm0) if monitor \
                 else jnp.asarray(False)
-            state["converged"] = state["done"]
+            done0 = conv0 | zero0
+            state["done"] = done0
+            state["converged"] = done0
+            state["status"] = jnp.where(done0, jnp.int32(S.CONVERGED),
+                                        jnp.int32(_ST_RUNNING))
             state["res_norm"] = norm0
             state["res_hist"] = jnp.zeros(
                 (hist_len,) + np.shape(norm0), norm0.dtype
@@ -432,8 +479,9 @@ class Solver:
                 iters = st["iters"]
                 core = {k: v for k, v in st.items()
                         if k not in ("iters", "done", "converged",
-                                     "res_norm", "res_hist")}
-                core = self.solve_iteration(data, b, core)
+                                     "res_norm", "res_hist", "status")}
+                with _fi.iteration_scope(iters):
+                    core = self.solve_iteration(data, b, core)
                 new = dict(st)
                 new.update(core)
                 new["iters"] = iters + 1
@@ -452,15 +500,56 @@ class Solver:
                     new["res_norm"] = rn
                     new["res_hist"] = st["res_hist"].at[iters + 1].set(rn)
                     cvg = conv.check(rn, norm0)
-                    diverged = jnp.asarray(False)
+                    false_ = jnp.asarray(False)
+                    diverged = false_
                     if div_tol > 0:
                         diverged = jnp.any(rn > div_tol * norm0)
-                    new["converged"] = cvg
-                    new["done"] = cvg | diverged
+                    bad = ~jnp.all(jnp.isfinite(rn)) if guards else false_
+                    brk = core.get("breakdown", false_) if guards \
+                        else false_
+                    stalled = false_
+                    if stall_w > 0:
+                        # sliding window over the history already being
+                        # recorded: stalled when the norm failed to drop
+                        # by stall_tolerance over the last stall_w steps
+                        past = jax.lax.dynamic_index_in_dim(
+                            new["res_hist"],
+                            jnp.maximum(iters + 1 - stall_w, 0),
+                            axis=0, keepdims=False)
+                        stalled = (iters + 1 >= stall_w) & jnp.all(
+                            rn >= (1.0 - stall_tol) * past)
+                    # first terminal condition wins; convergence beats
+                    # the failure classes (an exactly-converged CG also
+                    # trips p.Ap == 0). BREAKDOWN outranks NAN: the
+                    # Krylov breakdown flags are NaN-comparison-False
+                    # under a NaN storm (so NaN storms still classify
+                    # NAN_DETECTED), while AMG's non-finite-cycle flag
+                    # must not be drowned by the NaN its own breakdown
+                    # put into the residual
+                    status_now = jnp.where(
+                        cvg, jnp.int32(S.CONVERGED),
+                        jnp.where(brk, jnp.int32(S.BREAKDOWN),
+                        jnp.where(bad, jnp.int32(S.NAN_DETECTED),
+                        jnp.where(diverged, jnp.int32(S.DIVERGED),
+                        jnp.where(stalled, jnp.int32(S.STALLED),
+                                  jnp.int32(_ST_RUNNING))))))
+                    new["status"] = jnp.where(
+                        st["status"] == _ST_RUNNING, status_now,
+                        st["status"])
+                    new["converged"] = \
+                        new["status"] == jnp.int32(S.CONVERGED)
+                    new["done"] = new["status"] != jnp.int32(_ST_RUNNING)
                 return new
 
             final = jax.lax.while_loop(cond, body, state)
+            if _fi.any_loop_fault_armed():
+                # one poisoned trace per armed firing: the retry after a
+                # transient fault compiles clean (epoch is in the jit
+                # cache keys)
+                _fi.consume_loop_faults()
             x_final = self.finalize(data, b, final)
+            status = jnp.where(final["status"] == _ST_RUNNING,
+                               jnp.int32(S.MAX_ITERS), final["status"])
             # pack every scalar/stat output into ONE auxiliary array:
             # remote/tunneled TPU rigs pay a full round trip PER awaited
             # output buffer, so (x, stats) costs two concurrent awaits
@@ -471,6 +560,7 @@ class Solver:
             stats = jnp.concatenate([
                 jnp.reshape(final["iters"].astype(rdt), (1,)),
                 jnp.reshape(final["converged"].astype(rdt), (1,)),
+                jnp.reshape(status.astype(rdt), (1,)),
                 jnp.ravel(jnp.asarray(norm0)),
                 jnp.ravel(jnp.asarray(final["res_norm"])),
                 jnp.ravel(jnp.asarray(final["res_hist"]))])
@@ -481,19 +571,23 @@ class Solver:
     @staticmethod
     def unpack_stats(stats, hist_len: int):
         """Invert the stats packing of _build_solve_fn: returns
-        (iters, converged, norm0, res_norm, res_hist) as numpy values.
-        The norm width (1, or block_size for per-component block norms)
-        is recovered from the packed length."""
+        (iters, converged, status, norm0, res_norm, res_hist) as numpy
+        values. The norm width (1, or block_size for per-component block
+        norms) is recovered from the packed length. res_hist is trimmed
+        to the actual iteration count (iters + 1 entries), so the
+        post-exit zero padding of the fixed-length history buffer never
+        reaches callers or plots."""
         stats = np.asarray(stats)
-        nb = (stats.size - 2) // (2 + hist_len)
+        nb = (stats.size - 3) // (2 + hist_len)
         iters = int(stats[0])
         converged = bool(stats[1])
-        norm0 = stats[2:2 + nb]
-        res_norm = stats[2 + nb:2 + 2 * nb]
-        hist = stats[2 + 2 * nb:].reshape(hist_len, nb)
+        status = int(stats[2])
+        norm0 = stats[3:3 + nb]
+        res_norm = stats[3 + nb:3 + 2 * nb]
+        hist = stats[3 + 2 * nb:].reshape(hist_len, nb)[: iters + 1]
         if nb == 1:
             norm0, res_norm, hist = norm0[0], res_norm[0], hist[:, 0]
-        return iters, converged, norm0, res_norm, hist
+        return iters, converged, status, norm0, res_norm, hist
 
     def solve(self, b, x0=None, zero_initial_guess: bool = False
               ) -> SolveResult:
@@ -517,8 +611,12 @@ class Solver:
             # are in the scaled system — reference caveat solver.cu:449)
             b = self.scaler.scale_rhs(b)
             x0 = self.scaler.to_scaled_x(x0)
-        key = (b.shape, str(b.dtype))
+        # the faultinject epoch keys the cache so arming/consuming a
+        # fault retraces instead of replaying a (possibly poisoned)
+        # cached program; it is 0 forever when injection is unused
+        key = (b.shape, str(b.dtype), _fi.epoch())
         if key not in self._jit_cache:
+            _fi.evict_stale_epochs(self._jit_cache, key[-1])
             self._jit_cache[key] = jax.jit(self._build_solve_fn())
         t0 = time.perf_counter()
         x, stats = jax.block_until_ready(self._jit_cache[key](
@@ -526,14 +624,15 @@ class Solver:
         if self.scaler is not None:
             x = self.scaler.from_scaled_x(x)
         solve_time = time.perf_counter() - t0
-        iters_i, converged, norm0, res_norm, hist = self.unpack_stats(
-            stats, self.max_iters + 1)
+        iters_i, converged, status, norm0, res_norm, hist = \
+            self.unpack_stats(stats, self.max_iters + 1)
         res = SolveResult(
             x=x, iterations=iters_i, converged=converged,
             res_norm=np.asarray(res_norm), norm0=np.asarray(norm0),
-            res_history=np.asarray(hist)[:iters_i + 1]
+            res_history=np.asarray(hist)
             if self.store_res_history else None,
-            setup_time=self.setup_time, solve_time=solve_time)
+            setup_time=self.setup_time, solve_time=solve_time,
+            status_code=status)
         if self.print_solve_stats:
             self._print_stats(res, np.asarray(hist))
         return res
@@ -551,7 +650,7 @@ class Solver:
             amgx_printf(f"    {tag}         {mem_gb:10.4f}      "
                   f"{float(np.max(hist[i])):14.6e} {rate}")
         amgx_printf(f"    {'-' * 62}")
-        status = "success" if res.converged else "failed"
+        status = res.status if not res.converged else "success"
         amgx_printf(f"    Total Iterations: {res.iterations}")
         amgx_printf(f"    Avg Convergence Rate: "
               f"{float((np.max(hist[res.iterations]) / max(np.max(hist[0]), 1e-300)) ** (1.0 / max(res.iterations, 1))):10.4f}")

@@ -76,6 +76,7 @@ class _GmresBase(Solver):
             "i": jnp.zeros((), jnp.int32),
             "est_res": beta,
         }
+        st.update(self._guard_init())
         if self.flexible:
             st["Z"] = jnp.zeros((m, n), dt)
         return st
@@ -166,7 +167,12 @@ class _GmresBase(Solver):
         sn = st["sn"].at[i].set(s)
         g = st["g"]
         gi = g[i]
-        g = g.at[i].set(c * gi).at[i + 1].set(-s * gi)
+        # a degenerate rotation (rotated Hessenberg column entirely
+        # zero) reduces nothing: keep |g| at its old magnitude instead
+        # of the identity rotation's -s*gi = 0, which would read as
+        # instant (false) convergence
+        g = g.at[i].set(c * gi).at[i + 1].set(
+            jnp.where(denom == 0, gi, -s * gi))
         est = jnp.abs(g[i + 1])
 
         R = jax.lax.dynamic_update_slice_in_dim(
@@ -174,6 +180,10 @@ class _GmresBase(Solver):
 
         new = dict(st)
         new.update(V=V, R=R, cs=cs, sn=sn, g=g, est_res=est)
+        if self.health_guards:
+            # Givens/Hessenberg degeneracy with an unconverged residual:
+            # the Arnoldi process produced a zero column — exit cleanly
+            new["breakdown"] = (denom == 0) & (jnp.abs(gi) > 0)
         if self.flexible:
             new["Z"] = Z
 

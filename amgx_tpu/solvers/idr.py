@@ -59,6 +59,7 @@ class IDRSolver(_KrylovBase):
         return {
             "G": jnp.zeros((n, s), dt), "U": jnp.zeros((n, s), dt),
             "M": jnp.eye(s, dtype=dt), "omega": jnp.ones((), dt),
+            **self._guard_init(),
         }
 
     def solve_iteration(self, data, b, st):
@@ -111,4 +112,9 @@ class IDRSolver(_KrylovBase):
                        om)
         x = x + om * v
         r = r - om * t
-        return {**st, "x": x, "r": r, "G": G, "U": U, "M": M, "omega": om}
+        out = {**st, "x": x, "r": r, "G": G, "U": U, "M": M, "omega": om}
+        if self.health_guards:
+            # omega collapse: the dimension-reduction step degenerated
+            # (t == 0 or t orthogonal to r) — IDR(s) cannot proceed
+            out["breakdown"] = om == 0
+        return out

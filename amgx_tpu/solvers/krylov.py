@@ -34,19 +34,26 @@ class CGSolver(_KrylovBase):
     """Unpreconditioned conjugate gradients (cg_solver.cu)."""
 
     def solve_init(self, data, b, x, r):
-        return {"p": r, "rz": blas.dot(r, r)}
+        return {"p": r, "rz": blas.dot(r, r), **self._guard_init()}
 
     def solve_iteration(self, data, b, st):
         A = data["A"]
         x, r, p, rz = st["x"], st["r"], st["p"], st["rz"]
         Ap = spmv(A, p)
-        alpha = _safe_div(rz, blas.dot(p, Ap))
+        pAp = blas.dot(p, Ap)
+        alpha = _safe_div(rz, pAp)
         x = x + alpha * p
         r = r - alpha * Ap
         rz_new = blas.dot(r, r)
         beta = _safe_div(rz_new, rz)
         p = r + beta * p
-        return {**st, "x": x, "r": r, "p": p, "rz": rz_new}
+        out = {**st, "x": x, "r": r, "p": p, "rz": rz_new}
+        if self.health_guards:
+            # p.Ap <= 0: the matrix is not SPD on this Krylov space —
+            # a CG breakdown (p == 0 from exact convergence also lands
+            # here, but the CONVERGED check wins in the driver)
+            out["breakdown"] = pAp <= 0
+        return out
 
 
 @registry.solvers.register("PCG")
@@ -57,20 +64,25 @@ class PCGSolver(_KrylovBase):
 
     def solve_init(self, data, b, x, r):
         z = self._precond(data, r)
-        return {"p": z, "z": z, "rz": blas.dot(r, z)}
+        return {"p": z, "z": z, "rz": blas.dot(r, z),
+                **self._guard_init()}
 
     def solve_iteration(self, data, b, st):
         A = data["A"]
         x, r, p, rz = st["x"], st["r"], st["p"], st["rz"]
         Ap = spmv(A, p)
-        alpha = _safe_div(rz, blas.dot(p, Ap))
+        pAp = blas.dot(p, Ap)
+        alpha = _safe_div(rz, pAp)
         x = x + alpha * p
         r = r - alpha * Ap
         z = self._precond(data, r)
         rz_new = blas.dot(r, z)
         beta = _safe_div(rz_new, rz)
         p = z + beta * p
-        return {**st, "x": x, "r": r, "p": p, "z": z, "rz": rz_new}
+        out = {**st, "x": x, "r": r, "p": p, "z": z, "rz": rz_new}
+        if self.health_guards:
+            out["breakdown"] = pAp <= 0
+        return out
 
 
 @registry.solvers.register("PCGF")
@@ -82,13 +94,15 @@ class PCGFSolver(_KrylovBase):
 
     def solve_init(self, data, b, x, r):
         z = self._precond(data, r)
-        return {"p": z, "z": z, "r_old": r, "rz": blas.dot(r, z)}
+        return {"p": z, "z": z, "r_old": r, "rz": blas.dot(r, z),
+                **self._guard_init()}
 
     def solve_iteration(self, data, b, st):
         A = data["A"]
         x, r, p, rz = st["x"], st["r"], st["p"], st["rz"]
         Ap = spmv(A, p)
-        alpha = _safe_div(rz, blas.dot(p, Ap))
+        pAp = blas.dot(p, Ap)
+        alpha = _safe_div(rz, pAp)
         x = x + alpha * p
         r_new = r - alpha * Ap
         z = self._precond(data, r_new)
@@ -96,8 +110,11 @@ class PCGFSolver(_KrylovBase):
         rz_new = blas.dot(r_new, z)
         beta = _safe_div(blas.dot(r_new - r, z), rz)
         p = z + beta * p
-        return {**st, "x": x, "r": r_new, "p": p, "z": z, "r_old": r,
-                "rz": rz_new}
+        out = {**st, "x": x, "r": r_new, "p": p, "z": z, "r_old": r,
+               "rz": rz_new}
+        if self.health_guards:
+            out["breakdown"] = pAp <= 0
+        return out
 
 
 @registry.solvers.register("BICGSTAB")
@@ -107,7 +124,8 @@ class BiCGStabSolver(_KrylovBase):
     def solve_init(self, data, b, x, r):
         one = jnp.ones((), r.dtype)
         return {"r_tld": r, "p": r, "v": jnp.zeros_like(r),
-                "rho": blas.dot(r, r), "alpha": one, "omega": one}
+                "rho": blas.dot(r, r), "alpha": one, "omega": one,
+                **self._guard_init()}
 
     def solve_iteration(self, data, b, st):
         A = data["A"]
@@ -123,8 +141,13 @@ class BiCGStabSolver(_KrylovBase):
         rho_new = blas.dot(r_tld, r)
         beta = _safe_div(rho_new * alpha, rho * omega)
         p = r + beta * (p - omega * v)
-        return {**st, "x": x, "r": r, "p": p, "v": v, "rho": rho_new,
-                "alpha": alpha, "omega": omega}
+        out = {**st, "x": x, "r": r, "p": p, "v": v, "rho": rho_new,
+               "alpha": alpha, "omega": omega}
+        if self.health_guards:
+            # rho underflow (shadow residual orthogonal to r) or omega
+            # collapse: the BiCGStab recurrence is dead — exit cleanly
+            out["breakdown"] = (rho_new == 0) | (omega == 0)
+        return out
 
 
 @registry.solvers.register("PBICGSTAB")
@@ -136,7 +159,8 @@ class PBiCGStabSolver(_KrylovBase):
     def solve_init(self, data, b, x, r):
         one = jnp.ones((), r.dtype)
         return {"r_tld": r, "p": r, "v": jnp.zeros_like(r),
-                "rho": blas.dot(r, r), "alpha": one, "omega": one}
+                "rho": blas.dot(r, r), "alpha": one, "omega": one,
+                **self._guard_init()}
 
     def solve_iteration(self, data, b, st):
         A = data["A"]
@@ -155,8 +179,11 @@ class PBiCGStabSolver(_KrylovBase):
         rho_new = blas.dot(r_tld, r)
         beta = _safe_div(rho_new * alpha, rho * omega)
         p = r + beta * (p - omega * v)
-        return {**st, "x": x, "r": r, "p": p, "v": v, "rho": rho_new,
-                "alpha": alpha, "omega": omega}
+        out = {**st, "x": x, "r": r, "p": p, "v": v, "rho": rho_new,
+               "alpha": alpha, "omega": omega}
+        if self.health_guards:
+            out["breakdown"] = (rho_new == 0) | (omega == 0)
+        return out
 
 
 @registry.solvers.register("CHEBYSHEV")

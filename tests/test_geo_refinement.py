@@ -194,8 +194,9 @@ class TestRefinement:
 def test_unpack_stats_roundtrip():
     from amgx_tpu.solvers.base import Solver
     hist = np.linspace(1.0, 0.1, 7)
-    stats = np.concatenate([[3.0, 1.0], [2.5], [0.25], hist])
-    iters, conv, n0, rn, h = Solver.unpack_stats(stats, 7)
-    assert iters == 3 and conv is True
+    stats = np.concatenate([[3.0, 1.0, 0.0], [2.5], [0.25], hist])
+    iters, conv, status, n0, rn, h = Solver.unpack_stats(stats, 7)
+    assert iters == 3 and conv is True and status == 0
     assert n0 == 2.5 and rn == 0.25
-    np.testing.assert_allclose(h, hist)
+    # history is trimmed to the actual iteration count (iters + 1)
+    np.testing.assert_allclose(h, hist[:4])

@@ -1,0 +1,422 @@
+"""Resilience subsystem tests (amgx_tpu/resilience/).
+
+Proves, via deterministic fault injection, that EVERY SolveStatus code
+is reachable and that every fallback action recovers from its
+designated fault — the acceptance contract of the resilience layer.
+"""
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import amgx_tpu as amgx
+from amgx_tpu import gallery
+from amgx_tpu.config import Config
+from amgx_tpu.errors import AMGXError, BadConfigurationError
+from amgx_tpu.resilience import SolveStatus, faultinject as fi
+from amgx_tpu.resilience.policy import (ResilientSolver,
+                                        parse_fallback_policy)
+
+amgx.initialize()
+
+
+def _csr(Asp):
+    Asp = Asp.tocsr()
+    n = Asp.shape[0]
+    return amgx.CsrMatrix.from_scipy_like(
+        Asp.indptr, Asp.indices, Asp.data, n, n).init()
+
+
+def _poisson16():
+    return gallery.poisson("5pt", 16, 16).init()
+
+
+def _indefinite(n=64):
+    """Symmetric indefinite tridiagonal: CG's p.Ap <= 0 breakdown."""
+    d = np.ones(n)
+    d[::2] = -1.0
+    off = 0.1 * np.ones(n - 1)
+    return _csr(sp.diags([d, off, off], [0, 1, -1]))
+
+
+def _nondominant(n=32):
+    """Jacobi iteration matrix has spectral radius > 1: divergence."""
+    return _csr(sp.diags([np.ones(n), 2.0 * np.ones(n - 1),
+                          2.0 * np.ones(n - 1)], [0, 1, -1]))
+
+
+def _badly_scaled(n_side=16, seed=0):
+    """D A D with a 8-decade diagonal spread: CG crawls unscaled,
+    converges after a DIAGONAL_SYMMETRIC rescale."""
+    A = gallery.poisson("5pt", n_side, n_side).init()
+    n = A.num_rows
+    Ap = sp.csr_matrix((np.asarray(A.values), np.asarray(A.col_indices),
+                        np.asarray(A.row_offsets)), shape=(n, n))
+    d = 10.0 ** np.random.default_rng(seed).uniform(-4, 4, n)
+    D = sp.diags(d)
+    return _csr(D @ Ap @ D)
+
+
+def _cg(extra="", max_iters=200, tol="1e-8"):
+    return amgx.create_solver(Config.from_string(
+        f"solver=CG, max_iters={max_iters}, monitor_residual=1,"
+        f" tolerance={tol}, convergence=RELATIVE_INI" +
+        (", " + extra if extra else "")))
+
+
+# ---------------------------------------------------------------------------
+# every SolveStatus code is reachable
+# ---------------------------------------------------------------------------
+
+
+class TestStatusReachability:
+    def test_converged(self):
+        A = _poisson16()
+        slv = _cg().setup(A)
+        res = slv.solve(np.ones(A.num_rows))
+        assert res.status_code == SolveStatus.CONVERGED
+        assert res.status == "success" and res.converged
+
+    def test_zero_rhs_is_converged_at_zero_iters(self):
+        # norm0 == 0 guard: x = x0 with CONVERGED instead of feeding a
+        # zero norm into the relative-tolerance arithmetic
+        A = _poisson16()
+        slv = _cg().setup(A)
+        res = slv.solve(np.zeros(A.num_rows))
+        assert res.status_code == SolveStatus.CONVERGED
+        assert res.iterations == 0
+        assert np.all(np.asarray(res.x) == 0)
+
+    def test_max_iters(self):
+        A = _poisson16()
+        slv = _cg(max_iters=3, tol="1e-12").setup(A)
+        res = slv.solve(np.ones(A.num_rows))
+        assert res.status_code == SolveStatus.MAX_ITERS
+        assert res.iterations == 3 and not res.converged
+
+    def test_nan_detected_via_spmv_injection(self):
+        A = _poisson16()
+        slv = _cg(max_iters=50).setup(A)
+        with fi.inject("spmv_nan", iteration=3):
+            res = slv.solve(np.ones(A.num_rows))
+        assert res.status_code == SolveStatus.NAN_DETECTED
+        # fault fires at 0-based iteration 3 -> detected on iteration 4
+        assert res.iterations == 4
+        # disarmed: the epoch-keyed jit cache retraces clean
+        res2 = slv.solve(np.ones(A.num_rows))
+        assert res2.status_code == SolveStatus.CONVERGED
+
+    def test_breakdown_cg_indefinite(self):
+        A = _indefinite()
+        slv = _cg(max_iters=30, tol="1e-10").setup(A)
+        res = slv.solve(np.ones(A.num_rows))
+        assert res.status_code == SolveStatus.BREAKDOWN
+        # the loop exited at the breakdown, not at max_iters, and the
+        # iterate stayed finite (no NaN propagation)
+        assert res.iterations < 30
+        assert np.all(np.isfinite(np.asarray(res.x)))
+
+    def test_diverged(self):
+        A = _nondominant()
+        slv = amgx.create_solver(Config.from_string(
+            "solver=BLOCK_JACOBI, max_iters=50, monitor_residual=1,"
+            " tolerance=1e-8, convergence=RELATIVE_INI,"
+            " rel_div_tolerance=1e4")).setup(A)
+        res = slv.solve(np.ones(A.num_rows))
+        assert res.status_code == SolveStatus.DIVERGED
+        assert res.iterations < 50
+
+    def test_stalled(self):
+        # AMG V-cycle with ZERO smoothing sweeps: coarse-grid correction
+        # alone never damps the high-frequency error — the residual
+        # plateaus and the sliding-window guard calls it
+        A = _poisson16()
+        slv = amgx.create_solver(Config.from_string(
+            "solver(amg)=AMG, amg:max_iters=40, amg:monitor_residual=1,"
+            " amg:tolerance=1e-8, amg:convergence=RELATIVE_INI,"
+            " amg:algorithm=AGGREGATION, amg:selector=SIZE_2,"
+            " amg:smoother(sm)=JACOBI_L1, sm:max_iters=1,"
+            " amg:presweeps=0, amg:postsweeps=0, amg:cycle=V,"
+            " amg:coarse_solver=DENSE_LU_SOLVER, amg:min_coarse_rows=8,"
+            " amg:stall_detection_window=4")).setup(A)
+        res = slv.solve(np.ones(A.num_rows))
+        assert res.status_code == SolveStatus.STALLED
+        assert res.iterations < 40
+
+    def test_breakdown_amg_nonfinite_cycle(self):
+        # a non-finite AMG cycle output classifies as BREAKDOWN (the
+        # hierarchy is broken), not as the NAN storm it also causes in
+        # the residual — BREAKDOWN outranks NAN in the guard priority,
+        # while Krylov NaN storms still classify NAN_DETECTED because
+        # their breakdown predicates are NaN-comparison-False
+        A = _poisson16()
+        slv = amgx.create_solver(Config.from_string(
+            "solver(amg)=AMG, amg:max_iters=30, amg:monitor_residual=1,"
+            " amg:tolerance=1e-6, amg:convergence=RELATIVE_INI,"
+            " amg:algorithm=AGGREGATION, amg:selector=SIZE_2,"
+            " amg:smoother(sm)=JACOBI_L1, sm:max_iters=1,"
+            " amg:presweeps=1, amg:postsweeps=1, amg:cycle=V,"
+            " amg:coarse_solver=DENSE_LU_SOLVER,"
+            " amg:min_coarse_rows=8")).setup(A)
+        with fi.inject("spmv_nan", iteration=2):
+            res = slv.solve(np.ones(A.num_rows))
+        assert res.status_code == SolveStatus.BREAKDOWN
+        assert res.iterations == 3
+
+    def test_guards_off_restores_plain_monitor(self):
+        # health_guards=0: a NaN storm runs to max_iters (the old
+        # behavior) instead of being classified
+        A = _poisson16()
+        slv = _cg("health_guards=0", max_iters=10).setup(A)
+        with fi.inject("spmv_nan", iteration=1):
+            res = slv.solve(np.ones(A.num_rows))
+        assert res.status_code == SolveStatus.MAX_ITERS
+        assert res.iterations == 10
+
+
+# ---------------------------------------------------------------------------
+# fault injection harness
+# ---------------------------------------------------------------------------
+
+
+class TestFaultInjection:
+    def test_spec_consumed_after_one_trace(self):
+        A = _poisson16()
+        slv = _cg().setup(A)
+        with fi.inject("spmv_nan", iteration=0, fires=1):
+            bad = slv.solve(np.ones(A.num_rows))
+            # fires exhausted: the very next solve (same arm scope)
+            # compiles a clean trace
+            good = slv.solve(np.ones(A.num_rows))
+        assert bad.status_code == SolveStatus.NAN_DETECTED
+        assert good.status_code == SolveStatus.CONVERGED
+
+    def test_galerkin_perturbation_breaks_amg(self):
+        # sign-flipping one level's Galerkin values turns the coarse
+        # correction into an amplifier: the clean hierarchy converges,
+        # the perturbed one diverges — and the guards SAY so
+        A = _poisson16()
+        cfg_s = (
+            "solver(amg)=AMG, amg:max_iters=60, amg:monitor_residual=1,"
+            " amg:tolerance=1e-6, amg:convergence=RELATIVE_INI,"
+            " amg:algorithm=AGGREGATION, amg:selector=SIZE_2,"
+            " amg:smoother(sm)=JACOBI_L1, sm:max_iters=1,"
+            " amg:presweeps=2, amg:postsweeps=2, amg:cycle=V,"
+            " amg:coarse_solver=DENSE_LU_SOLVER, amg:min_coarse_rows=8,"
+            " amg:rel_div_tolerance=1e6")
+        clean = amgx.create_solver(Config.from_string(cfg_s)).setup(A)
+        ok = clean.solve(np.ones(A.num_rows))
+        assert ok.status_code == SolveStatus.CONVERGED
+        with fi.inject("galerkin_perturb", index=0, scale=-1.0):
+            broken = amgx.create_solver(
+                Config.from_string(cfg_s)).setup(A)
+        res = broken.solve(np.ones(A.num_rows))
+        assert res.status_code == SolveStatus.DIVERGED
+
+    def test_env_toggle(self, monkeypatch):
+        # AMGX_TPU_FAULT_INJECT arms a spec without touching code
+        monkeypatch.setenv("AMGX_TPU_FAULT_INJECT",
+                           "spmv_nan:iteration=2:fires=1")
+        monkeypatch.setattr(fi, "_ENV_CHECKED", False)
+        monkeypatch.setattr(fi, "_SPEC", None)
+        A = _poisson16()
+        slv = _cg().setup(A)
+        res = slv.solve(np.ones(A.num_rows))
+        assert res.status_code == SolveStatus.NAN_DETECTED
+        fi.disarm()
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError):
+            fi.FaultSpec("bitflip_everywhere")
+
+    def test_loop_fault_not_spent_by_unrelated_solve(self):
+        # a fires-limited halo fault must survive solves whose traces
+        # contain no halo hook (per-kind hook-hit consumption)
+        A = _poisson16()
+        with fi.inject("halo_corrupt", iteration=0, fires=1):
+            slv = _cg().setup(A)
+            res = slv.solve(np.ones(A.num_rows))
+            assert res.status_code == SolveStatus.CONVERGED
+            assert fi.active("halo_corrupt") is not None
+
+
+# ---------------------------------------------------------------------------
+# fallback chains (resilience/policy.py)
+# ---------------------------------------------------------------------------
+
+
+class TestFallbackChains:
+    def test_nan_retry_converges(self):
+        # transient NaN (fires=1): plain retry gets a clean retrace
+        A = _poisson16()
+        rs = amgx.create_solver(Config.from_string(
+            "solver=CG, max_iters=200, monitor_residual=1,"
+            " tolerance=1e-8, convergence=RELATIVE_INI,"
+            " fallback_policy=NAN_DETECTED>retry,"
+            " max_fallback_attempts=2"))
+        assert isinstance(rs, ResilientSolver)
+        rs.setup(A)
+        with fi.inject("spmv_nan", iteration=2, fires=1):
+            res = rs.solve(np.ones(A.num_rows))
+        assert res.status_code == SolveStatus.CONVERGED
+        assert res.fallback_history == [
+            ("initial", "nan_detected"), ("retry", "success")]
+
+    def test_breakdown_switches_to_gmres(self):
+        A = _indefinite()
+        rs = amgx.create_solver(Config.from_string(
+            "solver=CG, max_iters=80, monitor_residual=1,"
+            " tolerance=1e-8, convergence=RELATIVE_INI,"
+            " gmres_n_restart=40,"
+            " fallback_policy=BREAKDOWN>switch_solver=GMRES,"
+            " max_fallback_attempts=1"))
+        rs.setup(A)
+        res = rs.solve(np.ones(A.num_rows))
+        assert res.status_code == SolveStatus.CONVERGED
+        assert res.fallback_history[0] == ("initial", "breakdown")
+        assert res.fallback_history[1][0] == "switch_solver=GMRES"
+        # the recovered configuration is adopted for later solves
+        assert rs.solver.name == "GMRES"
+
+    def test_stalled_escalates_sweeps(self):
+        A = _poisson16()
+        rs = amgx.create_solver(Config.from_string(
+            "solver(amg)=AMG, amg:max_iters=40, amg:monitor_residual=1,"
+            " amg:tolerance=1e-8, amg:convergence=RELATIVE_INI,"
+            " amg:algorithm=AGGREGATION, amg:selector=SIZE_2,"
+            " amg:smoother(sm)=JACOBI_L1, sm:max_iters=1,"
+            " amg:presweeps=0, amg:postsweeps=0, amg:cycle=V,"
+            " amg:coarse_solver=DENSE_LU_SOLVER, amg:min_coarse_rows=8,"
+            " amg:stall_detection_window=4,"
+            " fallback_policy=STALLED>escalate_sweeps,"
+            " max_fallback_attempts=1"))
+        rs.setup(A)
+        res = rs.solve(np.ones(A.num_rows))
+        assert res.status_code == SolveStatus.CONVERGED
+        assert res.fallback_history[0] == ("initial", "stalled")
+
+    def test_max_iters_rescale_retry(self):
+        A = _badly_scaled()
+        rs = amgx.create_solver(Config.from_string(
+            "solver=CG, max_iters=60, monitor_residual=1,"
+            " tolerance=1e-6, convergence=RELATIVE_INI,"
+            " fallback_policy=MAX_ITERS>rescale_retry,"
+            " max_fallback_attempts=1"))
+        rs.setup(A)
+        res = rs.solve(np.ones(A.num_rows))
+        assert res.status_code == SolveStatus.CONVERGED
+        assert res.fallback_history[0] == ("initial", "max_iters")
+
+    def test_attempts_are_bounded(self):
+        # a PERSISTENT fault (fires=None): the chain must stop at
+        # max_fallback_attempts, not loop forever
+        A = _poisson16()
+        rs = amgx.create_solver(Config.from_string(
+            "solver=CG, max_iters=30, monitor_residual=1,"
+            " tolerance=1e-8, convergence=RELATIVE_INI,"
+            " fallback_policy=NAN_DETECTED>retry|NAN_DETECTED>retry,"
+            " max_fallback_attempts=2"))
+        rs.setup(A)
+        with fi.inject("spmv_nan", iteration=1, fires=None):
+            res = rs.solve(np.ones(A.num_rows))
+        assert res.status_code == SolveStatus.NAN_DETECTED
+        assert len(res.fallback_history) == 3   # initial + 2 attempts
+
+    def test_policy_parse_errors_suggest(self):
+        with pytest.raises(BadConfigurationError) as ei:
+            parse_fallback_policy("NAN_DETECTD>retry")
+        assert "NAN_DETECTED" in str(ei.value)
+        with pytest.raises(BadConfigurationError) as ei:
+            parse_fallback_policy("BREAKDOWN>swich_solver=GMRES")
+        assert "switch_solver" in str(ei.value)
+        with pytest.raises(BadConfigurationError):
+            parse_fallback_policy("BREAKDOWN>switch_solver")  # no arg
+
+
+# ---------------------------------------------------------------------------
+# surfacing: batch, distributed, capi, history trimming, config errors
+# ---------------------------------------------------------------------------
+
+
+class TestSurfacing:
+    def test_batch_per_system_status(self):
+        A = _poisson16()
+        n = A.num_rows
+        slv = _cg("store_res_history=1", max_iters=12,
+                  tol="1e-10").setup(A)
+        res = slv.solve_many(np.stack([np.zeros(n), np.ones(n)]))
+        assert res.status.tolist() == [int(SolveStatus.CONVERGED),
+                                       int(SolveStatus.MAX_ITERS)]
+        # zero-RHS system froze at iteration 0; its history rows past
+        # its own stop are NaN-masked, and per_system() trims them
+        assert res.iterations.tolist() == [0, 12]
+        assert np.isnan(res.res_history[0, 1:]).all()
+        per = res.per_system()
+        assert per[0].status_code == SolveStatus.CONVERGED
+        assert per[1].status_code == SolveStatus.MAX_ITERS
+        assert len(per[0].res_history) == 1
+        assert np.isfinite(per[1].res_history).all()
+
+    def test_res_history_trimmed_single(self):
+        A = _poisson16()
+        slv = _cg("store_res_history=1", max_iters=100).setup(A)
+        res = slv.solve(np.ones(A.num_rows))
+        assert res.res_history.shape[0] == res.iterations + 1
+        assert np.isfinite(res.res_history).all()
+
+    def test_distributed_status_agrees_after_halo_fault(self):
+        from amgx_tpu.distributed import DistributedSolver, default_mesh
+        A = _poisson16()
+        ds = DistributedSolver(Config.from_string(
+            "solver=CG, max_iters=100, monitor_residual=1,"
+            " tolerance=1e-8, convergence=RELATIVE_INI"),
+            default_mesh(4))
+        ds.setup(A)
+        b = np.ones(A.num_rows)
+        assert ds.solve(b).status_code == SolveStatus.CONVERGED
+        with fi.inject("halo_corrupt", iteration=2):
+            res = ds.solve(b)
+        # the pmax all-reduce makes every shard report the worst code
+        assert res.status_code == SolveStatus.NAN_DETECTED
+        # and the epoch-keyed program cache recovers afterwards
+        assert ds.solve(b).status_code == SolveStatus.CONVERGED
+
+    def test_capi_amgx_solve_status_codes(self):
+        from amgx_tpu import capi
+        rc, cfg_h = capi.AMGX_config_create(
+            "solver=CG, max_iters=3, monitor_residual=1,"
+            " tolerance=1e-12, convergence=RELATIVE_INI")
+        rc, rsrc = capi.AMGX_resources_create_simple(cfg_h)
+        rc, mtx = capi.AMGX_matrix_create(rsrc, "dDDI")
+        rc, bh = capi.AMGX_vector_create(rsrc, "dDDI")
+        rc, xh = capi.AMGX_vector_create(rsrc, "dDDI")
+        A = _poisson16()
+        n = A.num_rows
+        capi.AMGX_matrix_upload_all(
+            mtx, n, A.nnz, 1, 1, np.asarray(A.row_offsets),
+            np.asarray(A.col_indices), np.asarray(A.values))
+        capi.AMGX_vector_upload(bh, n, 1, np.ones(n))
+        rc, slv = capi.AMGX_solver_create(rsrc, "dDDI", cfg_h)
+        capi.AMGX_solver_setup(slv, mtx)
+        capi.AMGX_solver_solve_with_0_initial_guess(slv, bh, xh)
+        rc, status = capi.AMGX_solver_get_status(slv)
+        assert (rc, status) == (capi.RC.OK,
+                                capi.AMGX_SOLVE_NOT_CONVERGED)
+        # a converged re-run reports AMGX_SOLVE_SUCCESS
+        rc2, cfg2 = capi.AMGX_config_create(
+            "solver=CG, max_iters=200, monitor_residual=1,"
+            " tolerance=1e-8, convergence=RELATIVE_INI")
+        rc2, slv2 = capi.AMGX_solver_create(rsrc, "dDDI", cfg2)
+        capi.AMGX_solver_setup(slv2, mtx)
+        capi.AMGX_solver_solve_with_0_initial_guess(slv2, bh, xh)
+        rc2, status2 = capi.AMGX_solver_get_status(slv2)
+        assert status2 == capi.AMGX_SOLVE_SUCCESS
+
+    def test_unknown_config_key_did_you_mean(self):
+        with pytest.raises(BadConfigurationError) as ei:
+            Config.from_string("tolerence=1e-8")
+        assert "tolerance" in str(ei.value)
+
+    def test_unknown_solver_name_did_you_mean(self):
+        with pytest.raises(AMGXError) as ei:
+            amgx.create_solver(Config.from_string("solver=GMRS"))
+        assert "GMRES" in str(ei.value)
