@@ -65,10 +65,12 @@ class AggregationAMGLevel(AMGLevel):
     geo_coarse_shape = None
 
     def create_coarse_vertices(self):
+        from ...profiling import trace_region
         sel_name = str(self.cfg.get("selector", self.scope))
         sel = registry.aggregation_selectors.create(
             sel_name, self.cfg, self.scope)
-        self.aggregates, self.coarse_size = sel.set_aggregates(self.A)
+        with trace_region(f"amg.L{self.level_index}.selector"):
+            self.aggregates, self.coarse_size = sel.set_aggregates(self.A)
         if getattr(sel, "pair_axes", None) is not None and \
                 not self.A.is_block:
             self.geo_axes = sel.pair_axes
@@ -80,14 +82,23 @@ class AggregationAMGLevel(AMGLevel):
         return geo_shapes(self.geo_fine_shape, self.geo_axes)
 
     def create_coarse_matrix(self) -> CsrMatrix:
+        from ...profiling import trace_region
+        k = self.level_index
         if self.geo_axes is not None:
-            from .galerkin import geo_coarse_dia
-            Ac = geo_coarse_dia(self.A, self.geo_fine_shape,
-                                self.geo_axes, self.geo_coarse_shape)
-            if Ac is not None:      # structured sort-free Galerkin
-                return Ac
-        Ac = coarse_a_from_aggregates(self.A, self.aggregates,
-                                      self.coarse_size)
+            from .galerkin import geo_assemble_dia, geo_coarse_values
+            with trace_region(f"amg.L{k}.galerkin"):
+                pre = geo_coarse_values(self.A, self.geo_fine_shape,
+                                        self.geo_axes,
+                                        self.geo_coarse_shape)
+            if pre is not None:     # structured sort-free Galerkin
+                # the DIA pack is the coarse operator's LAYOUT build —
+                # timed as such, not hidden inside the galerkin bucket
+                with trace_region(f"amg.L{k}.layout"):
+                    return geo_assemble_dia(pre[0], pre[1],
+                                            self.geo_coarse_shape)
+        with trace_region(f"amg.L{k}.galerkin"):
+            Ac = coarse_a_from_aggregates(self.A, self.aggregates,
+                                          self.coarse_size)
         if self.geo_coarse_shape is not None:
             Ac = dataclasses.replace(Ac, grid_shape=self.geo_coarse_shape)
         return Ac

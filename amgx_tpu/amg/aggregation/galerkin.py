@@ -314,9 +314,9 @@ def _geo_csr_structure(coffsets, coarse_shape):
     return row_offsets, off_e, row_e, col_e, diag_idx
 
 
-def geo_coarse_dia(A: CsrMatrix, fine_shape, axes, coarse_shape):
-    """Galerkin product for a structured (GEO) pairing of a banded DIA
-    stencil operator, computed WITHOUT sorts or scatters.
+def geo_coarse_values(A: CsrMatrix, fine_shape, axes, coarse_shape):
+    """Numeric phase of the structured (GEO) Galerkin product: the
+    coarse diagonal slab (kc, nc) computed WITHOUT sorts or scatters.
 
     For a fine entry A[i, i+d] with grid shift (dx, dy, dz), the coarse
     offset along each paired axis is floor((x+dx)/2) - floor(x/2) — a
@@ -327,13 +327,11 @@ def geo_coarse_dia(A: CsrMatrix, fine_shape, axes, coarse_shape):
     program; numerically identical to the generic COO relabel+sum (both
     compute sum over fine pairs), so iteration counts are unchanged.
 
-    Returns the coarse CsrMatrix (initialized, DIA layout attached) or
-    None when the fast path does not apply (non-stencil offsets, or
-    entries that wrap grid rows).
+    Returns (cvals, coffsets) or None when the fast path does not apply
+    (non-stencil offsets, or entries that wrap grid rows).
     """
     nx, ny, nz = fine_shape
     cnx, cny, cnz = coarse_shape
-    nc = cnx * cny * cnz
     if A.dia_offsets is None or A.grid_shape != tuple(fine_shape) \
             or A.is_block:
         return None
@@ -365,6 +363,16 @@ def geo_coarse_dia(A: CsrMatrix, fine_shape, axes, coarse_shape):
         (cnx, cny, cnz))
     cvals = _geo_compute(vals, coffsets, contribs, tuple(fine_shape),
                          tuple(axes))
+    return cvals, coffsets
+
+
+def geo_assemble_dia(cvals, coffsets, coarse_shape) -> CsrMatrix:
+    """Layout phase of the structured Galerkin: pack the coarse slab
+    into the exact-size CSR + tile-aligned DIA storage (the coarse
+    operator's solve layout, built straight from device arrays — this
+    is the packing the amg.L*.layout timer wraps)."""
+    cnx, cny, cnz = coarse_shape
+    nc = cnx * cny * cnz
     (row_offsets, off_e, row_e, col_e, diag_idx) = _geo_csr_structure(
         coffsets, (cnx, cny, cnz))
     values = cvals[jnp.asarray(off_e), jnp.asarray(row_e)]
@@ -383,6 +391,8 @@ def geo_coarse_dia(A: CsrMatrix, fine_shape, axes, coarse_shape):
         dia_vals=dia_vals, num_rows=nc, num_cols=nc,
         block_dimx=1, block_dimy=1, initialized=True,
         grid_shape=tuple(coarse_shape))
+
+
 
 
 def restrict_vector(agg, nc: int, r, block_dim: int = 1):

@@ -46,7 +46,7 @@ class ClassicalAMGLevel(AMGLevel):
         cfg, scope = self.cfg, self.scope
         st = registry.strength.create(str(cfg.get("strength", scope)),
                                       cfg, scope)
-        with trace_region(f"cls.L{self.level_index}.strength"):
+        with trace_region(f"amg.L{self.level_index}.strength"):
             self.strong = st.strong_mask(self.A)
         sel_name = str(cfg.get(self.selector_param, scope))
         # aggressive coarsening on the first `aggressive_levels` levels
@@ -61,7 +61,7 @@ class ClassicalAMGLevel(AMGLevel):
         if not registry.classical_selectors.has(sel_name):
             sel_name = self.selector_fallback
         sel = registry.classical_selectors.create(sel_name, cfg, scope)
-        with trace_region(f"cls.L{self.level_index}.cfsplit"):
+        with trace_region(f"amg.L{self.level_index}.cfsplit"):
             self.cf_map = sel.mark_coarse_fine_points(self.A, self.strong)
             self.coarse_size = int(jnp.sum(self.cf_map == 1))
         self._aggressive = aggressive
@@ -70,10 +70,12 @@ class ClassicalAMGLevel(AMGLevel):
         """P (interpolator), R = P^T, RAP
         (computeProlongationOperator :406, computeRestrictionOperator
         :441, csr_galerkin_product)."""
+        from ...profiling import trace_region
         if getattr(self, "_reused", False):
             # structure reuse: transfer operators kept, only the
             # Galerkin product sees the new coefficients
-            return galerkin_rap(self.R, self.A, self.P)
+            with trace_region(f"amg.L{self.level_index}.rap"):
+                return galerkin_rap(self.R, self.A, self.P)
         cfg, scope = self.cfg, self.scope
         interp_name = str(cfg.get(self.interpolator_param, scope))
         if self._aggressive:
@@ -84,20 +86,21 @@ class ClassicalAMGLevel(AMGLevel):
         # host path: ell='auto' gives P and R the windowed-ELL (SWELL)
         # layout, the Pallas gather kernel's storage — transfer operators
         # are the other half of the unstructured cycle's SpMV traffic.
-        # Device-resident setup keeps ell='never': the auto layout probe
-        # costs blocking device fetches per level and SWELL is host-built.
-        from ...matrix import host_resident
-        from ...profiling import trace_region
+        # setup_backend=device also uses ell='auto': the DIA/ELL layouts
+        # build from the device CSR directly (_choose_layout's jnp path,
+        # no host round trip). Only the legacy in-place accelerator path
+        # keeps ell='never' (its layout probe would block per level).
+        from ...matrix import device_setup_forced, host_resident
         k = self.level_index
-        with trace_region(f"cls.L{k}.interp"):
+        with trace_region(f"amg.L{k}.interp"):
             P = interp.generate(self.A, self.cf_map, self.strong)
-        ell = "auto" if host_resident(P.row_offsets, P.col_indices,
-                                      P.values) else "never"
-        with trace_region(f"cls.L{k}.layoutP"):
+        ell = "auto" if device_setup_forced() or host_resident(
+            P.row_offsets, P.col_indices, P.values) else "never"
+        with trace_region(f"amg.L{k}.layoutP"):
             self.P = P.init(ell=ell)
-        with trace_region(f"cls.L{k}.transposeR"):
+        with trace_region(f"amg.L{k}.transposeR"):
             self.R = transpose(self.P).init(ell=ell)
-        with trace_region(f"cls.L{k}.rap"):
+        with trace_region(f"amg.L{k}.rap"):
             return galerkin_rap(self.R, self.A, self.P)
 
     def reuse_structure(self, old):

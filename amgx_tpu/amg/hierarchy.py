@@ -103,6 +103,9 @@ class AMG:
         self.print_grid_stats = bool(cfg.get("print_grid_stats", scope))
         self.intensive_smoothing = bool(cfg.get("intensive_smoothing", scope))
         self.host_setup = str(cfg.get("amg_host_setup", scope))
+        self.setup_backend = str(cfg.get("setup_backend", scope)).lower()
+        self.setup_device_min_rows = int(
+            cfg.get("setup_device_min_rows", scope))
         self.convergence_analysis = int(cfg.get("convergence_analysis",
                                                 scope))
         self.levels: List[AMGLevel] = []
@@ -116,6 +119,12 @@ class AMG:
         # host compute
         self._put_cache: Dict[int, tuple] = {}
         self._ship_pool = None
+        # which implementations the last setup used ("host" pull-and-ship,
+        # "device" forced pipeline, "auto" residency-driven)
+        self._setup_backend_used = None
+        # distributed setup builds the replicated tail through
+        # _build_levels but owns its smoother assignment
+        self._defer_smoothers = False
 
     # -- setup -----------------------------------------------------------
     def _host_setup_device(self, A: CsrMatrix):
@@ -127,10 +136,13 @@ class AMG:
         and the finished hierarchy ships to the accelerator once (cached
         solve-data). mode: auto (host when the default backend is a
         remote accelerator and the algorithm's setup is index-heavy),
-        always, never."""
+        always, never. `setup_backend` outranks `amg_host_setup`:
+        device never pulls, host always does (on an accelerator)."""
         import jax
+        if self.setup_backend == "device":
+            return None          # device-resident pipeline: never pull
         mode = self.host_setup
-        if mode == "never":
+        if mode == "never" and self.setup_backend != "host":
             return None
         try:
             cpu = jax.devices("cpu")[0]
@@ -139,8 +151,8 @@ class AMG:
         ambient = jax.config.jax_default_device or jax.devices()[0]
         if ambient.platform == "cpu":
             return None          # already on host
-        if mode == "always" or self.algorithm in ("CLASSICAL",
-                                                  "ENERGYMIN"):
+        if self.setup_backend == "host" or mode == "always" \
+                or self.algorithm in ("CLASSICAL", "ENERGYMIN"):
             return cpu
         return None
 
@@ -156,6 +168,7 @@ class AMG:
         self._last_resetup_value_only = False
         host = self._host_setup_device(A)
         if host is not None:
+            self._setup_backend_used = "host"
             # decide BEFORE init: the SpMV-layout build is itself eager
             # device work that belongs on the host in this mode; ship to
             # the device the caller's context selected
@@ -168,18 +181,31 @@ class AMG:
             l0_dev = self._l0_device_cast(A)
             with jax.default_device(host):
                 with trace_region("amg.host_pull"):
-                    Af = self._pull_numpy(self._strip_layouts(A))
-                    Af = Af.init()
+                    Af = self._pull_host_l0(A)
                 self._register_device_l0(A, Af, l0_dev)
                 self._build_levels_checked(Af, 0)
-                with trace_region("amg.finalize"):
-                    self._finalize_setup(t0)
+                self._finalize_setup(t0)
             return self
         self._ship_device = None
-        Af = A if A.initialized else A.init()
+        # "host" here means setup_backend=host on a host-ambient rig
+        # (no pull needed — the build IS on the host)
+        self._setup_backend_used = self.setup_backend
+        from ..matrix import forced_device_setup
+        from ..profiling import trace_region
+        with forced_device_setup(self._level_device_forced(A.num_rows)):
+            with trace_region("amg.l0_layout"):
+                Af = A if A.initialized else A.init()
         self._build_levels_checked(Af, 0)
         self._finalize_setup(t0)
         return self
+
+    def _level_device_forced(self, n: int) -> bool:
+        """setup_backend=device forces the jnp/device implementations
+        for this level; levels under setup_device_min_rows lift the
+        forcing (dispatch overhead loses against tiny host numpy)."""
+        return (self.setup_backend == "device"
+                and self._ship_device is None
+                and n >= self.setup_device_min_rows)
 
     def _pull_numpy(self, A: CsrMatrix) -> CsrMatrix:
         """Pull a (layout-stripped) matrix's arrays to host numpy. The
@@ -197,35 +223,78 @@ class AMG:
             values=pull(A.values),
             diag=None if A.diag is None else pull(A.diag))
 
+    # L0 SpMV-layout payload fields and which of them carry float data
+    # (the others are structure arrays the amg_precision cast ignores)
+    _L0_PAYLOADS = ("dia_vals", "ell_vals", "ell_cols", "swell_vals",
+                    "swell_cols", "swell_c0row", "swell_nchunk")
+
+    def _pull_host_l0(self, A: CsrMatrix) -> CsrMatrix:
+        """Host-numpy finest-level matrix for the host build. When the
+        caller's device matrix already carries its SpMV layout (DIA/
+        ELL/SWELL) with retained host mirrors, the layout arrays are
+        REUSED instead of rebuilt — the pre-layout strip + numpy
+        re-pack only runs when some piece cannot be served host-side."""
+        import dataclasses as _dc
+        from ..matrix import host_arrays
+        if A.initialized:
+            fields = ("row_offsets", "col_indices", "values", "diag",
+                      "row_ids", "diag_idx") + self._L0_PAYLOADS
+            arrs = host_arrays(*[getattr(A, f) for f in fields])
+            if arrs is not None:
+                return _dc.replace(A, **dict(zip(fields, arrs)))
+        Af = self._pull_numpy(self._strip_layouts(A))
+        return Af.init()
+
     def _l0_device_cast(self, orig: CsrMatrix):
-        """Precision-cast of the caller's finest-level DIA payload,
-        dispatched on the caller's device (must run OUTSIDE the host
-        default-device block — see setup())."""
-        if orig is not None and orig.initialized \
-                and orig.dia_vals is not None:
-            return self._cast_leaf(orig.dia_vals)
-        return None
+        """Device twins of the caller's finest-level SpMV-layout
+        payloads: precision casts for the float slabs (dispatched on
+        the caller's device — must run OUTSIDE the host default-device
+        block, see setup()), the resident arrays themselves for the
+        integer structure."""
+        if orig is None or not orig.initialized:
+            return None
+        import jax.numpy as jnp
+        out = {}
+        for f in self._L0_PAYLOADS:
+            v = getattr(orig, f)
+            if v is None:
+                continue
+            out[f] = (self._cast_leaf(v)
+                      if jnp.issubdtype(v.dtype, jnp.inexact) else v)
+        return out or None
 
     def _register_device_l0(self, orig: CsrMatrix, Af_host: CsrMatrix,
-                            dev_cast):
+                            dev):
         """The caller's device matrix already holds the finest level's
         SpMV layout; pre-seeding the transfer cache with its (precision-
-        cast, cast ON device) DIA payload makes the ship skip the one
-        payload that is both the largest and already resident — the
-        host-rebuilt L0 layout never crosses the wire."""
-        if not (dev_cast is not None
-                and Af_host.dia_offsets == orig.dia_offsets
-                and isinstance(Af_host.dia_vals, np.ndarray)):
-            self._l0_seed = None
+        cast, cast ON device) payloads makes the ship skip the arrays
+        that are both the largest and already resident — a host-held
+        L0 layout never crosses the wire. A payload seeds when the host
+        array IS the device array's retained mirror (layout reused by
+        _pull_host_l0), or — for DIA — when the host rebuild provably
+        produced the same packing (identical offset tuple)."""
+        self._l0_seed = None
+        if dev is None:
             return
-        self._l0_seed = (Af_host.dia_vals, dev_cast)
-        self._seed_put_cache()
+        from ..matrix import _HOST_MIRROR
+        seeds = []
+        for f, d in dev.items():
+            h = getattr(Af_host, f, None)
+            if h is None or not isinstance(h, np.ndarray):
+                continue
+            ok = h is _HOST_MIRROR.get(id(getattr(orig, f)))
+            if not ok and f == "dia_vals":
+                ok = Af_host.dia_offsets == orig.dia_offsets
+            if ok:
+                seeds.append((h, d))
+        if seeds:
+            self._l0_seed = tuple(seeds)
+            self._seed_put_cache()
 
     def _seed_put_cache(self):
-        """(Re)apply the L0 device-payload seed after any _put_cache
+        """(Re)apply the L0 device-payload seeds after any _put_cache
         reset (resetup, abandoned GEO builds)."""
-        if getattr(self, "_l0_seed", None) is not None:
-            src, dev = self._l0_seed
+        for src, dev in getattr(self, "_l0_seed", None) or ():
             self._put_cache[id(src)] = (src, dev)
 
     @staticmethod
@@ -284,12 +353,13 @@ class AMG:
             host = jax.devices("cpu")[0]
             l0_dev = self._l0_device_cast(A)        # see setup()
             with jax.default_device(host):
-                Af = self._pull_numpy(self._strip_layouts(A))
-                Af = Af.init()
-                # refresh the L0 seed: the rebuilt host hierarchy has a
-                # NEW dia array (a stale seed would both miss the ship
-                # skip and pin the previous payload for the object's
-                # lifetime)
+                from ..profiling import trace_region
+                with trace_region("amg.host_pull"):
+                    Af = self._pull_host_l0(A)
+                # refresh the L0 seeds: a rebuilt host hierarchy has
+                # NEW layout arrays (stale seeds would both miss the
+                # ship skip and pin the previous payloads for the
+                # object's lifetime)
                 self._register_device_l0(A, Af, l0_dev)
                 return self._resetup_impl(Af, reuse)
         Af = A if A.initialized else A.init()
@@ -306,6 +376,8 @@ class AMG:
         from .aggregation.galerkin import (deferred_wrap_checks,
                                            geo_dia_disabled)
 
+        from ..matrix import forced_device_setup
+
         def reuse_loop(Af):
             lvl = 0
             while lvl < k:
@@ -314,10 +386,18 @@ class AMG:
                     break
                 level = type(old)(Af, self.cfg, self.scope, lvl)
                 level.reuse_structure(old)
-                Ac = level.create_coarse_matrix()
-                self.levels.append(level)
-                self._prefetch_level(level)
-                Af = Ac.build_spmv_layout() if Ac.initialized else Ac.init()
+                forced = self._level_device_forced(Af.num_rows)
+                from ..matrix import host_resident
+                level.built_backend = "device" if forced or \
+                    not host_resident(Af.row_offsets, Af.values) else "host"
+                with forced_device_setup(forced):
+                    Ac = level.create_coarse_matrix()
+                    self.levels.append(level)
+                    if not self._defer_smoothers:
+                        self._attach_level_smoother(level)
+                    self._prefetch_level(level)
+                    Af = (Ac.build_spmv_layout() if Ac.initialized
+                          else Ac.init())
                 lvl += 1
             return Af, lvl
 
@@ -339,6 +419,7 @@ class AMG:
         return self
 
     def _build_levels(self, Af: CsrMatrix, lvl: int):
+        from ..matrix import forced_device_setup, host_resident
         from ..profiling import trace_region
         level_cls = registry.amg_levels.get(self.algorithm)
         while True:
@@ -350,59 +431,87 @@ class AMG:
             if stop:
                 break
             level = level_cls(Af, self.cfg, self.scope, lvl)
-            with trace_region(f"amg.L{lvl}.selector"):
+            forced = self._level_device_forced(n)
+            level.built_backend = "device" if forced or not host_resident(
+                Af.row_offsets, Af.values) else "host"
+            with forced_device_setup(forced):
+                # selector/interpolation/Galerkin phase timers live in
+                # the level classes (disjoint amg.L*.{selector,strength,
+                # cfsplit,interp,transposeR,rap,galerkin,...} leaves)
                 level.create_coarse_vertices()
-            nc = level.coarse_size
-            # stalling coarsening -> stop (coarsen_threshold semantics:
-            # require the grid to shrink by at least that factor)
-            if nc <= 0 or nc >= n or (n / max(nc, 1)) < self.coarsen_threshold:
-                break
-            with trace_region(f"amg.L{lvl}.galerkin"):
+                nc = level.coarse_size
+                # stalling coarsening -> stop (coarsen_threshold
+                # semantics: the grid must shrink at least that factor)
+                if nc <= 0 or nc >= n or \
+                        (n / max(nc, 1)) < self.coarsen_threshold:
+                    break
                 Ac = level.create_coarse_matrix()
-            # resilience fault harness: a `galerkin_perturb` spec scales
-            # this level's coarse values (host-orchestrated — no cached
-            # trace can replay it); inert when nothing is armed
-            from ..resilience import faultinject as _fault
-            Ac = _fault.perturb_galerkin(Ac, lvl)
-            self.levels.append(level)
-            self._prefetch_level(level)
-            with trace_region(f"amg.L{lvl}.layout"):
-                Af = Ac.build_spmv_layout() if Ac.initialized else Ac.init()
+                # resilience fault harness: a `galerkin_perturb` spec
+                # scales this level's coarse values (host-orchestrated —
+                # no cached trace can replay it); inert when unarmed
+                from ..resilience import faultinject as _fault
+                Ac = _fault.perturb_galerkin(Ac, lvl)
+                self.levels.append(level)
+                # per-level pipeline: the smoother is set up as soon as
+                # its level finishes, so its solve-data (and the level's
+                # operators) ship while the NEXT level is coarsening.
+                # Trade-off: a build abandoned by a failed deferred GEO
+                # wrap check (rare — values violating the geometric
+                # invariant) now discards this smoother work too and
+                # pays it again on the rebuild.
+                if not self._defer_smoothers:
+                    self._attach_level_smoother(level)
+                self._prefetch_level(level)
+                with trace_region(f"amg.L{lvl}.layout"):
+                    Af = (Ac.build_spmv_layout() if Ac.initialized
+                          else Ac.init())
             lvl += 1
         self.coarsest_A = Af
 
+    def _smoother_spec(self, level_index: int):
+        """Smoother (name, scope) for one level: with fine_levels >= 0,
+        levels < fine_levels use fine_smoother and the rest use
+        coarse_smoother (the reference's fine/coarse algorithm split);
+        fine_levels=-1 (default) disables the split and every level
+        uses `smoother`."""
+        fine_levels = int(self.cfg.get("fine_levels", self.scope))
+        if fine_levels < 0:
+            return self.cfg.get_solver("smoother", self.scope)
+        if level_index < fine_levels:
+            return self.cfg.get_solver("fine_smoother", self.scope)
+        return self.cfg.get_solver("coarse_smoother", self.scope)
+
+    def _attach_level_smoother(self, level: AMGLevel):
+        from ..solvers.base import make_solver
+        from ..profiling import trace_region
+        name, scope = self._smoother_spec(level.level_index)
+        level.smoother = make_solver(name, self.cfg, scope)
+        level.smoother._owns_scaling = False
+        if getattr(level.smoother, "needs_cf_map", False) and \
+                getattr(level, "cf_map", None) is not None:
+            level.smoother.set_cf_map(level.cf_map)
+        with trace_region(f"amg.L{level.level_index}.smoother_setup"):
+            level.smoother.setup(level.A)
+
     def _finalize_setup(self, t0: float):
         from ..solvers.base import make_solver
-        # smoothers: with fine_levels >= 0, levels < fine_levels use
-        # fine_smoother and the rest use coarse_smoother (the reference's
-        # fine/coarse algorithm split); fine_levels=-1 (default) disables
-        # the split and every level uses `smoother`
-        sm_name, sm_scope = self.cfg.get_solver("smoother", self.scope)
-        fine_levels = int(self.cfg.get("fine_levels", self.scope))
-        fs_name, fs_scope = self.cfg.get_solver("fine_smoother", self.scope)
-        cs2_name, cs2_scope = self.cfg.get_solver("coarse_smoother",
-                                                  self.scope)
         from ..profiling import trace_region
+        # smoothers normally attach per level during the build (the
+        # overlapped-shipping pipeline); this catches levels built by
+        # paths that defer (distributed tails restore their own)
         for level in self.levels:
-            if fine_levels < 0:
-                name, scope = sm_name, sm_scope
-            elif level.level_index < fine_levels:
-                name, scope = fs_name, fs_scope
-            else:
-                name, scope = cs2_name, cs2_scope
-            level.smoother = make_solver(name, self.cfg, scope)
-            level.smoother._owns_scaling = False
-            if getattr(level.smoother, "needs_cf_map", False) and \
-                    getattr(level, "cf_map", None) is not None:
-                level.smoother.set_cf_map(level.cf_map)
-            with trace_region(f"amg.L{level.level_index}.smoother_setup"):
-                level.smoother.setup(level.A)
-
+            if level.smoother is None:
+                self._attach_level_smoother(level)
         cs_name, cs_scope = self.cfg.get_solver("coarse_solver", self.scope)
         self.coarse_solver = make_solver(cs_name, self.cfg, cs_scope)
         self.coarse_solver._owns_scaling = False
         with trace_region("amg.coarse_solver_setup"):
             self.coarse_solver.setup(self.coarsest_A)
+        if self._ship_device is not None:
+            # completion barrier of the per-level ship pipeline: every
+            # prefetched transfer resolves before setup returns
+            with trace_region("amg.ship_resolve"):
+                self._resolve_put_cache()
         self.num_levels = len(self.levels) + 1
         self.setup_time = time.perf_counter() - t0
         if self.print_grid_stats:
@@ -455,9 +564,16 @@ class AMG:
             # host-side regardless of this thread's default device; the
             # rare no-toolchain fallback can leave jnp-backed leaves
             # that transfer uncast (full precision) — acceptable for a
-            # path that is already warning-slow
-            return jax.device_put([self._cast_leaf(x) for x in leaves],
-                                  dev)
+            # path that is already warning-slow. The region is
+            # deliberately NOT amg.-prefixed: it runs on the ship
+            # worker, overlapped with the main-thread build — summing
+            # it with the amg.* regions would double-count wall time
+            # (the non-overlapped remainder shows up in
+            # amg.ship_resolve instead).
+            from ..profiling import trace_region
+            with trace_region("ship.cast_put"):
+                return jax.device_put(
+                    [self._cast_leaf(x) for x in leaves], dev)
 
         fut = self._ship_pool.submit(_ship)
         for i, src in enumerate(todo):
@@ -471,9 +587,12 @@ class AMG:
                 self._put_cache[key] = (src, dev[1].result()[dev[2]])
 
     def _prefetch_level(self, level: AMGLevel):
-        """Ship a finished level's big matrix payloads while the rest of
-        the hierarchy is still building (device_put is async; the
-        transfer rides the tunnel behind the remaining host compute)."""
+        """Ship a finished level's solve data while the rest of the
+        hierarchy is still building (device_put is async; the transfer
+        rides the tunnel behind the remaining host compute): the level
+        operators, the transfer operators, and — now that smoothers
+        attach per level — the smoother's solve-data payloads (layout
+        slabs, damping tables, color maps)."""
         if self._ship_device is None:
             return
         pieces = [level.A.slim_for_spmv()]
@@ -481,6 +600,8 @@ class AMG:
             op = getattr(level, name, None)
             if op is not None and op.initialized:
                 pieces.append(op.slim_for_spmv())
+        if level.smoother is not None:
+            pieces.append(level.smoother.solve_data())
         self._prefetch_leaves(pieces)
 
     def solve_data(self) -> Dict[str, Any]:
@@ -501,7 +622,12 @@ class AMG:
             # and coarse-solver payloads) transfer here. amg_precision
             # casting happens host-side before the wire.
             from ..profiling import trace_region
-            with trace_region("amg.ship_resolve"):
+            # ship.-prefixed (NOT amg.): solve_data may run inside a
+            # caller's amg.device_sync span — an amg.* region here would
+            # double-count against the disjoint-leaf attribution sum.
+            # The setup-side barrier (amg.ship_resolve in
+            # _finalize_setup) already accounts the level transfers.
+            with trace_region("ship.resolve_stragglers"):
                 self._prefetch_leaves(data)
                 self._resolve_put_cache()
                 self._data_cache = jax.tree.map(

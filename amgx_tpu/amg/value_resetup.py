@@ -1,16 +1,21 @@
-"""One-dispatch value-only resetup for GEO/DIA hierarchies.
+"""Pipelined value-only resetup for GEO/DIA hierarchies.
 
 The reference's structure-reuse resetup (src/amg.cu:232-262) keeps the
-coarsening and re-runs only the Galerkin products. Done eagerly per
-level on a tunneled accelerator, that still costs one dispatch round
-trip per product plus per-smoother reductions (~1.2 s at 128^3 — pure
-latency, not compute). The XLA-native shape of "value-only rebuild" is
-ONE jitted program: new fine DIA values in, every level's coarse DIA
-values, the Chebyshev taus, and the coarse dense QR factor out. The
-program is traced once per hierarchy structure and cached on the AMG
-object; a resetup then costs one dispatch plus one scalar fetch (the
-batched GEO wrap-check flag, which must be re-validated because it
-depends on the values).
+coarsening and re-runs only the Galerkin products. The value plan here
+chains the SAME jitted building blocks the setup itself dispatches
+(`_geo_compute`, `_any_wrapped`, the eager DIA pack and dense-QR ops):
+new fine DIA values in, every level's coarse DIA values, the Chebyshev
+taus, and the coarse dense QR factor out — all async dispatches with
+exactly ONE device sync (the batched GEO wrap-check flag, which must be
+re-validated because it depends on the values).
+
+Reusing the setup's own jitted pieces is load-bearing for
+`resetup_first_s`: an earlier revision fused the whole plan into one
+mega-`jax.jit` program, which re-traced and re-compiled a second copy
+of every Galerkin product on the FIRST resetup (23 s at 256^3 — worse
+than a cold setup). The chained form hits the setup's compile caches,
+so the first resetup costs roughly a steady-state resetup plus the tiny
+tau/QR glue compiles.
 
 Applies when every level is a GEO-paired DIA level with an in-line
 diagonal (the flagship and north-star shape), every smoother is
@@ -21,7 +26,6 @@ from __future__ import annotations
 
 import dataclasses
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -75,7 +79,10 @@ def _level_plan(level, Ac_structure):
         axes=tuple(level.geo_axes),
         coarse_shape=tuple(level.geo_coarse_shape),
         coffsets=coffsets, contribs=contribs,
-        off_e=off_e, row_e=row_e,
+        # device-resident ONCE at plan build: re-uploading these O(nnz)
+        # gather indices per resetup call would pay a host->device
+        # transfer every cycle on tunneled rigs
+        off_e=jnp.asarray(off_e), row_e=jnp.asarray(row_e),
         nc=Ac_structure.num_rows, kc=len(Ac_structure.dia_offsets))
 
 
@@ -117,18 +124,26 @@ def build_plan(amg):
     if Az.dia_offsets is None or Az.num_rows > 4096 or \
             Az.row_ids is None:
         return None
-    # coarsest dense scatter structure (static)
-    cz_rows = np.asarray(Az.row_ids)
-    cz_cols = np.asarray(Az.col_indices)
+    # coarsest dense scatter structure + damping tables: device-resident
+    # once here, not re-uploaded per resetup call
+    cz_rows = jnp.asarray(Az.row_ids)
+    cz_cols = jnp.asarray(Az.col_indices)
     nz = Az.num_rows
     dt_cast = amg._PRECISIONS[amg.precision]
-    cheb_tabs = {o: np.asarray(chebyshev_poly_coeffs(o))
+    l0_dtype = chain[0].A.dtype
+    cheb_tabs = {o: jnp.asarray(np.asarray(chebyshev_poly_coeffs(o)),
+                                l0_dtype)
                  for _, *rest in sm_plans for o in rest}
 
     from .aggregation.galerkin import _any_wrapped, _geo_compute
     from ..ops.pallas_spmv import LANES, dia_padded_rows
 
     def run(dia_vals0):
+        # EAGER on purpose: every heavy piece below (_geo_compute,
+        # _any_wrapped) is the very jitted function the setup already
+        # compiled for this hierarchy, and the glue (DIA pack, dense
+        # scatter, QR, casts) is small eager ops — so the first resetup
+        # reuses the setup traces instead of compiling a fused twin.
         outs = {"dia": [], "vals": [], "taus": [], "cast": {}}
         dia_vals = dia_vals0
         wrapped = jnp.zeros((), bool)
@@ -138,15 +153,14 @@ def build_plan(amg):
                                              p["fine_shape"])
             if sm_plans[i][0] == "cheb":
                 lam = _lam_rowmax(vals2d)
-                taus = jnp.asarray(cheb_tabs[sm_plans[i][1]],
-                                   dia_vals0.dtype) / lam
+                taus = cheb_tabs[sm_plans[i][1]].astype(
+                    dia_vals0.dtype) / lam
             else:
                 taus = None
             outs["taus"].append(taus)
             cvals = _geo_compute(vals2d, p["coffsets"], p["contribs"],
                                  p["fine_shape"], p["axes"])
-            values_c = cvals[jnp.asarray(p["off_e"]),
-                             jnp.asarray(p["row_e"])]
+            values_c = cvals[p["off_e"], p["row_e"]]
             rows_pad = dia_padded_rows(p["kc"], p["nc"])
             dia_c = jnp.zeros((p["kc"], rows_pad * LANES), cvals.dtype
                               ).at[:, : p["nc"]].set(cvals).reshape(
@@ -173,7 +187,7 @@ def build_plan(amg):
         outs["wrapped"] = wrapped
         return outs
 
-    return {"fn": jax.jit(run), "lv": lv_plans, "sm": sm_plans,
+    return {"fn": run, "lv": lv_plans, "sm": sm_plans,
             "l0_sig": (tuple(int(d) for d in chain[0].A.dia_offsets),
                        chain[0].A.num_rows, len(chain))}
 

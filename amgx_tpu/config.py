@@ -186,6 +186,21 @@ def _register_default_parameters():
       "default backend is a remote accelerator and the algorithm's "
       "setup is index-heavy (CLASSICAL/ENERGYMIN)", "auto",
       {"auto", "always", "never"})
+    R("setup_backend", str, "where the AMG hierarchy setup pipeline runs: "
+      "device = on-accelerator eager jnp pipeline (strength, CF/aggregate "
+      "selection, interpolation assembly, Galerkin triple product and "
+      "DIA/ELL layout packing all stay device-resident; the host numpy "
+      "fast paths are disabled), host = host-CPU numpy/native build with "
+      "per-level overlapped shipping to the ambient accelerator, auto = "
+      "today's heuristic (amg_host_setup decides the pull for index-heavy "
+      "setups on remote accelerators; host fast paths engage wherever the "
+      "data is host-resident — including every tiny coarse level)",
+      "auto", ("auto", "device", "host"))
+    R("setup_device_min_rows", int, "setup_backend=device: levels with "
+      "fewer rows than this lift the device forcing so tiny coarse "
+      "levels may take the host numpy fast paths when the data is "
+      "host-resident (eager dispatch overhead beats the compute there); "
+      "0 forces every level onto the device pipeline", 0, None, 0)
     R("amg_precision", str, "precision of the stored hierarchy + cycle "
       "(TPU-native mixed-precision preconditioning, the dDFI-mode analog: "
       "a float32/bfloat16 cycle inside an f64 flexible Krylov solver)",

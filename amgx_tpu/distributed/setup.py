@@ -1351,7 +1351,14 @@ def _finish_sharded(amg, mesh, axis, M, offsets, lvl, levels,
     # ---- replicated tail: gather + compact + existing global setup ----
     A_tail = _gather_compact(M, offsets).init()
     amg.levels = list(levels)
-    amg._build_levels(A_tail, lvl)
+    # this function owns the smoother assignment for every level (incl.
+    # the replicated tail below) — suppress the hierarchy's per-level
+    # inline attach so tail smoothers are not set up twice
+    amg._defer_smoothers = True
+    try:
+        amg._build_levels(A_tail, lvl)
+    finally:
+        amg._defer_smoothers = False
     assign = _smoother_assignment(amg)
     boundary = len(levels)
     for k, lv in enumerate(levels):

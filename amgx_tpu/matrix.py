@@ -17,8 +17,10 @@ matching XLA's compilation model.
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
+import threading
 import weakref
 from typing import Optional
 
@@ -27,6 +29,35 @@ import jax.numpy as jnp
 import numpy as np
 
 from .errors import BadParametersError
+
+
+class _DeviceSetupState(threading.local):
+    """Per-thread flag for the device-resident setup pipeline
+    (setup_backend=device): while set, every host-numpy fast path that
+    gates on host residency reports 'not host' so the jnp/device
+    implementations run instead — the same code a real accelerator
+    build takes, selectable (and testable) on any backend."""
+
+    forced = False
+
+
+_device_setup = _DeviceSetupState()
+
+
+@contextlib.contextmanager
+def forced_device_setup(on: bool = True):
+    """Force (or explicitly lift, on=False) the device-resident setup
+    implementations for the enclosed block on this thread."""
+    prev = _device_setup.forced
+    _device_setup.forced = bool(on)
+    try:
+        yield
+    finally:
+        _device_setup.forced = prev
+
+
+def device_setup_forced() -> bool:
+    return _device_setup.forced
 
 # id(device array) -> the host numpy original it was created from. Real
 # AmgX matrices always originate on the host (uploads, readers, gallery);
@@ -64,6 +95,8 @@ def host_arrays(*arrays):
     array cannot be served host-side (callers fall back to the device
     path). This is what lets setup-phase index math run in synchronous
     numpy even when the user's matrix lives on the TPU."""
+    if _device_setup.forced:
+        return None
     out = []
     for a in arrays:
         if a is None:
@@ -111,7 +144,11 @@ def host_resident(*arrays) -> bool:
     Gates the numpy fast paths of the setup-phase index math: on the
     host-CPU setup path (amg_host_setup) the same math as the jnp form,
     run synchronously in numpy, avoids hundreds of eager XLA:CPU
-    dispatches per hierarchy build."""
+    dispatches per hierarchy build. Under a forced device-resident
+    setup (setup_backend=device) every array reports non-host so the
+    jnp implementations run."""
+    if _device_setup.forced:
+        return False
     for a in arrays:
         if a is None or isinstance(a, np.ndarray):
             continue
@@ -267,6 +304,8 @@ class CsrMatrix:
         temporaries that degrade every later transfer (measured:
         device_put drops ~30x after an eager device init)."""
         import jax as _jax
+        if _device_setup.forced:
+            return None          # setup_backend=device: build on device
         m_ro = _HOST_MIRROR.get(id(self.row_offsets))
         m_ci = _HOST_MIRROR.get(id(self.col_indices))
         m_va = _HOST_MIRROR.get(id(self.values))

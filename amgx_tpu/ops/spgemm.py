@@ -58,6 +58,9 @@ def _expand(A: CsrMatrix, B: CsrMatrix):
 
 def _on_host(A: CsrMatrix) -> bool:
     import numpy as np
+    from ..matrix import device_setup_forced
+    if device_setup_forced():
+        return False             # setup_backend=device: jnp pipeline
     if isinstance(A.values, np.ndarray):
         return True
     try:

@@ -86,6 +86,17 @@ def reset_timers():
         _timers.clear()
 
 
+def timers_total(prefix: str) -> float:
+    """Total wall seconds accumulated under regions starting with
+    `prefix`. The amg.* setup regions are maintained as DISJOINT leaf
+    spans (no nesting; the overlapped ship worker reports under ship.*)
+    precisely so `timers_total("amg.") / wall` is an honest accounted
+    fraction of a setup's main-thread wall time."""
+    with _lock:
+        return sum(tot for name, (_c, tot) in _timers.items()
+                   if name.startswith(prefix))
+
+
 def format_timers() -> str:
     """AMGX_timer-style report (src/amgx_timer.cu print tree role)."""
     rows = sorted(timers().items(), key=lambda kv: -kv[1][1])

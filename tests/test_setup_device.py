@@ -1,0 +1,240 @@
+"""Device-resident setup pipeline tests (setup_backend=device|host|auto).
+
+Parity contract: a hierarchy built through the forced device (jnp)
+pipeline must match the host (numpy/native) build — identical CF
+splits / aggregates (the PMIS weights and round structure are bit-exact
+across implementations), identical level row counts (hence identical
+grid complexity), and operator entries equal to dtype tolerance (the
+two backends sum the same Galerkin products in different orders).
+"""
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import amgx_tpu as amgx
+from amgx_tpu import gallery
+from amgx_tpu.amg.hierarchy import AMG
+from amgx_tpu.config import Config
+from amgx_tpu.errors import BadConfigurationError
+from amgx_tpu.matrix import device_setup_forced, forced_device_setup
+
+amgx.initialize()
+
+
+def _amg(extra: str, A):
+    cfg = Config.from_string(
+        "algorithm=CLASSICAL, selector=PMIS, interpolator=D2,"
+        " smoother=JACOBI_L1, coarse_solver=DENSE_LU_SOLVER,"
+        " min_coarse_rows=8, max_levels=10, " + extra)
+    return AMG(cfg).setup(A)
+
+
+def _level_rows(amg):
+    return [lv.A.num_rows for lv in amg.levels] + [amg.coarsest_A.num_rows]
+
+
+def _assert_parity(h, d, atol=1e-11):
+    assert len(h.levels) == len(d.levels)
+    assert _level_rows(h) == _level_rows(d), "grid complexity drifted"
+    for lh, ld in zip(h.levels, d.levels):
+        if getattr(lh, "cf_map", None) is not None:
+            assert np.array_equal(np.asarray(lh.cf_map),
+                                  np.asarray(ld.cf_map)), \
+                "CF split differs between backends"
+        if getattr(lh, "aggregates", None) is not None:
+            assert np.array_equal(np.asarray(lh.aggregates),
+                                  np.asarray(ld.aggregates)), \
+                "aggregates differ between backends"
+    mats_h = [lv.A for lv in h.levels] + [h.coarsest_A]
+    mats_d = [lv.A for lv in d.levels] + [d.coarsest_A]
+    for Mh, Md in zip(mats_h, mats_d):
+        np.testing.assert_allclose(
+            np.asarray(Mh.to_dense()), np.asarray(Md.to_dense()),
+            rtol=1e-10, atol=atol)
+
+
+class TestClassicalParity:
+    # the forced-device pipeline is eager-dispatch-bound on a CPU rig,
+    # so only ONE representative parity test per family stays in the
+    # tier-1 budget; the broader matrix runs with `-m slow`
+    @pytest.mark.parametrize("interp", ["D2"])
+    def test_pmis_parity_2d(self, interp):
+        A = gallery.poisson("5pt", 24, 24).init()
+        h = _amg(f"interpolator={interp}, setup_backend=host", A)
+        d = _amg(f"interpolator={interp}, setup_backend=device", A)
+        assert all(lv.built_backend == "device" for lv in d.levels)
+        assert all(lv.built_backend == "host" for lv in h.levels)
+        _assert_parity(h, d)
+
+    @pytest.mark.slow
+    def test_pmis_d1_parity_2d(self):
+        A = gallery.poisson("5pt", 24, 24).init()
+        _assert_parity(_amg("interpolator=D1, setup_backend=host", A),
+                       _amg("interpolator=D1, setup_backend=device", A))
+
+    @pytest.mark.slow
+    def test_pmis_d2_parity_3d(self):
+        A = gallery.poisson("7pt", 10, 10, 10).init()
+        _assert_parity(_amg("setup_backend=host", A),
+                       _amg("setup_backend=device", A))
+
+    @pytest.mark.slow
+    def test_truncated_production_config_parity(self):
+        """The reference's D2 production knobs (truncation + row-sum
+        weakening) through both backends."""
+        extra = ("interp_max_elements=4, max_row_sum=0.9,"
+                 " strength_threshold=0.25, ")
+        A = gallery.poisson("9pt", 20, 20).init()
+        _assert_parity(_amg(extra + "setup_backend=host", A),
+                       _amg(extra + "setup_backend=device", A))
+
+    @pytest.mark.slow
+    def test_hmis_parity(self):
+        """HMIS keeps its host-serial RS pass in BOTH backends (the
+        reference runs RS on the host even in device builds) — the
+        device pipeline covers the PMIS fixup; splits must agree."""
+        A = gallery.poisson("5pt", 18, 18).init()
+        _assert_parity(_amg("selector=HMIS, setup_backend=host", A),
+                       _amg("selector=HMIS, setup_backend=device", A))
+
+
+class TestAggregationParity:
+    def test_size2_parity(self):
+        A = gallery.poisson("7pt", 8, 8, 8).init()
+        base = ("algorithm=AGGREGATION, selector=SIZE_2,"
+                " smoother=JACOBI_L1, coarse_solver=DENSE_LU_SOLVER,"
+                " min_coarse_rows=8, max_levels=10, setup_backend=")
+        h = AMG(Config.from_string(base + "host")).setup(A)
+        d = AMG(Config.from_string(base + "device")).setup(A)
+        _assert_parity(h, d)
+
+    @pytest.mark.slow
+    def test_device_solve_converges(self):
+        """End-to-end: a solver whose AMG preconditioner was built by
+        the device pipeline converges like the host-built one."""
+        A = gallery.poisson("7pt", 12, 12, 12).init()
+        b = np.ones(A.num_rows)
+        iters = {}
+        for be in ("host", "device"):
+            cfg = Config.from_string(
+                "solver(s)=PCG, s:max_iters=60, s:tolerance=1e-8,"
+                " s:convergence=RELATIVE_INI, s:monitor_residual=1,"
+                " s:preconditioner(amg)=AMG, amg:algorithm=CLASSICAL,"
+                " amg:selector=PMIS, amg:interpolator=D2,"
+                " amg:smoother=JACOBI_L1, amg:max_iters=1,"
+                " amg:min_coarse_rows=16, amg:max_levels=10,"
+                f" amg:setup_backend={be}")
+            s = amgx.create_solver(cfg)
+            s.setup(A)
+            r = s.solve(b)
+            assert bool(r.converged), be
+            iters[be] = int(r.iterations)
+        assert iters["host"] == iters["device"]
+
+
+class TestBackendDispatch:
+    def test_auto_uses_host_impls_on_cpu(self):
+        A = gallery.poisson("5pt", 16, 16).init()
+        amg = _amg("setup_backend=auto", A)
+        assert amg._setup_backend_used == "auto"
+        assert all(lv.built_backend == "host" for lv in amg.levels)
+
+    def test_device_forces_jnp_impls(self):
+        A = gallery.poisson("5pt", 16, 16).init()
+        amg = _amg("setup_backend=device", A)
+        assert amg._setup_backend_used == "device"
+        assert all(lv.built_backend == "device" for lv in amg.levels)
+
+    def test_min_rows_threshold_lifts_forcing(self):
+        """setup_device_min_rows: tiny levels drop back to the host
+        numpy fast paths (the dispatch-overhead escape hatch)."""
+        A = gallery.poisson("5pt", 16, 16).init()
+        amg = _amg("setup_backend=device, setup_device_min_rows=100", A)
+        backends = [lv.built_backend for lv in amg.levels]
+        assert backends[0] == "device"          # 256 rows: forced
+        assert all(b == "host" for lv, b in zip(amg.levels, backends)
+                   if lv.A.num_rows < 100)
+
+    def test_bad_backend_value_rejected(self):
+        with pytest.raises(BadConfigurationError):
+            Config.from_string("setup_backend=banana")
+
+    def test_forcing_context_restores(self):
+        assert not device_setup_forced()
+        with forced_device_setup():
+            assert device_setup_forced()
+            with forced_device_setup(False):
+                assert not device_setup_forced()
+            assert device_setup_forced()
+        assert not device_setup_forced()
+
+
+class TestL0LayoutReuse:
+    def test_pull_host_l0_reuses_built_layout(self, monkeypatch):
+        """When the caller's matrix already carries its SpMV layout,
+        the host pull serves every piece (incl. DIA payloads) without
+        re-packing — init() must never run."""
+        A = gallery.poisson("7pt", 8, 8, 8).init()
+        assert A.dia_vals is not None
+        amg = AMG(Config.from_string("algorithm=AGGREGATION"))
+        from amgx_tpu.matrix import CsrMatrix
+
+        def boom(self, *a, **k):  # pragma: no cover - guard
+            raise AssertionError("layout was rebuilt instead of reused")
+
+        monkeypatch.setattr(CsrMatrix, "init", boom)
+        Af = amg._pull_host_l0(A)
+        assert Af.initialized
+        assert Af.dia_offsets == A.dia_offsets
+        np.testing.assert_array_equal(np.asarray(Af.dia_vals),
+                                      np.asarray(A.dia_vals))
+
+    def test_pull_host_l0_falls_back_uninitialized(self):
+        A = gallery.poisson("5pt", 8, 8)       # no layout yet
+        amg = AMG(Config.from_string("algorithm=AGGREGATION"))
+        Af = amg._pull_host_l0(A)
+        assert Af.initialized
+
+
+class TestSetupAttribution:
+    def test_breakdown_accounts_for_wall(self):
+        """The amg.* regions are disjoint leaves covering the setup's
+        main-thread wall: their sum must reach >= 85% of a warm setup
+        at test scale (bench enforces >= 90% at bench scale, where
+        fixed per-call overheads amortize)."""
+        import time
+
+        from amgx_tpu import profiling
+        from amgx_tpu.presets import FLAGSHIP
+        import jax
+        A = gallery.poisson("7pt", 16, 16, 16).init()
+        warm = amgx.create_solver(Config.from_string(FLAGSHIP))
+        warm.setup(A)
+        jax.block_until_ready(warm.solve_data())
+        slv = amgx.create_solver(Config.from_string(FLAGSHIP))
+        profiling.reset_timers()
+        t0 = time.perf_counter()
+        slv.setup(A)
+        with profiling.trace_region("amg.device_sync"):
+            jax.block_until_ready(slv.solve_data())
+        wall = time.perf_counter() - t0
+        accounted = profiling.timers_total("amg.")
+        assert accounted / wall >= 0.85, (accounted, wall,
+                                          profiling.timers())
+
+    def test_layout_timer_measures_packing(self):
+        """Satellite regression: amg.Lx.layout must wrap the actual
+        packing call sites (it used to report 0.0 on the GEO path,
+        whose DIA pack hid inside the galerkin bucket)."""
+        from amgx_tpu import profiling
+        from amgx_tpu.presets import FLAGSHIP
+        A = gallery.poisson("7pt", 16, 16, 16).init()
+        slv = amgx.create_solver(Config.from_string(FLAGSHIP))
+        profiling.reset_timers()
+        slv.setup(A)
+        t = profiling.timers()
+        layout = [k for k in t if ".layout" in k and k.startswith("amg.L")]
+        assert layout, t.keys()
+        assert sum(t[k][1] for k in layout) > 0.0
