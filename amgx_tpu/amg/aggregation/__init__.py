@@ -114,7 +114,13 @@ class AggregationAMGLevel(AMGLevel):
 
     def level_data(self):
         d = super().level_data()
-        d["aggregates"] = self.aggregates
+        if self.geo_axes is None:
+            # structured (paired) levels restrict/prolongate by reshape
+            # pair-sums — the aggregates map is setup-only state there,
+            # and carrying it in the solve pytree would re-upload an
+            # n-sized host array per jitted call (the GEO selector keeps
+            # it host-resident on purpose)
+            d["aggregates"] = self.aggregates
         return d
 
     def restrict(self, data, r):

@@ -366,16 +366,55 @@ def geo_coarse_values(A: CsrMatrix, fine_shape, axes, coarse_shape):
     return cvals, coffsets
 
 
+# Device-resident twin of _geo_csr_structure, keyed additionally by the
+# ambient device. The structure arrays are pure functions of the offset
+# pattern — identical across every warm setup, resetup, and bench
+# iteration of the same hierarchy — yet each jnp.asarray used to
+# re-cross the host->device wire: at 256^3 the per-setup re-upload of
+# the O(nnz) off_e/row_e/col_e/row_ids arrays is ~1 GB of tunnel
+# traffic, the dominant share of the PR-3-era warm-setup regression
+# (BENCH_r05 northstar_256^3_setup_warm_s 17.37 s vs 5.87 s). Bounded
+# explicit cache (the arrays are live in the hierarchy anyway, so a
+# cache hit adds no HBM beyond one generation).
+_GEO_STRUCT_DEV = {}          # insertion-ordered: oldest evicts first
+_GEO_STRUCT_DEV_MAX_BYTES = 2 << 30
+
+
+def _geo_csr_structure_device(coffsets, coarse_shape):
+    import jax as _jax
+    dev = _jax.config.jax_default_device or _jax.devices()[0]
+    key = (coffsets, coarse_shape, dev)
+    hit = _GEO_STRUCT_DEV.get(key)
+    if hit is not None:
+        _GEO_STRUCT_DEV[key] = _GEO_STRUCT_DEV.pop(key)   # LRU bump
+        return hit
+    out = tuple(jnp.asarray(a) for a in _geo_csr_structure(
+        coffsets, coarse_shape))
+    _GEO_STRUCT_DEV[key] = out
+    # bound by BYTES, not entry count: one 256^3-grade entry is
+    # hundreds of MB, so a count bound could pin many GB of HBM for
+    # hierarchies no longer alive. Entries still referenced by a live
+    # hierarchy survive eviction as arrays (only the cache slot goes).
+    total = 0
+    for k in reversed(list(_GEO_STRUCT_DEV)):
+        total += sum(int(a.nbytes) for a in _GEO_STRUCT_DEV[k])
+        if total > _GEO_STRUCT_DEV_MAX_BYTES and k != key:
+            del _GEO_STRUCT_DEV[k]
+    return out
+
+
 def geo_assemble_dia(cvals, coffsets, coarse_shape) -> CsrMatrix:
     """Layout phase of the structured Galerkin: pack the coarse slab
     into the exact-size CSR + tile-aligned DIA storage (the coarse
     operator's solve layout, built straight from device arrays — this
-    is the packing the amg.L*.layout timer wraps)."""
+    is the packing the amg.L*.layout timer wraps). The CSR structure
+    arrays come from the device-resident cache above: only the NUMERIC
+    slab is new work per setup."""
     cnx, cny, cnz = coarse_shape
     nc = cnx * cny * cnz
-    (row_offsets, off_e, row_e, col_e, diag_idx) = _geo_csr_structure(
-        coffsets, (cnx, cny, cnz))
-    values = cvals[jnp.asarray(off_e), jnp.asarray(row_e)]
+    (row_offsets, off_e, row_e, col_e, diag_idx) = \
+        _geo_csr_structure_device(coffsets, (cnx, cny, cnz))
+    values = cvals[off_e, row_e]
     from ...ops.pallas_spmv import LANES, dia_padded_rows
     kc = len(coffsets)
     rows_pad = dia_padded_rows(kc, nc)
@@ -383,9 +422,9 @@ def geo_assemble_dia(cvals, coffsets, coarse_shape) -> CsrMatrix:
                          ).at[:, :nc].set(cvals).reshape(kc, rows_pad,
                                                          LANES)
     return CsrMatrix(
-        row_offsets=jnp.asarray(row_offsets),
-        col_indices=jnp.asarray(col_e), values=values, diag=None,
-        row_ids=jnp.asarray(row_e), diag_idx=jnp.asarray(diag_idx),
+        row_offsets=row_offsets,
+        col_indices=col_e, values=values, diag=None,
+        row_ids=row_e, diag_idx=diag_idx,
         ell_cols=None, ell_vals=None,
         dia_offsets=tuple(int(k[0]) for k in coffsets),
         dia_vals=dia_vals, num_rows=nc, num_cols=nc,

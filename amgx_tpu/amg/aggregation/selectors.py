@@ -367,7 +367,15 @@ class GeoSelector(AggregationSelector):
         self.fine_shape = shape
         self.pair_axes = axes
         self.coarse_shape = (cnx, cny, cnz)
-        return jnp.asarray(agg, jnp.int32), int(cnx * cny * cnz)
+        # stays HOST numpy: the structured (paired) levels never touch
+        # the aggregates map in the solve phase — restriction/
+        # prolongation are reshape pair-sums and the Galerkin product is
+        # the parity-mask fast path — so uploading it cost a pointless
+        # n*4-byte transfer per level per setup (67 MB for L0 at 256^3
+        # through the tunnel). The generic-fallback consumers
+        # (coarse_a_from_aggregates, restrict_vector) accept numpy and
+        # upload on first use only when that slow path actually runs.
+        return agg.astype(np.int32), int(cnx * cny * cnz)
 
 
 @registry.aggregation_selectors.register("SERIAL_GREEDY")

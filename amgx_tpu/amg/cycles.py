@@ -21,6 +21,18 @@ def _smooth(level, data, b, x, sweeps: int):
     return level.smoother.smooth(data["smoother"], b, x, sweeps)
 
 
+def _smooth_residual(level, data, b, x, sweeps: int):
+    """Presmooth + residual as ONE smoother call: the damped-relaxation
+    smoothers fuse the final sweep with the residual SpMV (and all
+    sweeps with each other) into single-pass kernels on DIA/SWELL
+    levels (ops/smooth.py), so the cycle's hottest pair costs one HBM
+    pass over A instead of sweeps+1. Smoothers without a fused form
+    compose exactly what this replaced (Solver.smooth_residual)."""
+    if sweeps <= 0 or level.smoother is None:
+        return x, residual(data["A"], x, b)
+    return level.smoother.smooth_residual(data["smoother"], b, x, sweeps)
+
+
 def apply_coarse_solver(cs, data, bc, xc, coarsest_sweeps: int):
     """Coarsest-level dispatch (launchCoarseSolver analog,
     include/amg_level.h:229-242). Relaxation-type coarse solvers run
@@ -50,8 +62,7 @@ def _cycle(amg, shape: str, data, lvl: int, b, x):
         return _coarse_solve(amg, data, b, x)
     level = levels[lvl]
     ldata = data["levels"][lvl]
-    x = _smooth(level, ldata, b, x, amg._sweeps(lvl, pre=True))
-    r = residual(ldata["A"], x, b)
+    x, r = _smooth_residual(level, ldata, b, x, amg._sweeps(lvl, pre=True))
     bc = level.restrict(ldata, r)
     xc = jnp.zeros_like(bc)
     if shape == "V":
@@ -80,8 +91,7 @@ def _kcycle(amg, data, lvl: int, b, x, flex: bool):
         return _coarse_solve(amg, data, b, x)
     level = levels[lvl]
     ldata = data["levels"][lvl]
-    x = _smooth(level, ldata, b, x, amg._sweeps(lvl, pre=True))
-    r = residual(ldata["A"], x, b)
+    x, r = _smooth_residual(level, ldata, b, x, amg._sweeps(lvl, pre=True))
     bc = level.restrict(ldata, r)
     Ac_data_lvl = lvl + 1
 

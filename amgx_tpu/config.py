@@ -314,6 +314,10 @@ def _register_default_parameters():
     # TPU-specific additions (new surface; no reference analog)
     R("spmv_impl", str, "SpMV implementation <AUTO|CSR_SEGSUM|ELL|PALLAS>", "AUTO")
     R("tpu_dtype", str, "override compute dtype <float32|float64|bfloat16>", "")
+    R("fused_smoother", int, "fuse damped-relaxation smoother sweeps "
+      "and the trailing cycle residual into single-pass Pallas kernels "
+      "on DIA/SWELL levels (ops/smooth.py); 0 restores the unfused "
+      "sweep-by-sweep compose bit-for-bit", 1, BOOL01)
     # resilience subsystem (amgx_tpu/resilience/)
     R("health_guards", int, "in-trace NaN/breakdown guards in the solve "
       "loop (status classification rides the existing residual check; "

@@ -66,3 +66,27 @@ def spmv_multi(A: CsrMatrix, X: jax.Array) -> jax.Array:
 def residual_multi(A: CsrMatrix, X: jax.Array, B: jax.Array) -> jax.Array:
     """R = B - A @ X, row per system."""
     return B - spmv_multi(A, X)
+
+
+def smooth_dia_multi(A: CsrMatrix, B: jax.Array, X: jax.Array, taus,
+                     dinv=None, with_residual: bool = True):
+    """Multi-RHS form of the fused smoother (+ residual epilogue):
+    X' = X after len(taus) damped sweeps
+
+        X <- X + tau_s * dinv . (B - A X)
+
+    and, when `with_residual`, R = B - A X'. Each sweep's SpMV is one
+    shifted dense multiply-add per stored diagonal over the whole (B, n)
+    slab — this is the route the fused Pallas kernels' custom_vmap rules
+    take when only the vectors carry the batch axis (solve_many's
+    shared-matrix shape), so a vmapped cycle's presmooth+residual pair
+    streams A's values once per slab pass instead of once per system.
+    The update order matches the Pallas kernel: (tau * residual) * dinv."""
+    for t in range(taus.shape[0]):
+        upd = taus[t] * (B - spmv_dia_multi(A, X))
+        if dinv is not None:
+            upd = upd * dinv[None, :]
+        X = X + upd
+    if with_residual:
+        return X, B - spmv_dia_multi(A, X)
+    return X

@@ -38,6 +38,29 @@ from jax.experimental.pallas import tpu as pltpu
 LANES = 128
 _VMEM_BUDGET = 10 * 1024 * 1024  # leave headroom under ~16 MB/core
 
+# Testing hook: the CPU test rig runs the Pallas kernels through the
+# interpreter; flipping this (via force_pallas_interpret) makes the
+# trace-time gates report "supported" off-TPU and routes every kernel
+# call through interpret mode, so kernel-consuming code paths (spmv
+# dispatch, fused smoothers, the cycle) are exercised end to end.
+_FORCE_INTERPRET = False
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def force_pallas_interpret():
+    """Route the DIA Pallas kernels through the interpreter and make
+    their support gates ignore the backend check (CPU test path)."""
+    global _FORCE_INTERPRET
+    prev = _FORCE_INTERPRET
+    _FORCE_INTERPRET = True
+    try:
+        yield
+    finally:
+        _FORCE_INTERPRET = prev
+
 
 def pick_block_rows(k: int, rows128: int) -> int:
     """Rows (of 128 lanes) per grid block. Shared by matrix init (which
@@ -119,7 +142,7 @@ def _layout(offsets, k: int, num_rows: int):
 
 def dia_spmv_supported(A, x_dtype) -> bool:
     """Trace-time gate for the Pallas path."""
-    if jax.default_backend() != "tpu":
+    if jax.default_backend() != "tpu" and not _FORCE_INTERPRET:
         return False
     if A.dia_vals is None or A.dia_vals.dtype != jnp.float32 \
             or x_dtype != jnp.float32:
@@ -186,4 +209,320 @@ def dia_spmv(A, x, interpret=False):
     """Fused DIA SpMV; caller must have checked dia_spmv_supported
     (`interpret=True` runs the Pallas interpreter — CPU test path)."""
     return _dia_spmv_call(A.dia_vals, x, A.dia_offsets, A.num_rows,
-                          interpret=interpret)
+                          interpret=interpret or _FORCE_INTERPRET)
+
+
+# ---------------------------------------------------------------------------
+# Fused multi-sweep smoother (+ residual epilogue)
+#
+# The V-cycle's hot pair is presmooth -> residual: S damped sweeps
+#   x_{s+1} = x_s + tau_s * dinv . (b - A x_s)
+# (Jacobi/Jacobi-L1: tau_s = relaxation_factor, dinv = D^{-1};
+#  CHEBYSHEV_POLY: tau_s = magic-damping taus, dinv absent) followed by
+# r = b - A x_S. Unfused, that is S+1 HBM passes over A's diagonal slab
+# plus an elementwise pass per sweep. This kernel runs all S sweeps AND
+# the residual epilogue in ONE pallas_call via temporal blocking: each
+# grid block loads a row window wide enough to compute all applications
+# locally (redundant halo compute), so A's values stream from HBM once.
+#
+# Window math (rows of 128 lanes). Per application the data dependence
+# grows mr0 rows downward and Mr0 rows upward (mr0 = ceil(max(0,-min d)
+# / 128), Mr0 = max(0, max d)//128 + 1). With n_app applications
+# (n_app = sweeps + 1 when the residual is fused):
+#   win_v = br + (n_app-1)*(mr0+Mr0)    # vals/b/dinv window (compute rows)
+#   win_x = win_v + mr0 + Mr0           # x window (read halo on top)
+# The x state buffer lives in "window coordinates" (row j = x row
+# i*br - n_app*mr0 + j); each application computes rows [mr0, mr0+win_v)
+# of the next state and zero-fills the shrinking edges — the zeros land
+# exactly on rows already invalidated by the dependence cone, so the
+# final block rows [n_app*mr0, n_app*mr0+br) are exact.
+#
+# The values/b/dinv operands need (n_app-1)*mr0 front-halo rows, which
+# the tile-aligned dia_vals store does not carry; callers pass PRE-PADDED
+# operand slabs (built once per setup/resetup by ops.smooth and carried
+# in the smoother's solve_data) so no per-cycle re-layout of A happens.
+# ---------------------------------------------------------------------------
+
+_SMOOTH_VMEM_BUDGET = 11 * 1024 * 1024
+SMOOTH_MAX_APPS = 8          # sweeps + residual cap for one fused call
+_BR_CAP = 2048               # largest candidate block size
+
+
+def smooth_halo_rows(offsets):
+    """(mr0, Mr0): per-application dependence growth in 128-lane rows."""
+    m = max(0, -min(offsets))
+    M = max(0, max(offsets))
+    return -(-m // LANES), M // LANES + 1
+
+
+def smooth_quota_rows(offsets, num_rows: int):
+    """(front, content, back) rows of the quota-padded operand slabs
+    (values / dinv) the fused kernel DMAs windows from. The quota is
+    sized for ANY plan up to SMOOTH_MAX_APPS applications and _BR_CAP
+    block rows, so ONE padded slab per matrix (built at setup by
+    ops.smooth) serves every sweep count the cycle asks for — the
+    sweep count is only known at trace time, after the solve-data
+    pytree is already fixed."""
+    mr0, Mr0 = smooth_halo_rows(offsets)
+    rows128 = max(1, -(-num_rows // LANES))
+    content = max(8, -(-rows128 // 8) * 8)
+    front = (SMOOTH_MAX_APPS - 1) * mr0
+    # block rounding never exceeds one block (every candidate block
+    # size is <= min(content, _BR_CAP)), so the back quota stays
+    # proportional to the matrix instead of a fixed _BR_CAP slab that
+    # would double tiny coarse levels
+    back = (SMOOTH_MAX_APPS - 1) * Mr0 + min(content, _BR_CAP)
+    return front, content, back
+
+
+def dia_smooth_plan(offsets, k: int, num_rows: int, n_steps: int,
+                    with_residual: bool):
+    """Block plan for the fused smoother or None when it does not pay.
+
+    Returns (br, n_app, mr0, Mr0, win_x, win_v, n_blocks). The block
+    size is the largest that fits the double-buffered windows in the
+    VMEM budget; the plan is rejected when the halo recompute would
+    cost more HBM traffic than the unfused n_app passes it replaces
+    (callers then chain shorter fused calls instead)."""
+    if not offsets:
+        return None
+    n_app = int(n_steps) + (1 if with_residual else 0)
+    if n_app < 1 or n_app > SMOOTH_MAX_APPS:
+        return None
+    mr0, Mr0 = smooth_halo_rows(offsets)
+    H = mr0 + Mr0
+    rows128 = max(1, -(-num_rows // LANES))
+    single = max(8, -(-rows128 // 8) * 8)
+    cands = [c for c in (_BR_CAP, 1536, 1024, 768, 512, 384, 256, 192,
+                         128, 96, 64, 32, 16, 8) if c < single]
+    for br in ([single] if single <= _BR_CAP else []) + cands:
+        win_v = br + (n_app - 1) * H
+        win_x = win_v + H
+        n_out = 2 if with_residual else 1
+        vmem = (2 * k * win_v            # values, double-buffered
+                + 2 * (2 * win_v + win_x)   # b/dinv/x windows, 2 slots
+                + 2 * n_out * br         # pipelined output blocks
+                ) * LANES * 4
+        if vmem > _SMOOTH_VMEM_BUDGET:
+            continue
+        # traffic guard: the fused windows (k+2 streams of win_v plus
+        # the x window) must undercut the n_app separate passes
+        fused = (k + 2) * win_v + win_x
+        unfused = n_app * (k + 3) * br
+        if n_app > 1 and fused >= 0.9 * unfused:
+            return None     # halo dominates; caller chains smaller calls
+        n_blocks = -(-rows128 // br)
+        return br, n_app, mr0, Mr0, win_x, win_v, n_blocks
+    return None
+
+
+def dia_smooth_supported(A, x_dtype, n_steps: int,
+                         with_residual: bool) -> bool:
+    """Trace-time gate for the fused smoother Pallas path."""
+    if jax.default_backend() != "tpu" and not _FORCE_INTERPRET:
+        return False
+    if A.dia_vals is None or A.dia_vals.dtype != jnp.float32 \
+            or x_dtype != jnp.float32:
+        return False
+    if A.num_rows != A.num_cols or A.has_external_diag:
+        return False
+    k = A.dia_vals.shape[0]
+    return dia_smooth_plan(A.dia_offsets, k, A.num_rows, n_steps,
+                           with_residual) is not None
+
+
+def _dia_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x, win_v,
+                       n_steps, with_residual, has_dinv, n_blocks,
+                       slab_shift, dtype):
+    """Kernel body factory. Buffer coordinates: state row j = x row
+    i*br - n_app*mr0 + j; vals/b/dinv compute-region row j' = x row
+    i*br - (n_app-1)*mr0 + j' (so an application's output row j'
+    aligns with operand-window row j' directly). `slab_shift` is the
+    static extra front padding of the quota-padded vals/dinv slabs
+    beyond this plan's (n_app-1)*mr0 need."""
+    ro = [mr0 + (o - (o % LANES)) // LANES for o in offsets]
+    rl = [o % LANES for o in offsets]
+
+    def kernel(*refs):
+        # refs: xp, vals_q, bp, [dinv_q], taus, out_x, [out_r],
+        #       xbuf, vbuf, bbuf, [dbuf], sems
+        xp_ref, vals_ref, bp_ref = refs[0], refs[1], refs[2]
+        dinv_ref = refs[3] if has_dinv else None
+        taus_ref = refs[3 + (1 if has_dinv else 0)]
+        off = 4 + (1 if has_dinv else 0)
+        y_ref = refs[off]
+        r_ref = refs[off + 1] if with_residual else None
+        off += 2 if with_residual else 1
+        xbuf, vbuf, bbuf = refs[off], refs[off + 1], refs[off + 2]
+        dbuf = refs[off + 3] if has_dinv else None
+        sems = refs[off + 3 + (1 if has_dinv else 0)]
+
+        i = pl.program_id(0)
+        slot = jax.lax.rem(i, jnp.int32(2))
+
+        def dmas(s, blk):
+            base = jnp.int32(blk) * jnp.int32(br)
+            qbase = base + jnp.int32(slab_shift)
+            ops = [
+                pltpu.make_async_copy(xp_ref.at[pl.ds(base, win_x)],
+                                      xbuf.at[jnp.int32(s)],
+                                      sems.at[jnp.int32(s), 0]),
+                pltpu.make_async_copy(
+                    vals_ref.at[:, pl.ds(qbase, win_v)],
+                    vbuf.at[jnp.int32(s)], sems.at[jnp.int32(s), 1]),
+                pltpu.make_async_copy(bp_ref.at[pl.ds(base, win_v)],
+                                      bbuf.at[jnp.int32(s)],
+                                      sems.at[jnp.int32(s), 2]),
+            ]
+            if has_dinv:
+                ops.append(pltpu.make_async_copy(
+                    dinv_ref.at[pl.ds(qbase, win_v)],
+                    dbuf.at[jnp.int32(s)], sems.at[jnp.int32(s), 3]))
+            return ops
+
+        @pl.when(i == 0)
+        def _():
+            for d in dmas(0, 0):
+                d.start()
+
+        @pl.when(i + 1 < n_blocks)
+        def _():
+            for d in dmas(jax.lax.rem(i + 1, jnp.int32(2)), i + 1):
+                d.start()
+
+        for d in dmas(slot, i):
+            d.wait()
+
+        col = jax.lax.broadcasted_iota(jnp.int32, (win_v, LANES), 1)
+        vals = vbuf[slot]               # (k, win_v, 128)
+        bw = bbuf[slot]                 # (win_v, 128)
+        dw = dbuf[slot] if has_dinv else None
+
+        def apply_A(s):
+            """A @ state on the compute region (win_v rows)."""
+            acc = jnp.zeros((win_v, LANES), dtype)
+            for t, _ in enumerate(offsets):
+                a = jax.lax.slice_in_dim(s, ro[t], ro[t] + win_v, 1, 0)
+                if rl[t] == 0:
+                    w = a
+                else:
+                    b2 = jax.lax.slice_in_dim(s, ro[t] + 1,
+                                              ro[t] + 1 + win_v, 1, 0)
+                    shift = LANES - rl[t]
+                    wa = pltpu.roll(a, jnp.int32(shift), 1)
+                    wb = pltpu.roll(b2, jnp.int32(shift), 1)
+                    w = jnp.where(col < shift, wa, wb)
+                acc = acc + vals[t] * w
+            return acc
+
+        s = xbuf[slot]                  # (win_x, 128) state
+        for t in range(n_steps):
+            tau = taus_ref[t]
+            mid = jax.lax.slice_in_dim(s, mr0, mr0 + win_v, 1, 0)
+            corr = tau * (bw - apply_A(s))
+            if has_dinv:
+                corr = corr * dw
+            pieces = [mid + corr, jnp.zeros((Mr0, LANES), dtype)]
+            if mr0:
+                pieces.insert(0, jnp.zeros((mr0, LANES), dtype))
+            s = jnp.concatenate(pieces, axis=0)
+        y_ref[...] = jax.lax.slice_in_dim(
+            s, n_app * mr0, n_app * mr0 + br, 1, 0)
+        if with_residual:
+            r = bw - apply_A(s)
+            r_ref[...] = jax.lax.slice_in_dim(
+                r, (n_app - 1) * mr0, (n_app - 1) * mr0 + br, 1, 0)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "offsets", "num_rows", "with_residual", "interpret"))
+def _dia_smooth_call(vals_q, dinv_q, taus, b, x, offsets, num_rows,
+                     with_residual, interpret=False):
+    """Run the fused smoother kernel. `vals_q` (k, Q, 128) and `dinv_q`
+    ((Q, 128) or None) are the QUOTA-PADDED operand slabs from
+    ops.smooth (built once per setup, smooth_quota_rows layout); b and
+    x are padded in-trace (the same cost the plain SpMV kernel already
+    pays for x). Caller must have checked dia_smooth_supported."""
+    k = vals_q.shape[0]
+    n_steps = taus.shape[0]
+    has_dinv = dinv_q is not None
+    dtype = vals_q.dtype
+    plan = dia_smooth_plan(offsets, k, num_rows, n_steps, with_residual)
+    br, n_app, mr0, Mr0, win_x, win_v, nb = plan
+    qf, qc, qb = smooth_quota_rows(offsets, num_rows)
+    assert vals_q.shape[1] == qf + qc + qb, \
+        f"fused slab rows {vals_q.shape[1]} != quota {qf + qc + qb}"
+    # quota slab row qf == x row 0; this plan's window base (block i)
+    # is x row i*br - (n_app-1)*mr0, i.e. slab row i*br + slab_shift
+    slab_shift = qf - (n_app - 1) * mr0
+    n = num_rows
+    # x window coordinates: front pad n_app*mr0 rows
+    xp_rows = n_app * mr0 + nb * br + n_app * Mr0
+    xp = jnp.zeros((xp_rows * LANES,), dtype)
+    xp = jax.lax.dynamic_update_slice(xp, x.astype(dtype),
+                                      (n_app * mr0 * LANES,))
+    xp = xp.reshape(xp_rows, LANES)
+    front_v = (n_app - 1) * mr0
+    rows_v = front_v + nb * br + (n_app - 1) * Mr0
+    bp = jnp.zeros((rows_v * LANES,), dtype)
+    bp = jax.lax.dynamic_update_slice(bp, b.astype(dtype),
+                                      (front_v * LANES,))
+    bp = bp.reshape(rows_v, LANES)
+
+    kernel = _dia_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x,
+                                win_v, n_steps, with_residual, has_dinv,
+                                nb, slab_shift, dtype)
+    n_sem = 4 if has_dinv else 3
+    in_specs = [
+        pl.BlockSpec(memory_space=pl.ANY),          # xp
+        pl.BlockSpec(memory_space=pl.ANY),          # vals_q
+        pl.BlockSpec(memory_space=pl.ANY),          # bp
+    ]
+    operands = [xp, vals_q, bp]
+    if has_dinv:
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+        operands.append(dinv_q)
+    in_specs.append(pl.BlockSpec((n_steps,), lambda i: (jnp.int32(0),),
+                                 memory_space=pltpu.SMEM))
+    operands.append(taus.astype(dtype))
+    out_block = pl.BlockSpec((br, LANES), lambda i: (i, jnp.int32(0)),
+                             memory_space=pltpu.VMEM)
+    out_shape = jax.ShapeDtypeStruct((nb * br, LANES), dtype)
+    scratch = [
+        pltpu.VMEM((2, win_x, LANES), dtype),
+        pltpu.VMEM((2, k, win_v, LANES), dtype),
+        pltpu.VMEM((2, win_v, LANES), dtype),
+    ]
+    if has_dinv:
+        scratch.append(pltpu.VMEM((2, win_v, LANES), dtype))
+    scratch.append(pltpu.SemaphoreType.DMA((2, n_sem)))
+    n_out = 2 if with_residual else 1
+    out = pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=in_specs,
+        out_specs=tuple([out_block] * n_out) if with_residual
+        else out_block,
+        out_shape=tuple([out_shape] * n_out) if with_residual
+        else out_shape,
+        scratch_shapes=scratch,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n_app * k * nb * br * LANES,
+            bytes_accessed=((k + 2) * win_v + win_x + n_out * br)
+            * nb * LANES * 4,
+            transcendentals=0,
+        ),
+        # NOTE: `interpret` must be resolved by the (un-jitted) caller —
+        # reading the _FORCE_INTERPRET global here would bake it into a
+        # trace whose jit cache key does not carry it, so an interpret-
+        # mode trace could outlive the forcing context
+        interpret=interpret,
+    )(*operands)
+    outs = out if with_residual else (out,)
+    trimmed = []
+    for o in outs:
+        v = o.reshape(-1)
+        trimmed.append(v[:n] if v.shape[0] != n else v)
+    return tuple(trimmed) if with_residual else trimmed[0]

@@ -165,7 +165,8 @@ def swell_vals_host(ro, vals, num_rows, kpad):
 
 def swell_spmv_supported(A, x_dtype) -> bool:
     """Trace-time gate for the Pallas path."""
-    if jax.default_backend() != "tpu":
+    from .pallas_spmv import _FORCE_INTERPRET
+    if jax.default_backend() != "tpu" and not _FORCE_INTERPRET:
         return False
     if A.swell_cols is None or A.swell_vals is None:
         return False
@@ -292,9 +293,179 @@ def _swell_spmv_call(cols4, vals4, c0row, nchunk, x, w128, num_rows,
 def swell_spmv(A, x, interpret=False):
     """Fused SWELL SpMV; caller must have checked swell_spmv_supported
     (`interpret=True` runs the Pallas interpreter — CPU test path)."""
+    from .pallas_spmv import _FORCE_INTERPRET
     return _swell_spmv_call(A.swell_cols, A.swell_vals, A.swell_c0row,
                             A.swell_nchunk, x, A.swell_w128, A.num_rows,
-                            interpret=interpret)
+                            interpret=interpret or _FORCE_INTERPRET)
+
+
+# ---------------------------------------------------------------------------
+# Fused smoother sweep: SpMV + damped-Jacobi update in one pass
+#
+# x' = x + tau * dinv . (b - A x) for the windowed-ELL layout. The
+# lane-gather layout cannot temporally block like the DIA kernel (a
+# block's x window reaches arbitrarily far, so a second in-kernel sweep
+# would need other blocks' updated values), but fusing the elementwise
+# update into the kernel epilogue removes the separate XLA pass and its
+# 4 HBM streams (read y/x/b/dinv, write x') per sweep — the unfused
+# shape materializes y to HBM because XLA cannot fuse into pallas_call
+# outputs. x/b/dinv arrive as exact row blocks via auto-pipelined
+# BlockSpecs (no halo needed: the update is pointwise in the row).
+# ---------------------------------------------------------------------------
+
+
+def swell_smooth_supported(A, x_dtype) -> bool:
+    """Trace-time gate for the fused-sweep SWELL path."""
+    if not swell_spmv_supported(A, x_dtype):
+        return False
+    if A.has_external_diag or A.num_rows != A.num_cols:
+        return False
+    # three extra (SUBS, 128) double-buffered blocks ride the pipeline
+    w128 = A.swell_w128
+    kpad = A.swell_vals.shape[2]
+    win_bytes = 2 * w128 * LANES * 4
+    ent_bytes = 2 * SUBS * kpad * LANES * (4 + 4)
+    out_bytes = 2 * 4 * SUBS * LANES * 4
+    return win_bytes + ent_bytes + out_bytes <= _VMEM_BUDGET
+
+
+def _swell_smooth_kernel(w128, kpad, n_blocks, has_dinv):
+    rows = SUBS * kpad
+
+    def kernel(*refs):
+        # refs: c0, nch, tau, xp, cols, vals, xblk, bblk, [dinvblk],
+        #       out, xbuf, sems
+        (c0_ref, nch_ref, tau_ref, xp_ref, cols_ref, vals_ref,
+         xb_ref, bb_ref) = refs[:8]
+        db_ref = refs[8] if has_dinv else None
+        out_ref = refs[8 + (1 if has_dinv else 0)]
+        xbuf = refs[9 + (1 if has_dinv else 0)]
+        sems = refs[10 + (1 if has_dinv else 0)]
+
+        b = pl.program_id(0)
+        slot = jax.lax.rem(b, jnp.int32(2))
+
+        def dma(s, blk):
+            return pltpu.make_async_copy(
+                xp_ref.at[pl.ds(c0_ref[blk], w128)],
+                xbuf.at[jnp.int32(s)], sems.at[jnp.int32(s)])
+
+        @pl.when(b == 0)
+        def _():
+            dma(0, 0).start()
+
+        @pl.when(b + 1 < n_blocks)
+        def _():
+            dma(jax.lax.rem(b + 1, jnp.int32(2)), b + 1).start()
+
+        dma(slot, b).wait()
+
+        cols = cols_ref[0].reshape(rows, LANES)
+        vals = vals_ref[0].reshape(rows, LANES)
+        hi = jax.lax.shift_right_logical(cols, jnp.int32(7))
+        lo = jax.lax.bitwise_and(cols, jnp.int32(LANES - 1))
+
+        def slab_step(s, acc):
+            base = s * jnp.int32(8)
+            for j in range(8):
+                c = base + jnp.int32(j)
+                chunk = xbuf[slot, pl.ds(c, 1)]
+                src = jnp.broadcast_to(chunk, (rows, LANES))
+                with enable_x64(False):
+                    g = jnp.take_along_axis(src, lo, axis=1)
+                acc = jnp.where(hi == c, g, acc)
+            return acc
+
+        nslab = jax.lax.div(nch_ref[b] + jnp.int32(7), jnp.int32(8))
+        acc = jax.lax.fori_loop(jnp.int32(0), nslab, slab_step,
+                                jnp.zeros((rows, LANES), jnp.float32))
+        y = jnp.sum((acc * vals).reshape(SUBS, kpad, LANES), axis=1)
+        corr = tau_ref[0] * (bb_ref[...] - y)
+        if has_dinv:
+            corr = corr * db_ref[...]
+        out_ref[...] = xb_ref[...] + corr
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("w128", "num_rows",
+                                             "has_dinv", "interpret"))
+def _swell_smooth_call(cols4, vals4, c0row, nchunk, x, b, dinv, tau,
+                       w128, num_rows, has_dinv, interpret=False):
+    nb, _, kpad, _ = vals4.shape
+    n = num_rows
+    ncols = x.shape[0]
+    xp_rows = -(-ncols // LANES) + w128
+    xp = jnp.zeros((xp_rows * LANES,), jnp.float32)
+    xp = jax.lax.dynamic_update_slice(xp, x.astype(jnp.float32), (0,))
+    xp = xp.reshape(xp_rows, LANES)
+
+    def rowpad(v):
+        out = jnp.zeros((nb * BLOCK_ROWS,), jnp.float32)
+        out = jax.lax.dynamic_update_slice(out, v.astype(jnp.float32),
+                                           (0,))
+        return out.reshape(nb * SUBS, LANES)
+
+    blk = pl.BlockSpec((SUBS, LANES), lambda i: (i, jnp.int32(0)),
+                       memory_space=pltpu.VMEM)
+    in_specs = [
+        pl.BlockSpec((nb,), lambda i: (jnp.int32(0),),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((nb,), lambda i: (jnp.int32(0),),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((1,), lambda i: (jnp.int32(0),),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec((1, SUBS, kpad, LANES),
+                     lambda i: (i, jnp.int32(0), jnp.int32(0),
+                                jnp.int32(0)),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, SUBS, kpad, LANES),
+                     lambda i: (i, jnp.int32(0), jnp.int32(0),
+                                jnp.int32(0)),
+                     memory_space=pltpu.VMEM),
+        blk,            # x block
+        blk,            # b block
+    ]
+    operands = [c0row, nchunk, jnp.reshape(tau, (1,)).astype(jnp.float32),
+                xp, cols4, vals4, rowpad(x), rowpad(b)]
+    if has_dinv:
+        in_specs.append(blk)
+        operands.append(rowpad(dinv))
+    kernel = _swell_smooth_kernel(w128, kpad, nb, has_dinv)
+    y2 = pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=in_specs,
+        out_specs=blk,
+        out_shape=jax.ShapeDtypeStruct((nb * SUBS, LANES), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((2, w128, LANES), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * nb * SUBS * kpad * LANES,
+            bytes_accessed=(2 * kpad + 5) * nb * SUBS * LANES * 4,
+            transcendentals=0,
+        ),
+        # `interpret` resolved by the un-jitted wrapper below so the
+        # flag rides the jit cache key (see _dia_smooth_call)
+        interpret=interpret,
+    )(*operands)
+    y = y2.reshape(-1)
+    if y.shape[0] != n:
+        y = y[:n]
+    return y
+
+
+def swell_smooth_step(A, b, x, tau, dinv=None, interpret=False):
+    """One fused damped sweep x' = x + tau * dinv . (b - A x); caller
+    must have checked swell_smooth_supported."""
+    from .pallas_spmv import _FORCE_INTERPRET
+    return _swell_smooth_call(
+        A.swell_cols, A.swell_vals, A.swell_c0row, A.swell_nchunk,
+        x, b, dinv, tau, A.swell_w128, A.num_rows,
+        dinv is not None, interpret=interpret or _FORCE_INTERPRET)
 
 
 def swell_spmv_xla(A, x):

@@ -691,6 +691,17 @@ class Solver:
         st = jax.lax.fori_loop(0, sweeps, body, st)
         return st["x"]
 
+    def smooth_residual(self, data, b, x, sweeps: int):
+        """(x', r) after `sweeps` smoothing sweeps plus the residual
+        r = b - A x' — the V-cycle's presmooth->restrict hot pair
+        (amg/cycles.py). The default composes smooth() with one extra
+        SpMV, so every smoother keeps working; the damped-relaxation
+        smoothers (relaxation.py, polynomial.py) override with the
+        fused single-pass kernels (ops/smooth.py) when the level's
+        layout supports them."""
+        x = self.smooth(data, b, x, sweeps)
+        return x, _residual(data["A"], x, b)
+
 
 def make_solver(name: str, cfg: Config, scope: str = "default") -> Solver:
     """SolverFactory::allocate analog."""

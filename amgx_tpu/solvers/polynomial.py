@@ -201,6 +201,7 @@ class ChebyshevPolySolver(Solver):
         super().__init__(cfg, scope, name)
         order = int(cfg.get("chebyshev_polynomial_order", scope))
         self.order = min(10, max(order, 1))      # clamp (:102-103)
+        self.fused_smoother = bool(int(cfg.get("fused_smoother", scope)))
 
     def solver_setup(self):
         if self.A.is_block:
@@ -216,6 +217,12 @@ class ChebyshevPolySolver(Solver):
     def solve_data(self):
         d = super().solve_data()
         d["taus"] = self._taus
+        if self.fused_smoother and self.A is not None \
+                and not getattr(self.A, "is_block", True):
+            from ..ops import smooth as fused
+            slabs = fused.solver_fused_slabs(self, self.A)
+            if slabs is not None:
+                d["fused"] = slabs
         return d
 
     def computes_residual(self):
@@ -229,3 +236,32 @@ class ChebyshevPolySolver(Solver):
         out = dict(st)
         out["x"] = x
         return out
+
+    # -- fused smoothing (ops/smooth.py) --------------------------------
+    # One smoother application is `order` damped-Richardson steps
+    # x += tau_i (b - A x); `sweeps` applications are the tiled tau
+    # schedule, which the fused kernels run (with the trailing cycle
+    # residual) in as few HBM passes over A as the plan budget allows.
+    def _fused_taus(self, data, sweeps: int, dtype):
+        taus = jnp.asarray(data["taus"], dtype)
+        return jnp.tile(taus, sweeps) if sweeps > 1 else taus
+
+    def smooth(self, data, b, x, sweeps: int):
+        if sweeps > 0 and self.fused_smoother:
+            from ..ops import smooth as fused
+            out = fused.fused_smooth(
+                data, b, x, self._fused_taus(data, sweeps, x.dtype),
+                with_residual=False)
+            if out is not None:
+                return out
+        return super().smooth(data, b, x, sweeps)
+
+    def smooth_residual(self, data, b, x, sweeps: int):
+        if sweeps > 0 and self.fused_smoother:
+            from ..ops import smooth as fused
+            out = fused.fused_smooth(
+                data, b, x, self._fused_taus(data, sweeps, x.dtype),
+                with_residual=True)
+            if out is not None:
+                return out
+        return super().smooth_residual(data, b, x, sweeps)

@@ -11,10 +11,60 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+import numpy as np
+
 from .. import registry
+from ..ops import smooth as fused
 from ..ops.dense import safe_inverse
 from ..ops.spmv import spmv
 from .base import Solver
+
+
+class _FusedJacobiMixin:
+    """Fused smooth/smooth_residual for scalar damped-Jacobi solvers
+    (x' = x + omega * dinv . (b - A x)): all sweeps and the trailing
+    cycle residual run through the single-pass kernels of ops/smooth.py
+    when the level layout supports them. `fused_smoother=0` (or any
+    unsupported layout/backend) falls back to the base implementations
+    unchanged — bit-for-bit the pre-fusion computation."""
+
+    def _fused_eligible(self, data):
+        A = data["A"]
+        return (self.fused_smoother and not getattr(A, "is_block", True)
+                and "dinv" in data)
+
+    def _fused_taus(self, sweeps: int, dtype):
+        return jnp.asarray(
+            np.full(max(sweeps, 0), self.relaxation_factor), dtype)
+
+    def solve_data(self):
+        d = super().solve_data()
+        d["dinv"] = self._dinv
+        if self.fused_smoother and self.A is not None \
+                and not getattr(self.A, "is_block", True):
+            slabs = fused.solver_fused_slabs(self, self.A,
+                                             dinv=self._dinv)
+            if slabs is not None:
+                d["fused"] = slabs
+        return d
+
+    def smooth(self, data, b, x, sweeps: int):
+        if sweeps > 0 and self._fused_eligible(data):
+            out = fused.fused_smooth(
+                data, b, x, self._fused_taus(sweeps, x.dtype),
+                dinv=data["dinv"], with_residual=False)
+            if out is not None:
+                return out
+        return super().smooth(data, b, x, sweeps)
+
+    def smooth_residual(self, data, b, x, sweeps: int):
+        if sweeps > 0 and self._fused_eligible(data):
+            out = fused.fused_smooth(
+                data, b, x, self._fused_taus(sweeps, x.dtype),
+                dinv=data["dinv"], with_residual=True)
+            if out is not None:
+                return out
+        return super().smooth_residual(data, b, x, sweeps)
 
 
 def safe_recip(d):
@@ -78,7 +128,7 @@ def l1_strengthened_diag(A):
 
 @registry.solvers.register("BLOCK_JACOBI")
 @registry.solvers.register("JACOBI")
-class BlockJacobiSolver(Solver):
+class BlockJacobiSolver(_FusedJacobiMixin, Solver):
     """Damped (block-)Jacobi: x += omega * D^{-1} (b - A x)."""
 
     is_smoother = True
@@ -86,14 +136,10 @@ class BlockJacobiSolver(Solver):
     def __init__(self, cfg, scope="default", name="BLOCK_JACOBI"):
         super().__init__(cfg, scope, name)
         self.relaxation_factor = float(cfg.get("relaxation_factor", scope))
+        self.fused_smoother = bool(int(cfg.get("fused_smoother", scope)))
 
     def solver_setup(self):
         self._dinv = _invert_diag(self.A)
-
-    def solve_data(self):
-        d = super().solve_data()
-        d["dinv"] = self._dinv
-        return d
 
     def computes_residual(self):
         return False
@@ -109,7 +155,7 @@ class BlockJacobiSolver(Solver):
 
 
 @registry.solvers.register("JACOBI_L1")
-class JacobiL1Solver(Solver):
+class JacobiL1Solver(_FusedJacobiMixin, Solver):
     """L1-Jacobi: the diagonal is strengthened by the off-diagonal row L1
     norm, making the sweep unconditionally convergent for SPD matrices
     (jacobi_l1_solver.cu analog)."""
@@ -119,6 +165,7 @@ class JacobiL1Solver(Solver):
     def __init__(self, cfg, scope="default", name="JACOBI_L1"):
         super().__init__(cfg, scope, name)
         self.relaxation_factor = float(cfg.get("relaxation_factor", scope))
+        self.fused_smoother = bool(int(cfg.get("fused_smoother", scope)))
 
     def solver_setup(self):
         A = self.A
@@ -135,11 +182,6 @@ class JacobiL1Solver(Solver):
             self._dinv = safe_inverse(d)
         else:
             self._dinv = safe_recip(l1_strengthened_diag(A))
-
-    def solve_data(self):
-        d = super().solve_data()
-        d["dinv"] = self._dinv
-        return d
 
     def computes_residual(self):
         return False
