@@ -33,6 +33,35 @@ def _smooth_residual(level, data, b, x, sweeps: int):
     return level.smoother.smooth_residual(data["smoother"], b, x, sweeps)
 
 
+def _smooth_restrict(amg, level, data, b, x, sweeps: int):
+    """Presmooth + restriction: with cycle_fusion, aggregation/DIA
+    levels emit the segment-summed coarse rhs from the presmoother
+    kernel's epilogue (ops/smooth.py) — the residual never round-trips
+    HBM and `level.restrict` disappears from the trace. Everything
+    else (classical levels, cycle_fusion=0, unsupported layouts)
+    composes exactly the prior smooth_residual -> restrict pair."""
+    if amg.cycle_fusion and sweeps > 0:
+        out = level.restrict_fused(data, b, x, sweeps)
+        if out is not None:
+            return out
+    x, r = _smooth_residual(level, data, b, x, sweeps)
+    return x, level.restrict(data, r)
+
+
+def _prolongate_smooth(amg, level, data, b, x, xc, sweeps: int):
+    """Prolongation + correction + postsmooth: with cycle_fusion,
+    aggregation/DIA levels fold x + P xc into the postsmoother
+    kernel's first application (ops/smooth.py), removing the
+    correction add's full-vector pass. Falls back to the prior
+    x + prolongate -> smooth compose bit-for-bit."""
+    if amg.cycle_fusion and sweeps > 0:
+        out = level.prolongate_smooth(data, b, x, xc, sweeps)
+        if out is not None:
+            return out
+    x = x + level.prolongate(data, xc)
+    return _smooth(level, data, b, x, sweeps)
+
+
 def apply_coarse_solver(cs, data, bc, xc, coarsest_sweeps: int):
     """Coarsest-level dispatch (launchCoarseSolver analog,
     include/amg_level.h:229-242). Relaxation-type coarse solvers run
@@ -60,10 +89,19 @@ def _cycle(amg, shape: str, data, lvl: int, b, x):
     levels = amg.levels
     if lvl == len(levels):
         return _coarse_solve(amg, data, b, x)
+    if amg.cycle_fusion:
+        # VMEM-resident coarse tail: when every level from here down
+        # fits VMEM together, the whole sub-cycle (smooth -> restrict
+        # -> ... -> coarsest solve -> ... -> prolongate -> smooth) is
+        # ONE pallas_call instead of ~10 tiny dispatches per cycle
+        from ..ops.smooth import coarse_tail_cycle
+        out = coarse_tail_cycle(amg, shape, data, lvl, b, x)
+        if out is not None:
+            return out
     level = levels[lvl]
     ldata = data["levels"][lvl]
-    x, r = _smooth_residual(level, ldata, b, x, amg._sweeps(lvl, pre=True))
-    bc = level.restrict(ldata, r)
+    x, bc = _smooth_restrict(amg, level, ldata, b, x,
+                             amg._sweeps(lvl, pre=True))
     xc = jnp.zeros_like(bc)
     if shape == "V":
         xc = _cycle(amg, "V", data, lvl + 1, bc, xc)
@@ -77,9 +115,8 @@ def _cycle(amg, shape: str, data, lvl: int, b, x):
             xc = _cycle(amg, "V", data, lvl + 1, bc, xc)
     else:
         raise ValueError(f"unknown fixed cycle {shape!r}")
-    x = x + level.prolongate(ldata, xc)
-    x = _smooth(level, ldata, b, x, amg._sweeps(lvl, pre=False))
-    return x
+    return _prolongate_smooth(amg, level, ldata, b, x, xc,
+                              amg._sweeps(lvl, pre=False))
 
 
 def _kcycle(amg, data, lvl: int, b, x, flex: bool):
@@ -91,8 +128,8 @@ def _kcycle(amg, data, lvl: int, b, x, flex: bool):
         return _coarse_solve(amg, data, b, x)
     level = levels[lvl]
     ldata = data["levels"][lvl]
-    x, r = _smooth_residual(level, ldata, b, x, amg._sweeps(lvl, pre=True))
-    bc = level.restrict(ldata, r)
+    x, bc = _smooth_restrict(amg, level, ldata, b, x,
+                             amg._sweeps(lvl, pre=True))
     Ac_data_lvl = lvl + 1
 
     def M(v):
@@ -120,17 +157,19 @@ def _kcycle(amg, data, lvl: int, b, x, flex: bool):
         if it + 1 == k_iters:
             break   # last update: skip the unused trailing M()/beta/p
         z = M(rc)
+        rz_new = blas.dot(rc, z)
         if flex:
             # flexible (Polak-Ribiere) beta tolerates a varying M
             num = blas.dot(rc - rc_old, z)
         else:
-            num = blas.dot(rc, z)
+            # Fletcher-Reeves: the beta numerator IS the next rz —
+            # reuse it instead of computing the same reduction twice
+            num = rz_new
         beta = num / jnp.where(rz == 0, 1.0, rz) * (rz != 0)
-        rz = blas.dot(rc, z)
+        rz = rz_new
         p = z + beta * p
-    x = x + level.prolongate(ldata, xc)
-    x = _smooth(level, ldata, b, x, amg._sweeps(lvl, pre=False))
-    return x
+    return _prolongate_smooth(amg, level, ldata, b, x, xc,
+                              amg._sweeps(lvl, pre=False))
 
 
 def spmv_coarsest(amg, data, v):

@@ -77,6 +77,22 @@ class AMGLevel:
     def prolongate(self, data, xc):
         raise NotImplementedError
 
+    # -- cycle fusion hooks (amg/cycles.py) ------------------------------
+    # Aggregation levels override these with the fused grid-transfer
+    # kernels (presmooth+restrict in one pallas_call, prolongate+
+    # correction folded into the postsmoother's first application);
+    # classical/energymin levels keep the unfused compose by returning
+    # None here.
+    def restrict_fused(self, data, b, x, sweeps: int):
+        """(x', bc) with the restriction fused into the presmoother's
+        kernel epilogue, or None when unsupported."""
+        return None
+
+    def prolongate_smooth(self, data, b, x, xc, sweeps: int):
+        """smooth(b, x + P xc) with the correction folded into the
+        postsmoother's kernel prologue, or None when unsupported."""
+        return None
+
 
 _PENDING = object()    # _put_cache placeholder: (src, (_PENDING, fut, i))
 
@@ -99,6 +115,9 @@ class AMG:
         self.dense_lu_num_rows = int(cfg.get("dense_lu_num_rows", scope))
         self.cycle_name = str(cfg.get("cycle", scope)).upper()
         self.cycle_iters = int(cfg.get("cycle_iters", scope))
+        self.cycle_fusion = bool(int(cfg.get("cycle_fusion", scope)))
+        self.cycle_fusion_tail_rows = int(
+            cfg.get("cycle_fusion_tail_rows", scope))
         self.precision = str(cfg.get("amg_precision", scope))
         self.print_grid_stats = bool(cfg.get("print_grid_stats", scope))
         self.intensive_smoothing = bool(cfg.get("intensive_smoothing", scope))

@@ -318,6 +318,17 @@ def _register_default_parameters():
       "and the trailing cycle residual into single-pass Pallas kernels "
       "on DIA/SWELL levels (ops/smooth.py); 0 restores the unfused "
       "sweep-by-sweep compose bit-for-bit", 1, BOOL01)
+    R("cycle_fusion", int, "fuse the cycle's grid transfers into the "
+      "smoother kernels on aggregation/DIA levels (restriction epilogue "
+      "in the presmoother, prolongation+correction prologue in the "
+      "postsmoother) and run the VMEM-resident coarse tail of the "
+      "hierarchy as one kernel (ops/smooth.py); 0 restores the "
+      "per-level smooth/restrict/prolongate composition bit-for-bit",
+      1, BOOL01)
+    R("cycle_fusion_tail_rows", int, "largest level row count admitted "
+      "into the fused coarse-tail kernel (the dispatch-latency-bound "
+      "tiny-level region; levels above it keep per-level kernels)",
+      65536, None, 0)
     # resilience subsystem (amgx_tpu/resilience/)
     R("health_guards", int, "in-trace NaN/breakdown guards in the solve "
       "loop (status classification rides the existing residual check; "

@@ -713,6 +713,14 @@ class DistAMGLevel:
         from ..ops.spmv import spmv
         return spmv(data["P"], xc)
 
+    # cycle-fusion hooks (amg/cycles.py): sharded levels decline — the
+    # fused transfer kernels assume single-device aggregation layouts
+    def restrict_fused(self, data, b, x, sweeps: int):
+        return None
+
+    def prolongate_smooth(self, data, b, x, xc, sweeps: int):
+        return None
+
 
 class ShardedConsolidationLevel:
     """Boundary between the sharded levels and the replicated tail

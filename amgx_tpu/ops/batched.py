@@ -90,3 +90,73 @@ def smooth_dia_multi(A: CsrMatrix, B: jax.Array, X: jax.Array, taus,
     if with_residual:
         return X, B - spmv_dia_multi(A, X)
     return X
+
+
+# ---------------------------------------------------------------------------
+# cycle fusion slab forms (the custom_vmap fallbacks of the fused
+# grid-transfer / coarse-tail kernels in ops/smooth.py — and the f64
+# reference the kernel parity tests compare against)
+# ---------------------------------------------------------------------------
+
+
+def restrict_multi(R: jax.Array, xfer) -> jax.Array:
+    """BC = segment-sum of R (B, n) over aggregates, via the
+    structure-only child-index slab (m gathers, no scatter)."""
+    ctab = xfer.ctab.reshape(xfer.m, -1)
+    valid = ctab >= 0
+    idx = jnp.where(valid, ctab, 0)
+    g = R[:, idx]                                   # (B, m, ncr*128)
+    bc = jnp.where(valid[None], g, 0.0).sum(axis=1)
+    return bc[:, : xfer.nc]
+
+
+def _agg_content(A: CsrMatrix, xfer) -> jax.Array:
+    """Aggregate id per fine row (n,) — the content slice of the
+    quota-padded atab slab."""
+    from .pallas_spmv import LANES, transfer_quota_rows
+    aqf = transfer_quota_rows(A.dia_offsets, A.num_rows)[0]
+    return xfer.atab.reshape(-1)[aqf * LANES: aqf * LANES + A.num_rows]
+
+
+def prolong_corr_multi(A: CsrMatrix, X: jax.Array, XC: jax.Array,
+                       xfer) -> jax.Array:
+    """X + P XC (piecewise-constant prolongation = gather by aggregate
+    id) for (B, n) X and (B, nc) XC."""
+    return X + XC[:, _agg_content(A, xfer)]
+
+
+def smooth_restrict_dia_multi(A: CsrMatrix, B: jax.Array, X: jax.Array,
+                              taus, dinv, xfer):
+    """Multi-RHS form of the fused presmooth + restriction epilogue:
+    (X', BC) with BC = R (B - A X')."""
+    X, R = smooth_dia_multi(A, B, X, taus, dinv, True)
+    return X, restrict_multi(R, xfer)
+
+
+def corr_smooth_dia_multi(A: CsrMatrix, B: jax.Array, X: jax.Array,
+                          XC: jax.Array, taus, dinv, xfer):
+    """Multi-RHS form of the fused prolongation prologue + postsmooth:
+    X' = smooth(B, X + P XC)."""
+    X = prolong_corr_multi(A, X, XC, xfer)
+    return smooth_dia_multi(A, B, X, taus, dinv, False)
+
+
+def tail_cycle_multi(arrs, B: jax.Array, X: jax.Array, spec):
+    """Multi-RHS form of the VMEM-resident coarse-tail sub-cycle: the
+    SAME _tail_compute the Pallas kernel body runs, vmapped over the
+    batch with the matrix slabs shared — XLA streams each level's
+    values once per slab pass."""
+    from .pallas_spmv import LANES, _tail_compute
+
+    l0 = spec.levels[0]
+
+    def single(b, x):
+        b2 = jnp.zeros((l0.qc * LANES,), b.dtype)
+        b2 = jax.lax.dynamic_update_slice(b2, b, (0,))
+        x2 = jnp.zeros((l0.qc * LANES,), x.dtype)
+        x2 = jax.lax.dynamic_update_slice(x2, x, (0,))
+        out = _tail_compute(arrs, b2.reshape(l0.qc, LANES),
+                            x2.reshape(l0.qc, LANES), spec)
+        return out.reshape(-1)[: l0.n]
+
+    return jax.vmap(single)(B, X)

@@ -255,6 +255,18 @@ def smooth_halo_rows(offsets):
     return -(-m // LANES), M // LANES + 1
 
 
+def smooth_br_candidates(num_rows: int):
+    """Candidate block sizes shared by the plan functions AND the
+    transfer-slab builder (which precomputes per-block coarse window
+    bases for every br the plans could pick — the two lists must never
+    diverge or a planned br would have no window metadata)."""
+    rows128 = max(1, -(-num_rows // LANES))
+    single = max(8, -(-rows128 // 8) * 8)
+    cands = [c for c in (_BR_CAP, 1536, 1024, 768, 512, 384, 256, 192,
+                         128, 96, 64, 32, 16, 8) if c < single]
+    return ([single] if single <= _BR_CAP else []) + cands
+
+
 def smooth_quota_rows(offsets, num_rows: int):
     """(front, content, back) rows of the quota-padded operand slabs
     (values / dinv) the fused kernel DMAs windows from. The quota is
@@ -292,10 +304,7 @@ def dia_smooth_plan(offsets, k: int, num_rows: int, n_steps: int,
     mr0, Mr0 = smooth_halo_rows(offsets)
     H = mr0 + Mr0
     rows128 = max(1, -(-num_rows // LANES))
-    single = max(8, -(-rows128 // 8) * 8)
-    cands = [c for c in (_BR_CAP, 1536, 1024, 768, 512, 384, 256, 192,
-                         128, 96, 64, 32, 16, 8) if c < single]
-    for br in ([single] if single <= _BR_CAP else []) + cands:
+    for br in smooth_br_candidates(num_rows):
         win_v = br + (n_app - 1) * H
         win_x = win_v + H
         n_out = 2 if with_residual else 1
@@ -526,3 +535,799 @@ def _dia_smooth_call(vals_q, dinv_q, taus, b, x, offsets, num_rows,
         v = o.reshape(-1)
         trimmed.append(v[:n] if v.shape[0] != n else v)
     return tuple(trimmed) if with_residual else trimmed[0]
+
+
+# ---------------------------------------------------------------------------
+# Cycle fusion: grid-transfer epilogues + VMEM-resident coarse tail
+#
+# After the fused smoother removed the standalone residual pass (above),
+# the remaining solve-phase HBM traffic of an aggregation level is the
+# grid-transfer chain: restrict reads the residual the smoother just
+# wrote, and prolongate+correction makes one more full-vector pass
+# before the post-smoother reads x again. Both fold into the smoother
+# kernels:
+#
+# - RESTRICTION EPILOGUE (`_dia_smooth_restrict_call`): the presmooth
+#   kernel already holds r in VMEM — instead of writing it to HBM, each
+#   grid block emits the partial segment-sums of its OWN fine rows into
+#   the (static) coarse row window the block touches, gathered through a
+#   precomputed child-index slab (ctab[j][c] = fine slot of aggregate
+#   c's j-th child, -1 when absent). Aggregates straddling a block
+#   boundary complete in the cheap XLA combine that adds the per-block
+#   windows into the coarse rhs — each fine slot belongs to exactly one
+#   block, so the partials sum exactly. r never round-trips HBM and
+#   `level.restrict` disappears from the cycle.
+#
+# - PROLONGATION PROLOGUE (`_dia_prolong_smooth_call`): the postsmooth
+#   kernel's first application folds x + P xc in: each block DMAs the
+#   coarse window its x-window references (per-block base from an SMEM
+#   table) and gathers xc through the aggregate-id slab (atab[slot] =
+#   coarse id, -1 at padding) before the first sweep — the correction
+#   add's full-vector pass disappears.
+#
+# - COARSE TAIL (`_dia_coarse_tail_call`): when every level >= k fits
+#   the VMEM budget simultaneously (the dispatch-latency-bound tiny
+#   levels), the whole sub-cycle — smooth, restrict, ..., coarsest
+#   solve (dense inverse matmul), ..., prolongate, smooth — runs as ONE
+#   grid=(1,) kernel with every intermediate vector VMEM-resident.
+#   `_tail_compute` is the single source of truth: the Pallas kernel
+#   body and the XLA fallback (f64 / vmapped batches, ops/batched.py)
+#   both call it.
+#
+# The child/aggregate index slabs are STRUCTURE-only (built once per
+# (re)setup from the aggregates map by ops.smooth.build_transfer_slabs;
+# value-only resetups keep them). In-kernel gathers use precomputed
+# indices only — no data-dependent addressing.
+# ---------------------------------------------------------------------------
+
+TRANSFER_MAX_CHILD = 16     # largest aggregate the epilogue fuses
+
+
+def coarse_pad_rows(nc: int) -> int:
+    """Padded 128-lane row count of kernel-side coarse vectors."""
+    return max(1, -(-nc // LANES))
+
+
+def transfer_quota_rows(offsets, num_rows: int):
+    """(front, content, back) rows of the quota-padded aggregate-id
+    slab (atab): sized like smooth_quota_rows but one application
+    deeper in front (the prolongation prologue covers the x window,
+    which reaches n_app*mr0 rows before the block)."""
+    mr0, Mr0 = smooth_halo_rows(offsets)
+    rows128 = max(1, -(-num_rows // LANES))
+    content = max(8, -(-rows128 // 8) * 8)
+    front = SMOOTH_MAX_APPS * mr0
+    back = SMOOTH_MAX_APPS * Mr0 + min(content, _BR_CAP)
+    return front, content, back
+
+
+@jax.tree_util.register_pytree_node_class
+class TransferSlabs:
+    """Setup-built transfer payloads of one aggregation level.
+
+    Children (device arrays): `ctab` (m, ncr, 128) int32 child-index
+    slab; `atab` (quota rows, 128) int32 aggregate-id slab; `bases`
+    {br: (cb, pcb)} per-candidate-block-size int32 coarse window bases
+    (restriction / prolongation). Static aux: `nc` coarse rows, `ncr`
+    padded coarse 128-lane rows, `m` max aggregate size, and `windows`
+    ((br, cw, pcw), ...) — the static coarse-window row counts the plan
+    functions check VMEM against."""
+
+    def __init__(self, ctab, atab, bases, nc, ncr, m, windows):
+        self.ctab = ctab
+        self.atab = atab
+        self.bases = bases
+        self.nc = nc
+        self.ncr = ncr
+        self.m = m
+        self.windows = windows
+
+    def tree_flatten(self):
+        return ((self.ctab, self.atab, self.bases),
+                (self.nc, self.ncr, self.m, self.windows))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], children[2], *aux)
+
+
+def dia_restrict_plan(offsets, k: int, num_rows: int, n_steps: int,
+                      m: int, windows):
+    """Block plan for the smoother+restriction-epilogue kernel, or
+    None. Mirrors dia_smooth_plan(with_residual=True) plus the epilogue
+    buffers: m double-buffered child-index windows and the pipelined
+    partial-coarse output block."""
+    if not offsets or m < 1 or m > TRANSFER_MAX_CHILD:
+        return None
+    n_app = int(n_steps) + 1
+    if n_steps < 1 or n_app > SMOOTH_MAX_APPS:
+        return None
+    wmap = {w[0]: w[1] for w in windows}
+    mr0, Mr0 = smooth_halo_rows(offsets)
+    H = mr0 + Mr0
+    rows128 = max(1, -(-num_rows // LANES))
+    for br in smooth_br_candidates(num_rows):
+        if br not in wmap:
+            continue
+        cw = wmap[br]
+        win_v = br + (n_app - 1) * H
+        win_x = win_v + H
+        vmem = (2 * k * win_v + 2 * (2 * win_v + win_x)
+                + 2 * br                 # x output pipeline
+                + 2 * m * cw             # child-index windows (int32)
+                + 2 * cw                 # partial-coarse output pipeline
+                ) * LANES * 4
+        if vmem > _SMOOTH_VMEM_BUDGET:
+            continue
+        # traffic guard vs the unfused compose: n_app passes over A
+        # plus the standalone restrict pass (r write + r/agg read + bc
+        # write ~ 3*br + cw)
+        fused = (k + 2) * win_v + win_x + (m + 1) * cw
+        unfused = n_app * (k + 3) * br + 3 * br + cw
+        if n_app > 1 and fused >= 0.95 * unfused:
+            continue
+        n_blocks = -(-rows128 // br)
+        return br, n_app, mr0, Mr0, win_x, win_v, n_blocks, cw
+    return None
+
+
+def dia_prolong_plan(offsets, k: int, num_rows: int, n_steps: int,
+                     windows):
+    """Block plan for the prolongation-prologue+smoother kernel, or
+    None. with_residual is never true here (the correction folds into
+    the POST-smoother); the prologue adds the aggregate-id window and
+    the coarse-vector window to the budget."""
+    if not offsets:
+        return None
+    n_app = int(n_steps)
+    if n_app < 1 or n_app > SMOOTH_MAX_APPS:
+        return None
+    wmap = {w[0]: w[2] for w in windows}
+    mr0, Mr0 = smooth_halo_rows(offsets)
+    H = mr0 + Mr0
+    rows128 = max(1, -(-num_rows // LANES))
+    for br in smooth_br_candidates(num_rows):
+        if br not in wmap:
+            continue
+        pcw = wmap[br]
+        win_v = br + (n_app - 1) * H
+        win_x = win_v + H
+        vmem = (2 * k * win_v + 2 * (2 * win_v + win_x)
+                + 2 * br                 # x output pipeline
+                + 2 * win_x              # aggregate-id windows (int32)
+                + 2 * pcw                # coarse-vector windows
+                ) * LANES * 4
+        if vmem > _SMOOTH_VMEM_BUDGET:
+            continue
+        # guard vs unfused: n_app passes plus the correction pass
+        # (x read + xc/agg read + x write ~ 2*br + pcw)
+        fused = (k + 2) * win_v + win_x + win_x + pcw
+        unfused = n_app * (k + 3) * br + 2 * br + pcw
+        if fused >= 0.95 * unfused and n_app > 1:
+            continue
+        n_blocks = -(-rows128 // br)
+        return br, n_app, mr0, Mr0, win_x, win_v, n_blocks, pcw
+    return None
+
+
+def _transfer_gate(A, x_dtype) -> bool:
+    if jax.default_backend() != "tpu" and not _FORCE_INTERPRET:
+        return False
+    if A.dia_vals is None or A.dia_vals.dtype != jnp.float32 \
+            or x_dtype != jnp.float32:
+        return False
+    return A.num_rows == A.num_cols and not A.has_external_diag
+
+
+def dia_restrict_supported(A, x_dtype, n_steps: int, xfer) -> bool:
+    if xfer is None or not _transfer_gate(A, x_dtype):
+        return False
+    k = A.dia_vals.shape[0]
+    return dia_restrict_plan(A.dia_offsets, k, A.num_rows, n_steps,
+                             xfer.m, xfer.windows) is not None
+
+
+def dia_prolong_supported(A, x_dtype, n_steps: int, xfer) -> bool:
+    if xfer is None or not _transfer_gate(A, x_dtype):
+        return False
+    k = A.dia_vals.shape[0]
+    return dia_prolong_plan(A.dia_offsets, k, A.num_rows, n_steps,
+                            xfer.windows) is not None
+
+
+def _dia_smooth_restrict_kernel(offsets, br, n_app, mr0, Mr0, win_x,
+                                win_v, n_steps, has_dinv, n_blocks,
+                                slab_shift, m, cw, dtype):
+    """Kernel body factory: the dia_smooth body (window coordinates
+    documented on _dia_smooth_kernel) with the residual epilogue
+    replaced by per-block partial coarse segment-sums — r is gathered
+    through the child-index window into the block's coarse rows and
+    never written to HBM."""
+    ro = [mr0 + (o - (o % LANES)) // LANES for o in offsets]
+    rl = [o % LANES for o in offsets]
+
+    def kernel(*refs):
+        # refs: xp, vals_q, bp, [dinv_q], ctab, cb, taus,
+        #       out_x, out_bc, xbuf, vbuf, bbuf, [dbuf], cbuf, sems
+        xp_ref, vals_ref, bp_ref = refs[0], refs[1], refs[2]
+        off = 3
+        dinv_ref = refs[off] if has_dinv else None
+        off += 1 if has_dinv else 0
+        ctab_ref, cb_ref, taus_ref = refs[off], refs[off + 1], refs[off + 2]
+        off += 3
+        y_ref, bc_ref = refs[off], refs[off + 1]
+        off += 2
+        xbuf, vbuf, bbuf = refs[off], refs[off + 1], refs[off + 2]
+        off += 3
+        dbuf = refs[off] if has_dinv else None
+        off += 1 if has_dinv else 0
+        cbuf, sems = refs[off], refs[off + 1]
+
+        i = pl.program_id(0)
+        slot = jax.lax.rem(i, jnp.int32(2))
+
+        def dmas(s, blk):
+            base = jnp.int32(blk) * jnp.int32(br)
+            qbase = base + jnp.int32(slab_shift)
+            ops = [
+                pltpu.make_async_copy(xp_ref.at[pl.ds(base, win_x)],
+                                      xbuf.at[jnp.int32(s)],
+                                      sems.at[jnp.int32(s), 0]),
+                pltpu.make_async_copy(
+                    vals_ref.at[:, pl.ds(qbase, win_v)],
+                    vbuf.at[jnp.int32(s)], sems.at[jnp.int32(s), 1]),
+                pltpu.make_async_copy(bp_ref.at[pl.ds(base, win_v)],
+                                      bbuf.at[jnp.int32(s)],
+                                      sems.at[jnp.int32(s), 2]),
+            ]
+            nsem = 3
+            if has_dinv:
+                ops.append(pltpu.make_async_copy(
+                    dinv_ref.at[pl.ds(qbase, win_v)],
+                    dbuf.at[jnp.int32(s)], sems.at[jnp.int32(s), nsem]))
+                nsem += 1
+            cbv = cb_ref[blk]
+            for j in range(m):
+                ops.append(pltpu.make_async_copy(
+                    ctab_ref.at[j, pl.ds(cbv, cw)],
+                    cbuf.at[jnp.int32(s), j],
+                    sems.at[jnp.int32(s), nsem + j]))
+            return ops
+
+        @pl.when(i == 0)
+        def _():
+            for d in dmas(0, 0):
+                d.start()
+
+        @pl.when(i + 1 < n_blocks)
+        def _():
+            for d in dmas(jax.lax.rem(i + 1, jnp.int32(2)), i + 1):
+                d.start()
+
+        for d in dmas(slot, i):
+            d.wait()
+
+        col = jax.lax.broadcasted_iota(jnp.int32, (win_v, LANES), 1)
+        vals = vbuf[slot]
+        bw = bbuf[slot]
+        dw = dbuf[slot] if has_dinv else None
+
+        def apply_A(s):
+            acc = jnp.zeros((win_v, LANES), dtype)
+            for t, _ in enumerate(offsets):
+                a = jax.lax.slice_in_dim(s, ro[t], ro[t] + win_v, 1, 0)
+                if rl[t] == 0:
+                    w = a
+                else:
+                    b2 = jax.lax.slice_in_dim(s, ro[t] + 1,
+                                              ro[t] + 1 + win_v, 1, 0)
+                    shift = LANES - rl[t]
+                    wa = pltpu.roll(a, jnp.int32(shift), 1)
+                    wb = pltpu.roll(b2, jnp.int32(shift), 1)
+                    w = jnp.where(col < shift, wa, wb)
+                acc = acc + vals[t] * w
+            return acc
+
+        s = xbuf[slot]
+        for t in range(n_steps):
+            tau = taus_ref[t]
+            mid = jax.lax.slice_in_dim(s, mr0, mr0 + win_v, 1, 0)
+            corr = tau * (bw - apply_A(s))
+            if has_dinv:
+                corr = corr * dw
+            pieces = [mid + corr, jnp.zeros((Mr0, LANES), dtype)]
+            if mr0:
+                pieces.insert(0, jnp.zeros((mr0, LANES), dtype))
+            s = jnp.concatenate(pieces, axis=0)
+        y_ref[...] = jax.lax.slice_in_dim(
+            s, n_app * mr0, n_app * mr0 + br, 1, 0)
+        r = bw - apply_A(s)
+        rblk = jax.lax.slice_in_dim(
+            r, (n_app - 1) * mr0, (n_app - 1) * mr0 + br, 1, 0)
+        rflat = rblk.reshape(br * LANES)
+        base = i * jnp.int32(br * LANES)
+        part = jnp.zeros((cw, LANES), dtype)
+        for j in range(m):
+            idxj = cbuf[slot, j]                       # (cw, 128) int32
+            rel = idxj - base
+            valid = (idxj >= 0) & (rel >= 0) & (rel < br * LANES)
+            g = jnp.take(rflat, jnp.where(valid, rel, 0))
+            part = part + jnp.where(valid, g, jnp.zeros((), dtype))
+        bc_ref[...] = part
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "offsets", "num_rows", "interpret"))
+def _dia_smooth_restrict_call(vals_q, dinv_q, taus, b, x, xfer,
+                              offsets, num_rows, interpret=False):
+    """Fused presmoother + restriction epilogue: (x', bc) after
+    len(taus) damped sweeps, with bc the segment-summed coarse rhs of
+    the trailing residual. Caller must have checked
+    dia_restrict_supported."""
+    k = vals_q.shape[0]
+    n_steps = taus.shape[0]
+    has_dinv = dinv_q is not None
+    dtype = vals_q.dtype
+    plan = dia_restrict_plan(offsets, k, num_rows, n_steps, xfer.m,
+                             xfer.windows)
+    br, n_app, mr0, Mr0, win_x, win_v, nb, cw = plan
+    qf, qc, qb = smooth_quota_rows(offsets, num_rows)
+    assert vals_q.shape[1] == qf + qc + qb
+    slab_shift = qf - (n_app - 1) * mr0
+    n = num_rows
+    cb = xfer.bases[br][0]
+    xp_rows = n_app * mr0 + nb * br + n_app * Mr0
+    xp = jnp.zeros((xp_rows * LANES,), dtype)
+    xp = jax.lax.dynamic_update_slice(xp, x.astype(dtype),
+                                      (n_app * mr0 * LANES,))
+    xp = xp.reshape(xp_rows, LANES)
+    front_v = (n_app - 1) * mr0
+    rows_v = front_v + nb * br + (n_app - 1) * Mr0
+    bp = jnp.zeros((rows_v * LANES,), dtype)
+    bp = jax.lax.dynamic_update_slice(bp, b.astype(dtype),
+                                      (front_v * LANES,))
+    bp = bp.reshape(rows_v, LANES)
+
+    kernel = _dia_smooth_restrict_kernel(
+        offsets, br, n_app, mr0, Mr0, win_x, win_v, n_steps, has_dinv,
+        nb, slab_shift, xfer.m, cw, dtype)
+    n_sem = (4 if has_dinv else 3) + xfer.m
+    in_specs = [
+        pl.BlockSpec(memory_space=pl.ANY),          # xp
+        pl.BlockSpec(memory_space=pl.ANY),          # vals_q
+        pl.BlockSpec(memory_space=pl.ANY),          # bp
+    ]
+    operands = [xp, vals_q, bp]
+    if has_dinv:
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+        operands.append(dinv_q)
+    in_specs.append(pl.BlockSpec(memory_space=pl.ANY))   # ctab
+    operands.append(xfer.ctab)
+    in_specs.append(pl.BlockSpec((nb,), lambda i: (jnp.int32(0),),
+                                 memory_space=pltpu.SMEM))
+    operands.append(cb.astype(jnp.int32))
+    in_specs.append(pl.BlockSpec((n_steps,), lambda i: (jnp.int32(0),),
+                                 memory_space=pltpu.SMEM))
+    operands.append(taus.astype(dtype))
+    out_specs = (
+        pl.BlockSpec((br, LANES), lambda i: (i, jnp.int32(0)),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((cw, LANES), lambda i: (i, jnp.int32(0)),
+                     memory_space=pltpu.VMEM),
+    )
+    out_shape = (
+        jax.ShapeDtypeStruct((nb * br, LANES), dtype),
+        jax.ShapeDtypeStruct((nb * cw, LANES), dtype),
+    )
+    scratch = [
+        pltpu.VMEM((2, win_x, LANES), dtype),
+        pltpu.VMEM((2, k, win_v, LANES), dtype),
+        pltpu.VMEM((2, win_v, LANES), dtype),
+    ]
+    if has_dinv:
+        scratch.append(pltpu.VMEM((2, win_v, LANES), dtype))
+    scratch.append(pltpu.VMEM((2, xfer.m, cw, LANES), jnp.int32))
+    scratch.append(pltpu.SemaphoreType.DMA((2, n_sem)))
+    y2, parts = pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n_app * k * nb * br * LANES,
+            bytes_accessed=((k + 2) * win_v + win_x
+                            + (xfer.m + 1) * cw + br) * nb * LANES * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(*operands)
+    y = y2.reshape(-1)
+    if y.shape[0] != n:
+        y = y[:n]
+    # combine: add each block's partial coarse window at its base row —
+    # every fine slot lives in exactly one block, so aggregates that
+    # straddle block windows complete here
+    if nb == 1 and cw == xfer.ncr:
+        bc = parts.reshape(-1)[:xfer.nc]
+        return y, bc
+    flat = parts.reshape(nb, cw * LANES)
+    bcp = jnp.zeros((xfer.ncr * LANES,), dtype)
+    for i in range(nb):
+        start = cb[i].astype(jnp.int32) * LANES
+        cur = jax.lax.dynamic_slice(bcp, (start,), (cw * LANES,))
+        bcp = jax.lax.dynamic_update_slice(bcp, cur + flat[i], (start,))
+    return y, bcp[:xfer.nc]
+
+
+def _dia_prolong_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x,
+                               win_v, n_steps, has_dinv, n_blocks,
+                               slab_shift, ashift, pcw, dtype):
+    """Kernel body factory: the dia_smooth body with a prologue that
+    folds the coarse correction in — the state window becomes
+    x + P xc (gather of the block's coarse window through the
+    aggregate-id window) BEFORE the first sweep, so the correction
+    add's full-vector HBM pass disappears. `ashift` is the static
+    offset of the x-window base inside the quota-padded atab slab."""
+    ro = [mr0 + (o - (o % LANES)) // LANES for o in offsets]
+    rl = [o % LANES for o in offsets]
+
+    def kernel(*refs):
+        # refs: xp, vals_q, bp, [dinv_q], xcp, atab, pcb, taus,
+        #       out_x, xbuf, vbuf, bbuf, [dbuf], xcbuf, abuf, sems
+        xp_ref, vals_ref, bp_ref = refs[0], refs[1], refs[2]
+        off = 3
+        dinv_ref = refs[off] if has_dinv else None
+        off += 1 if has_dinv else 0
+        xcp_ref, atab_ref, pcb_ref, taus_ref = \
+            refs[off], refs[off + 1], refs[off + 2], refs[off + 3]
+        off += 4
+        y_ref = refs[off]
+        off += 1
+        xbuf, vbuf, bbuf = refs[off], refs[off + 1], refs[off + 2]
+        off += 3
+        dbuf = refs[off] if has_dinv else None
+        off += 1 if has_dinv else 0
+        xcbuf, abuf, sems = refs[off], refs[off + 1], refs[off + 2]
+
+        i = pl.program_id(0)
+        slot = jax.lax.rem(i, jnp.int32(2))
+
+        def dmas(s, blk):
+            base = jnp.int32(blk) * jnp.int32(br)
+            qbase = base + jnp.int32(slab_shift)
+            abase = base + jnp.int32(ashift)
+            ops = [
+                pltpu.make_async_copy(xp_ref.at[pl.ds(base, win_x)],
+                                      xbuf.at[jnp.int32(s)],
+                                      sems.at[jnp.int32(s), 0]),
+                pltpu.make_async_copy(
+                    vals_ref.at[:, pl.ds(qbase, win_v)],
+                    vbuf.at[jnp.int32(s)], sems.at[jnp.int32(s), 1]),
+                pltpu.make_async_copy(bp_ref.at[pl.ds(base, win_v)],
+                                      bbuf.at[jnp.int32(s)],
+                                      sems.at[jnp.int32(s), 2]),
+            ]
+            nsem = 3
+            if has_dinv:
+                ops.append(pltpu.make_async_copy(
+                    dinv_ref.at[pl.ds(qbase, win_v)],
+                    dbuf.at[jnp.int32(s)], sems.at[jnp.int32(s), nsem]))
+                nsem += 1
+            ops.append(pltpu.make_async_copy(
+                xcp_ref.at[pl.ds(pcb_ref[blk], pcw)],
+                xcbuf.at[jnp.int32(s)], sems.at[jnp.int32(s), nsem]))
+            ops.append(pltpu.make_async_copy(
+                atab_ref.at[pl.ds(abase, win_x)],
+                abuf.at[jnp.int32(s)], sems.at[jnp.int32(s), nsem + 1]))
+            return ops
+
+        @pl.when(i == 0)
+        def _():
+            for d in dmas(0, 0):
+                d.start()
+
+        @pl.when(i + 1 < n_blocks)
+        def _():
+            for d in dmas(jax.lax.rem(i + 1, jnp.int32(2)), i + 1):
+                d.start()
+
+        for d in dmas(slot, i):
+            d.wait()
+
+        col = jax.lax.broadcasted_iota(jnp.int32, (win_v, LANES), 1)
+        vals = vbuf[slot]
+        bw = bbuf[slot]
+        dw = dbuf[slot] if has_dinv else None
+
+        def apply_A(s):
+            acc = jnp.zeros((win_v, LANES), dtype)
+            for t, _ in enumerate(offsets):
+                a = jax.lax.slice_in_dim(s, ro[t], ro[t] + win_v, 1, 0)
+                if rl[t] == 0:
+                    w = a
+                else:
+                    b2 = jax.lax.slice_in_dim(s, ro[t] + 1,
+                                              ro[t] + 1 + win_v, 1, 0)
+                    shift = LANES - rl[t]
+                    wa = pltpu.roll(a, jnp.int32(shift), 1)
+                    wb = pltpu.roll(b2, jnp.int32(shift), 1)
+                    w = jnp.where(col < shift, wa, wb)
+                acc = acc + vals[t] * w
+            return acc
+
+        # prologue: s = x + P xc over the WHOLE x window (the sweeps
+        # consume halo rows, which need the corrected state too)
+        s = xbuf[slot]
+        aw = abuf[slot]                                # (win_x, 128)
+        xcw = xcbuf[slot].reshape(pcw * LANES)
+        rel = aw - pcb_ref[i] * jnp.int32(LANES)
+        valid = (aw >= 0) & (rel >= 0) & (rel < pcw * LANES)
+        corr0 = jnp.take(xcw, jnp.where(valid, rel, 0))
+        s = s + jnp.where(valid, corr0, jnp.zeros((), dtype))
+        for t in range(n_steps):
+            tau = taus_ref[t]
+            mid = jax.lax.slice_in_dim(s, mr0, mr0 + win_v, 1, 0)
+            corr = tau * (bw - apply_A(s))
+            if has_dinv:
+                corr = corr * dw
+            pieces = [mid + corr, jnp.zeros((Mr0, LANES), dtype)]
+            if mr0:
+                pieces.insert(0, jnp.zeros((mr0, LANES), dtype))
+            s = jnp.concatenate(pieces, axis=0)
+        y_ref[...] = jax.lax.slice_in_dim(
+            s, n_app * mr0, n_app * mr0 + br, 1, 0)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "offsets", "num_rows", "interpret"))
+def _dia_prolong_smooth_call(vals_q, dinv_q, taus, b, x, xc, xfer,
+                             offsets, num_rows, interpret=False):
+    """Fused prolongation/correction prologue + postsmoother:
+    x' = smooth(b, x + P xc) after len(taus) damped sweeps. Caller
+    must have checked dia_prolong_supported."""
+    k = vals_q.shape[0]
+    n_steps = taus.shape[0]
+    has_dinv = dinv_q is not None
+    dtype = vals_q.dtype
+    plan = dia_prolong_plan(offsets, k, num_rows, n_steps, xfer.windows)
+    br, n_app, mr0, Mr0, win_x, win_v, nb, pcw = plan
+    qf, qc, qb = smooth_quota_rows(offsets, num_rows)
+    assert vals_q.shape[1] == qf + qc + qb
+    slab_shift = qf - (n_app - 1) * mr0
+    aqf, aqc, aqb = transfer_quota_rows(offsets, num_rows)
+    assert xfer.atab.shape[0] == aqf + aqc + aqb
+    ashift = aqf - n_app * mr0
+    n = num_rows
+    pcb = xfer.bases[br][1]
+    xp_rows = n_app * mr0 + nb * br + n_app * Mr0
+    xp = jnp.zeros((xp_rows * LANES,), dtype)
+    xp = jax.lax.dynamic_update_slice(xp, x.astype(dtype),
+                                      (n_app * mr0 * LANES,))
+    xp = xp.reshape(xp_rows, LANES)
+    front_v = (n_app - 1) * mr0
+    rows_v = front_v + nb * br + (n_app - 1) * Mr0
+    bp = jnp.zeros((rows_v * LANES,), dtype)
+    bp = jax.lax.dynamic_update_slice(bp, b.astype(dtype),
+                                      (front_v * LANES,))
+    bp = bp.reshape(rows_v, LANES)
+    xcp = jnp.zeros((xfer.ncr * LANES,), dtype)
+    xcp = jax.lax.dynamic_update_slice(xcp, xc.astype(dtype), (0,))
+    xcp = xcp.reshape(xfer.ncr, LANES)
+
+    kernel = _dia_prolong_smooth_kernel(
+        offsets, br, n_app, mr0, Mr0, win_x, win_v, n_steps, has_dinv,
+        nb, slab_shift, ashift, pcw, dtype)
+    n_sem = (4 if has_dinv else 3) + 2
+    in_specs = [
+        pl.BlockSpec(memory_space=pl.ANY),          # xp
+        pl.BlockSpec(memory_space=pl.ANY),          # vals_q
+        pl.BlockSpec(memory_space=pl.ANY),          # bp
+    ]
+    operands = [xp, vals_q, bp]
+    if has_dinv:
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+        operands.append(dinv_q)
+    in_specs.append(pl.BlockSpec(memory_space=pl.ANY))   # xcp
+    operands.append(xcp)
+    in_specs.append(pl.BlockSpec(memory_space=pl.ANY))   # atab
+    operands.append(xfer.atab)
+    in_specs.append(pl.BlockSpec((nb,), lambda i: (jnp.int32(0),),
+                                 memory_space=pltpu.SMEM))
+    operands.append(pcb.astype(jnp.int32))
+    in_specs.append(pl.BlockSpec((n_steps,), lambda i: (jnp.int32(0),),
+                                 memory_space=pltpu.SMEM))
+    operands.append(taus.astype(dtype))
+    out_specs = pl.BlockSpec((br, LANES), lambda i: (i, jnp.int32(0)),
+                             memory_space=pltpu.VMEM)
+    out_shape = jax.ShapeDtypeStruct((nb * br, LANES), dtype)
+    scratch = [
+        pltpu.VMEM((2, win_x, LANES), dtype),
+        pltpu.VMEM((2, k, win_v, LANES), dtype),
+        pltpu.VMEM((2, win_v, LANES), dtype),
+    ]
+    if has_dinv:
+        scratch.append(pltpu.VMEM((2, win_v, LANES), dtype))
+    scratch.append(pltpu.VMEM((2, pcw, LANES), dtype))
+    scratch.append(pltpu.VMEM((2, win_x, LANES), jnp.int32))
+    scratch.append(pltpu.SemaphoreType.DMA((2, n_sem)))
+    y2 = pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n_app * k * nb * br * LANES,
+            bytes_accessed=((k + 2) * win_v + 2 * win_x + pcw + br)
+            * nb * LANES * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(*operands)
+    y = y2.reshape(-1)
+    if y.shape[0] != n:
+        y = y[:n]
+    return y
+
+
+# ---------------------------------------------------------------------------
+# VMEM-resident coarse-tail sub-cycle
+# ---------------------------------------------------------------------------
+
+import collections
+
+TailLevelSpec = collections.namedtuple(
+    "TailLevelSpec",
+    "offsets n qc has_dinv n_pre n_post nc ncr m")
+TailSpec = collections.namedtuple("TailSpec", "shape levels coarse")
+# coarse: ("inv", nz, ncrz) — dense inverse matmul; ("none", nz, ncrz)
+# — NOSOLVER (no coarse correction)
+
+
+def _rows_to(v, rows: int):
+    """Row-pad / row-trim a (r, 128) vector to `rows` 128-lane rows —
+    the lane packing (linear index, x fastest) is shared by every
+    level's vector layout, so converting between a level's coarse-rhs
+    rows and the next level's content rows is pure row arithmetic."""
+    r = v.shape[0]
+    if rows == r:
+        return v
+    if rows > r:
+        return jnp.pad(v, ((0, rows - r), (0, 0)))
+    return jax.lax.slice_in_dim(v, 0, rows, 1, 0)
+
+
+def _tail_compute(arrs, b, x, spec):
+    """The whole coarse-tail sub-cycle on (rows, 128) VMEM-resident
+    values: per level — presmooth sweeps, residual, child-gather
+    restriction, recursion (V/W/F shape), aggregate-gather prolongation
+    + correction, postsmooth sweeps; dense-inverse matmul (or nothing,
+    NOSOLVER) at the coarsest. SINGLE SOURCE OF TRUTH: the Pallas
+    kernel body runs this on loaded refs and the XLA fallback
+    (ops/batched.py tail_cycle_multi, the f64 / vmapped route) runs it
+    on plain arrays — they cannot drift apart."""
+    levels = spec.levels
+
+    def apply_dia(ls, ar, s):
+        mr0, Mr0 = smooth_halo_rows(ls.offsets)
+        sp = jnp.pad(s, ((mr0, Mr0), (0, 0)))
+        col = jax.lax.broadcasted_iota(jnp.int32, (ls.qc, LANES), 1)
+        acc = jnp.zeros((ls.qc, LANES), s.dtype)
+        for t, o in enumerate(ls.offsets):
+            ro = mr0 + (o - (o % LANES)) // LANES
+            a = jax.lax.slice_in_dim(sp, ro, ro + ls.qc, 1, 0)
+            rl = o % LANES
+            if rl == 0:
+                w = a
+            else:
+                b2 = jax.lax.slice_in_dim(sp, ro + 1, ro + 1 + ls.qc,
+                                          1, 0)
+                shift = LANES - rl
+                w = jnp.where(col < shift, jnp.roll(a, shift, 1),
+                              jnp.roll(b2, shift, 1))
+            acc = acc + ar["vals"][t] * w
+        return acc
+
+    def sweeps(ls, ar, bc, s, taus, n_taus):
+        for t in range(n_taus):
+            corr = taus[t] * (bc - apply_dia(ls, ar, s))
+            if ls.has_dinv:
+                corr = corr * ar["dinv"]
+            s = s + corr
+        return s
+
+    def run(shape, i, bc, s):
+        ls, ar = levels[i], arrs[i]
+        s = sweeps(ls, ar, bc, s, ar["taus_pre"], ls.n_pre)
+        r = bc - apply_dia(ls, ar, s)
+        rflat = r.reshape(-1)
+        coarse_b = jnp.zeros((ls.ncr, LANES), s.dtype)
+        for j in range(ls.m):
+            idxj = ar["ctab"][j]
+            valid = idxj >= 0
+            g = jnp.take(rflat, jnp.where(valid, idxj, 0))
+            coarse_b = coarse_b + jnp.where(valid, g,
+                                            jnp.zeros((), s.dtype))
+        if i + 1 < len(levels):
+            bq = _rows_to(coarse_b, levels[i + 1].qc)
+            xc = run(shape, i + 1, bq, jnp.zeros_like(bq))
+            if shape == "W":
+                xc = run("W", i + 1, bq, xc)
+            elif shape == "F":
+                xc = run("V", i + 1, bq, xc)
+            xc = _rows_to(xc, ls.ncr)
+        else:
+            kind, nz, ncrz = spec.coarse
+            bz = _rows_to(coarse_b, ncrz)
+            if kind == "inv":
+                F = ncrz * LANES
+                xcf = jnp.dot(bz.reshape(1, F), arrs[-1]["invT"],
+                              preferred_element_type=s.dtype)
+                xc = _rows_to(xcf.reshape(ncrz, LANES), ls.ncr)
+            else:               # NOSOLVER: no coarse correction
+                xc = jnp.zeros((ls.ncr, LANES), s.dtype)
+        xcflat = xc.reshape(-1)
+        aw = ar["atab_c"]
+        valid = aw >= 0
+        corr = jnp.take(xcflat, jnp.where(valid, aw, 0))
+        s = s + jnp.where(valid, corr, jnp.zeros((), s.dtype))
+        s = sweeps(ls, ar, bc, s, ar["taus_post"], ls.n_post)
+        return s
+
+    return run(spec.shape, 0, b, x)
+
+
+def _dia_tail_kernel(spec, treedef, n_leaves):
+    def kernel(*refs):
+        arrs = jax.tree_util.tree_unflatten(
+            treedef, [r[...] for r in refs[:n_leaves]])
+        b, x = refs[n_leaves][...], refs[n_leaves + 1][...]
+        refs[n_leaves + 2][...] = _tail_compute(arrs, b, x, spec)
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("spec", "interpret"))
+def _dia_coarse_tail_call(arrs, b, x, spec, interpret=False):
+    """One grid=(1,) pallas_call running the whole coarse-tail
+    sub-cycle with every intermediate vector VMEM-resident — ~10 tiny
+    kernel dispatches per cycle become one. Caller (ops.smooth
+    coarse_tail_plan) has checked eligibility and the VMEM budget."""
+    l0 = spec.levels[0]
+    dtype = b.dtype
+    b2 = jnp.zeros((l0.qc * LANES,), dtype)
+    b2 = jax.lax.dynamic_update_slice(b2, b, (0,)).reshape(l0.qc, LANES)
+    x2 = jnp.zeros((l0.qc * LANES,), dtype)
+    x2 = jax.lax.dynamic_update_slice(x2, x, (0,)).reshape(l0.qc, LANES)
+    leaves, treedef = jax.tree_util.tree_flatten(arrs)
+    kernel = _dia_tail_kernel(spec, treedef, len(leaves))
+
+    def _spec_of(v):
+        nd = len(v.shape)
+        return pl.BlockSpec(v.shape, lambda i, _nd=nd: (jnp.int32(0),)
+                            * _nd, memory_space=pltpu.VMEM)
+
+    flops = sum(2 * (ls.n_pre + ls.n_post + 1) * len(ls.offsets)
+                * ls.qc * LANES for ls in spec.levels)
+    byts = sum(int(v.size) * v.dtype.itemsize for v in leaves) \
+        + 3 * l0.qc * LANES * 4
+    out = pl.pallas_call(
+        kernel,
+        grid=(1,),
+        in_specs=[_spec_of(v) for v in leaves] + [_spec_of(b2),
+                                                  _spec_of(x2)],
+        out_specs=pl.BlockSpec((l0.qc, LANES),
+                               lambda i: (jnp.int32(0), jnp.int32(0)),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((l0.qc, LANES), dtype),
+        cost_estimate=pl.CostEstimate(flops=flops, bytes_accessed=byts,
+                                      transcendentals=0),
+        interpret=interpret,
+    )(*leaves, b2, x2)
+    return out.reshape(-1)[:l0.n]

@@ -26,6 +26,7 @@ class DenseLUSolver(Solver):
         super().__init__(cfg, scope, name)
         self.dense_lu_num_rows = int(cfg.get("dense_lu_num_rows", scope))
         self.dense_lu_max_rows = int(cfg.get("dense_lu_max_rows", scope))
+        self.cycle_fusion = bool(int(cfg.get("cycle_fusion", scope)))
 
     def solver_setup(self):
         dense = self.A.to_dense()
@@ -41,10 +42,33 @@ class DenseLUSolver(Solver):
         q, r = jnp.linalg.qr(dense)
         return q.T, r
 
+    # explicit-inverse size cap for the fused coarse-tail kernel: the
+    # padded inverse lives in VMEM during the whole tail sub-cycle
+    _TAIL_INV_MAX_ROWS = 1024
+
     def solve_data(self):
         d = super().solve_data()
         d["qt"] = self._qt
         d["r"] = self._r
+        if self.cycle_fusion and self.A is not None \
+                and self.A.num_rows <= self._TAIL_INV_MAX_ROWS:
+            from ..ops.smooth import fused_runtime_on
+            if fused_runtime_on():
+                # explicit inverse A^{-1} = R^{-1} Q^T for the
+                # VMEM-resident coarse tail (ops/smooth.py): the tail
+                # kernel applies the coarsest solve as one MXU matmul.
+                # Memoized on the CURRENT factors' identity, so a value
+                # resetup that swaps _qt/_r refreshes it while repeated
+                # solve_data calls (e.g. hierarchies whose tail never
+                # fuses) don't redo the n^2-RHS triangular solve.
+                memo = getattr(self, "_inv_memo", None)
+                if memo is None or memo[0] is not self._qt \
+                        or memo[1] is not self._r:
+                    memo = (self._qt, self._r,
+                            jsl.solve_triangular(self._r, self._qt,
+                                                 lower=False))
+                    self._inv_memo = memo
+                d["inv"] = memo[2]
         return d
 
     def _direct(self, data, rhs):

@@ -265,3 +265,30 @@ class ChebyshevPolySolver(Solver):
             if out is not None:
                 return out
         return super().smooth_residual(data, b, x, sweeps)
+
+    # -- cycle fusion (AMGLevel.restrict_fused / prolongate_smooth) ----
+    def smooth_restrict(self, data, b, x, sweeps: int, xfer):
+        if sweeps > 0 and self.fused_smoother:
+            from ..ops import smooth as fused
+            return fused.fused_smooth_restrict(
+                data, b, x, self._fused_taus(data, sweeps, x.dtype),
+                xfer)
+        return None
+
+    def smooth_corr(self, data, b, x, xc, sweeps: int, xfer):
+        if sweeps > 0 and self.fused_smoother:
+            from ..ops import smooth as fused
+            return fused.fused_corr_smooth(
+                data, b, x, xc, self._fused_taus(data, sweeps, x.dtype),
+                xfer)
+        return None
+
+    def fused_tail_spec(self, data, sweeps: int, dtype):
+        """Tiled tau schedule for the coarse-tail kernel (one smoother
+        application = `order` damped-Richardson steps)."""
+        if not self.fused_smoother or getattr(
+                data["A"], "is_block", True):
+            return None
+        if sweeps <= 0:
+            return jnp.zeros((0,), dtype), None
+        return self._fused_taus(data, sweeps, dtype), None

@@ -66,6 +66,33 @@ class _FusedJacobiMixin:
                 return out
         return super().smooth_residual(data, b, x, sweeps)
 
+    # -- cycle fusion (AMGLevel.restrict_fused / prolongate_smooth) ----
+    def smooth_restrict(self, data, b, x, sweeps: int, xfer):
+        """(x', bc) with the restriction riding the presmoother
+        kernel's epilogue, or None (caller composes unfused)."""
+        if sweeps > 0 and self._fused_eligible(data):
+            return fused.fused_smooth_restrict(
+                data, b, x, self._fused_taus(sweeps, x.dtype), xfer,
+                dinv=data["dinv"])
+        return None
+
+    def smooth_corr(self, data, b, x, xc, sweeps: int, xfer):
+        """smooth(b, x + P xc) with the correction folded into the
+        first kernel application, or None."""
+        if sweeps > 0 and self._fused_eligible(data):
+            return fused.fused_corr_smooth(
+                data, b, x, xc, self._fused_taus(sweeps, x.dtype),
+                xfer, dinv=data["dinv"])
+        return None
+
+    def fused_tail_spec(self, data, sweeps: int, dtype):
+        """(taus, dinv) schedule for the VMEM-resident coarse-tail
+        kernel, or None when this smoother cannot ride it."""
+        if not self.fused_smoother or getattr(
+                data["A"], "is_block", True) or "dinv" not in data:
+            return None
+        return self._fused_taus(max(sweeps, 0), dtype), data["dinv"]
+
 
 def safe_recip(d):
     """Elementwise 1/d with 0 -> 0 (zero-in-diagonal robustness).

@@ -304,7 +304,155 @@ def bench_spmv_layouts(n: int = 128, reps: int = 30, swell_n: int = 192):
     except Exception as e:  # pragma: no cover - bench robustness
         out["swell_error"] = str(e)[:120]
 
+    # ---- fused-vs-unfused CYCLE (grid transfers + coarse tail) --------
+    # One GEO/DIA V-cycle at 64^3 f32: the cycle_fusion knob only
+    # changes the trace, so both timings run against one hierarchy
+    try:
+        cfg = Config.from_string(
+            "solver(s)=PCG, s:max_iters=1, s:monitor_residual=1,"
+            " s:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION,"
+            " amg:selector=GEO, amg:smoother=CHEBYSHEV_POLY,"
+            " amg:chebyshev_polynomial_order=2, amg:presweeps=1,"
+            " amg:postsweeps=1, amg:max_iters=1,"
+            " amg:coarse_solver=DENSE_LU_SOLVER, amg:min_coarse_rows=32")
+        Ac = amgx.gallery.poisson("7pt", 64, 64, 64,
+                                  dtype=np.float32).init()
+        slv = amgx.create_solver(cfg)
+        slv.setup(Ac)
+        sp = cycle_fused_speedup(slv, jnp.ones(Ac.num_rows, jnp.float32),
+                                 reps=9)
+        if sp is not None:
+            out["geo_cycle_64^3"] = sp
+    except Exception as e:  # pragma: no cover - bench robustness
+        out["cycle_error"] = str(e)[:120]
+
     return out
+
+
+def _amg_of(slv):
+    """Walk the preconditioner chain to the AMG hierarchy owner."""
+    s = slv
+    for _ in range(4):
+        if hasattr(s, "amg"):
+            return s.amg
+        s = getattr(s, "preconditioner", None)
+        if s is None:
+            break
+    return None
+
+
+def _cycle_kernel_counts(amg, data, b):
+    """Per-cycle kernel counts from the traced cycle's jaxpr — the
+    HBM-pass regression number the artifact tracks round over round
+    (each dia_* site is one single-pass kernel; dia_spmv sites are the
+    unfused passes cycle fusion is meant to remove)."""
+    import re
+    jaxpr = str(jax.make_jaxpr(
+        lambda bb, xx: amg.cycle(data, bb, xx))(b, jnp.zeros_like(b)))
+    names = re.findall(r"name=\"?([A-Za-z_0-9]+)\"?", jaxpr)
+    counts = {}
+    for nm in names:
+        if "dia" in nm or "swell" in nm:
+            counts[nm] = counts.get(nm, 0) + 1
+    return counts
+
+
+def _time_median(fn, args, reps):
+    jax.block_until_ready(fn(*args))         # compile
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[len(ts) // 2]
+
+
+def cycle_attribution(slv, b, reps: int = 10):
+    """Solve-phase attribution (the solve-side mirror of the setup
+    breakdown): per-level rows / stored diagonals / fusion kind /
+    measured per-level transfer+smooth pair time, the fused-tail
+    boundary, and the traced cycle's per-cycle kernel counts."""
+    from amgx_tpu.amg import cycles as _cyc
+    from amgx_tpu.ops import smooth as _sm
+    amg = _amg_of(slv)
+    if amg is None:
+        return {"error": "no AMG preconditioner"}
+    data = amg.solve_data()
+    dt = amg._PRECISIONS[amg.precision]
+    bb = b.astype(dt) if dt is not None else b
+    out = {"kernels_per_cycle": _cycle_kernel_counts(amg, data, bb)}
+    shape = amg.cycle_name if amg.cycle_name in ("V", "W", "F") else "V"
+    tail_start = None
+    if amg.cycle_fusion:
+        for k in range(len(amg.levels)):
+            bk = jnp.ones(amg.levels[k].A.num_rows, bb.dtype)
+            if _sm.coarse_tail_cycle(amg, shape, data, k, bk,
+                                     jnp.zeros_like(bk)) is not None:
+                tail_start = k
+                break
+    out["tail_start_level"] = tail_start
+    levels = []
+    for lvl, level in enumerate(amg.levels):
+        A = level.A
+        row = {"level": lvl, "rows": int(A.num_rows),
+               "diags": (len(A.dia_offsets) if A.dia_offsets is not None
+                         else None)}
+        nxt = (amg.levels[lvl + 1].A if lvl + 1 < len(amg.levels)
+               else amg.coarsest_A)
+        if tail_start is not None and lvl >= tail_start:
+            row["kind"] = "vmem_tail"
+            if lvl == tail_start:
+                bk = jnp.ones(A.num_rows, bb.dtype)
+                fn = jax.jit(lambda bb_, xx_: _sm.coarse_tail_cycle(
+                    amg, shape, data, tail_start, bb_, xx_))
+                row["tail_s"] = round(_time_median(
+                    fn, (bk, jnp.zeros_like(bk)), reps), 6)
+            levels.append(row)
+            continue
+        ld = data["levels"][lvl]
+        has_xfer = "xfer" in ld
+        row["kind"] = ("fused_transfers" if amg.cycle_fusion and has_xfer
+                       else "unfused_transfers")
+        bk = jnp.ones(A.num_rows, bb.dtype)
+        xck = jnp.ones(nxt.num_rows, bb.dtype)
+        swp, swq = amg._sweeps(lvl, pre=True), amg._sweeps(lvl, pre=False)
+
+        def pair(bb_, xx_, xc_, level=level, ld=ld, swp=swp, swq=swq):
+            x2, bc = _cyc._smooth_restrict(amg, level, ld, bb_, xx_, swp)
+            return _cyc._prolongate_smooth(amg, level, ld, bb_, x2, xc_,
+                                           swq), bc
+        row["pair_s"] = round(_time_median(
+            jax.jit(pair), (bk, jnp.zeros_like(bk), xck), reps), 6)
+        levels.append(row)
+    out["levels"] = levels
+    return out
+
+
+def cycle_fused_speedup(slv, b, reps: int = 10):
+    """Fused-vs-unfused cycle wall clock on the SAME hierarchy: the
+    cycle_fusion knob only changes the trace, so flipping it re-traces
+    the cycle against identical solve data — no second setup."""
+    amg = _amg_of(slv)
+    if amg is None:
+        return None
+    data = amg.solve_data()
+    dt = amg._PRECISIONS[amg.precision]
+    bb = b.astype(dt) if dt is not None else b
+    x0 = jnp.zeros_like(bb)
+
+    def timed():
+        f = jax.jit(lambda bb_, xx_: amg.cycle(data, bb_, xx_))
+        return _time_median(f, (bb, x0), reps)
+
+    t_fused = timed()
+    old = amg.cycle_fusion
+    amg.cycle_fusion = False
+    try:
+        t_unf = timed()
+    finally:
+        amg.cycle_fusion = old
+    return {"fused_s": round(t_fused, 6), "unfused_s": round(t_unf, 6),
+            "speedup": round(t_unf / max(t_fused, 1e-12), 3)}
 
 
 def bench_flagship(n: int = 128, tolerance: str = "1e-8", reps: int = 3,
@@ -376,6 +524,15 @@ def bench_flagship(n: int = 128, tolerance: str = "1e-8", reps: int = 3,
         res = slv2.solve(b)
         times.append(time.perf_counter() - t0)
     solve_s = sorted(times)[len(times) // 2]
+    # solve-phase attribution (the solve-side mirror of the setup
+    # breakdown): per-level cycle pair timings + per-cycle kernel
+    # counts + fused-vs-unfused cycle wall clock on the same hierarchy
+    try:
+        cyc_attr = cycle_attribution(slv2, b, reps=max(reps, 5))
+        cyc_speed = cycle_fused_speedup(slv2, b, reps=max(reps, 5))
+    except Exception as e:  # pragma: no cover - bench robustness
+        cyc_attr = {"error": str(e)[:200]}
+        cyc_speed = None
     rel = float(
         np.linalg.norm(np.asarray(amgx.ops.residual(A, res.x, b)))
         / np.linalg.norm(np.asarray(b)))
@@ -391,6 +548,8 @@ def bench_flagship(n: int = 128, tolerance: str = "1e-8", reps: int = 3,
         "iters": int(res.iterations),
         "converged": bool(res.converged),
         "rel": rel,
+        "cycle_breakdown": cyc_attr,
+        "cycle_speedup": cyc_speed,
     }
 
 
@@ -690,6 +849,10 @@ def main():
             if "fused_speedup" in fl_row:
                 extra["fused_smooth_residual_speedup"] = \
                     fl_row["fused_speedup"]
+            cy_row = extra["spmv_layouts_128^3"].get(
+                "geo_cycle_64^3", {})
+            if "speedup" in cy_row:
+                extra["fused_cycle_speedup_64^3"] = cy_row["speedup"]
         finally:
             signal.alarm(0)
             signal.signal(signal.SIGALRM, old)
@@ -756,6 +919,13 @@ def main():
             "flagship_128^3_outer_iters": fl["iters"],
             "flagship_128^3_converged": fl["converged"],
             "flagship_128^3_true_rel_residual": fl["rel"],
+            # solve-phase attribution: per-level cycle breakdown +
+            # per-cycle kernel counts (nested -> artifact only) and the
+            # fused-vs-unfused cycle speedup scalar (compact line too)
+            "flagship_128^3_cycle_breakdown": fl["cycle_breakdown"],
+            "flagship_128^3_cycle_speedup": fl["cycle_speedup"],
+            "flagship_128^3_cycle_fused_speedup":
+                (fl["cycle_speedup"] or {}).get("speedup"),
             "flagship_config":
                 "REFINEMENT[f64] -> FGMRES+GEO-AggAMG[f32]+Cheb2",
         })
@@ -804,6 +974,11 @@ def main():
                     "northstar_256^3_outer_iters": ns["iters"],
                     "northstar_256^3_converged": ns["converged"],
                     "northstar_256^3_true_rel_residual": ns["rel"],
+                    "northstar_256^3_cycle_breakdown":
+                        ns["cycle_breakdown"],
+                    "northstar_256^3_cycle_speedup": ns["cycle_speedup"],
+                    "northstar_256^3_cycle_fused_speedup":
+                        (ns["cycle_speedup"] or {}).get("speedup"),
                 })
             finally:
                 signal.alarm(0)

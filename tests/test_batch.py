@@ -133,6 +133,8 @@ def test_solver_solve_many_method(poisson16):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow     # 32^3 scale acceptance: the 16^3 multi-matrix
+# parity + cache tests cover the semantics in the tier-1 budget
 def test_batched_32cubed_bucket_single_trace():
     """ISSUE acceptance: solve_many over N=8 stacked 32^3 Poisson
     systems (shared pattern, perturbed values) matches sequential solves

@@ -97,6 +97,8 @@ class TestUploadDistributed:
         r = b - np.asarray(amgx.ops.spmv(A, jnp.asarray(x)))
         assert np.linalg.norm(r) / np.linalg.norm(b) < 1e-7
 
+    @pytest.mark.slow     # heaviest upload variant; the other
+    # distributed-upload tests keep the family in tier-1
     def test_upload_all_global_partition_vector(self, system):
         """Non-contiguous partition vector: rows renumbered to
         contiguous blocks (renumberMatrixOneRing analog), solve matches

@@ -161,6 +161,8 @@ class TestClassicalSolve:
                 float(np.max(res.norm0))) ** (1 / max(res.iterations, 1))
         assert rate < 0.45, f"V-cycle rate {rate}"
 
+    @pytest.mark.slow     # 3D classical-from-config smoke; the 2D
+    # gmres reference-config test below keeps the family in tier-1
     def test_pcg_classical_config_file(self):
         A = gallery.poisson("7pt", 16, 16, 16).init()
         b = jnp.ones(A.num_rows)

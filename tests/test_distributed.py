@@ -172,6 +172,8 @@ class TestDistSolve:
         r = np.asarray(A.init().to_dense()) @ np.asarray(res.x) - b
         assert np.linalg.norm(r) < 1e-8
 
+    @pytest.mark.slow     # heaviest DistSolve member; the other
+    # admitted-preconditioner tests keep the family in tier-1
     def test_strong_precond_admitted_data_driven(self, mesh):
         """The preconditioner envelope is data-driven: MULTICOLOR_ILU is
         admitted when its solve-data partitions row-wise (construction
@@ -283,7 +285,10 @@ def test_distributed_amg_kcycle_small(mesh):
 
 
 @pytest.mark.parametrize("extra,expect_boundary", [
-    ("", False),
+    # the consolidation-OFF baseline is the heavy redundant
+    # parametrization (plain distributed AMG is covered broadly
+    # elsewhere); the flag=1 boundary case stays in tier-1
+    pytest.param("", False, marks=pytest.mark.slow),
     (", amg:amg_consolidation_flag=1,"
      " amg:matrix_consolidation_lower_threshold=40", True),
 ])

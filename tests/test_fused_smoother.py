@@ -221,12 +221,17 @@ _CYCLE_CFG = (
 
 def _cycle_pallas_counts(extra_cfg=""):
     """Trace one V-cycle with the Pallas gates forced on; return
-    (n_levels, fused_calls, plain_spmv_calls) from the jaxpr."""
+    (n_levels, fused_calls, plain_spmv_calls) from the jaxpr. Pinned
+    to cycle_fusion=0: this file proves the PR-4 smoother+residual
+    composition (which the cycle_fusion knob's escape hatch must keep
+    reproducing); the fused grid-transfer / coarse-tail shapes are
+    proven by tests/test_cycle_fusion.py."""
     A = gallery.poisson("7pt", 16, 16, 16, dtype=jnp.float32).init()
     b = jnp.ones(A.num_rows, jnp.float32)
     with ps.force_pallas_interpret():
         slv = amgx.create_solver(
-            Config.from_string(_CYCLE_CFG + extra_cfg))
+            Config.from_string(_CYCLE_CFG + ", amg:cycle_fusion=0"
+                               + extra_cfg))
         slv.setup(A)
         pc = slv.preconditioner
         d = pc.solve_data()

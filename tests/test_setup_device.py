@@ -141,6 +141,8 @@ class TestBackendDispatch:
         assert amg._setup_backend_used == "auto"
         assert all(lv.built_backend == "host" for lv in amg.levels)
 
+    @pytest.mark.slow     # forced-device dispatch is also proven by
+    # TestClassicalParity (built_backend asserts); eager-bound on CPU
     def test_device_forces_jnp_impls(self):
         A = gallery.poisson("5pt", 16, 16).init()
         amg = _amg("setup_backend=device", A)
