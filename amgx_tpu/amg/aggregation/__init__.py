@@ -147,6 +147,16 @@ class AggregationAMGLevel(AMGLevel):
         self._xfer_memo = (slabs,)
         return slabs
 
+    def supports_fusion(self, data):
+        """Single-device aggregation levels advertise the fused
+        grid-transfer kernels; distributed level-data (explicit sharded
+        R/P) declines — the cycle's plain compose already runs the
+        halo-folded per-shard smoother kernel through the smoother's
+        own dispatch (ops/smooth.fused_smooth)."""
+        if "R" in data or "P" in data:
+            return ()
+        return self.FUSION_CAPS if self.smoother is not None else ()
+
     def restrict_fused(self, data, b, x, sweeps: int):
         """Presmooth + restriction in one kernel (ops/smooth.py), or
         None (distributed levels with explicit R, unsupported layouts,

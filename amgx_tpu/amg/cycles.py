@@ -33,14 +33,37 @@ def _smooth_residual(level, data, b, x, sweeps: int):
     return level.smoother.smooth_residual(data["smoother"], b, x, sweeps)
 
 
+def _fusion_caps(level, data):
+    """Fusion capabilities a level ADVERTISES for its solve-data — the
+    single gate the cycle consults before invoking any fused hook
+    (`restrict_fused` / `prolongate_smooth`). Levels declare support
+    via `supports_fusion(data)` returning a capability collection
+    ("restrict", "prolongate"). Resolved through the CLASS (MRO), not
+    instance getattr: a `__getattr__`-delegating wrapper must define
+    `supports_fusion` (and the hooks) EXPLICITLY to advertise anything
+    — its inner level answering through delegation would claim the
+    WRONG transfer space (the level's shard-local R/P instead of the
+    wrapper's gather/compact). A class that defines neither advertises
+    nothing and is never called, so new hooks cannot re-introduce the
+    AttributeError-on-distributed-levels class of bug PR 5 fixed."""
+    fn = getattr(type(level), "supports_fusion", None)
+    if fn is None:
+        return ()
+    return fn(level, data)
+
+
 def _smooth_restrict(amg, level, data, b, x, sweeps: int):
     """Presmooth + restriction: with cycle_fusion, aggregation/DIA
     levels emit the segment-summed coarse rhs from the presmoother
     kernel's epilogue (ops/smooth.py) — the residual never round-trips
-    HBM and `level.restrict` disappears from the trace. Everything
-    else (classical levels, cycle_fusion=0, unsupported layouts)
-    composes exactly the prior smooth_residual -> restrict pair."""
-    if amg.cycle_fusion and sweeps > 0:
+    HBM and `level.restrict` disappears from the trace — and
+    distributed DIA levels run the halo-folded per-shard kernel
+    (distributed/fused.py) before their explicit sharded restriction.
+    Everything else (classical levels, cycle_fusion=0, unsupported
+    layouts) composes exactly the prior smooth_residual -> restrict
+    pair."""
+    if amg.cycle_fusion and sweeps > 0 and \
+            "restrict" in _fusion_caps(level, data):
         out = level.restrict_fused(data, b, x, sweeps)
         if out is not None:
             return out
@@ -54,7 +77,8 @@ def _prolongate_smooth(amg, level, data, b, x, xc, sweeps: int):
     kernel's first application (ops/smooth.py), removing the
     correction add's full-vector pass. Falls back to the prior
     x + prolongate -> smooth compose bit-for-bit."""
-    if amg.cycle_fusion and sweeps > 0:
+    if amg.cycle_fusion and sweeps > 0 and \
+            "prolongate" in _fusion_caps(level, data):
         out = level.prolongate_smooth(data, b, x, xc, sweeps)
         if out is not None:
             return out

@@ -78,14 +78,33 @@ class AMGLevel:
         raise NotImplementedError
 
     # -- cycle fusion hooks (amg/cycles.py) ------------------------------
-    # Aggregation levels override these with the fused grid-transfer
-    # kernels (presmooth+restrict in one pallas_call, prolongate+
-    # correction folded into the postsmoother's first application);
-    # classical/energymin levels keep the unfused compose by returning
-    # None here.
+    # The cycle NEVER calls restrict_fused / prolongate_smooth blindly:
+    # it first consults `supports_fusion(data)` (cycles._fusion_caps,
+    # resolved through the CLASS so `__getattr__`-delegating wrappers
+    # advertise nothing unless they define the surface explicitly) and
+    # invokes a hook only when its capability is advertised — a level
+    # class that does not implement a future hook is simply skipped
+    # instead of raising. Aggregation levels override the hooks with
+    # the fused grid-transfer kernels (presmooth+restrict in one
+    # pallas_call, prolongate+correction folded into the postsmoother's
+    # first application). Distributed levels advertise NOTHING here on
+    # purpose: their fusion — the halo-folded per-shard smoother
+    # kernel (distributed/fused.py) — rides inside the smoother's own
+    # smooth/smooth_residual dispatch (ops/smooth.fused_smooth sees the
+    # "dist_fused" payload), so the plain compose the cycle falls back
+    # to IS the fused distributed path; transfer-space-changing
+    # wrappers (consolidation) need no overrides at all.
+    FUSION_CAPS = frozenset({"restrict", "prolongate"})
+
+    def supports_fusion(self, data):
+        """Capabilities of the fused cycle hooks for this level's
+        solve-data: a collection drawn from {"restrict", "prolongate"}
+        (empty = always compose unfused)."""
+        return ()
+
     def restrict_fused(self, data, b, x, sweeps: int):
-        """(x', bc) with the restriction fused into the presmoother's
-        kernel epilogue, or None when unsupported."""
+        """(x', bc) with the presmooth+residual fused into one kernel,
+        or None when unsupported."""
         return None
 
     def prolongate_smooth(self, data, b, x, xc, sweeps: int):

@@ -329,6 +329,18 @@ def _register_default_parameters():
       "into the fused coarse-tail kernel (the dispatch-latency-bound "
       "tiny-level region; levels above it keep per-level kernels)",
       65536, None, 0)
+    R("dist_cycle_fusion", int, "bring the fused smoother kernels under "
+      "shard_map on distributed DIA levels (distributed/fused.py): "
+      "per-shard quota slabs with the neighbor shards' halo rows folded "
+      "in, ONE packed edge-window exchange per fused smoother call "
+      "(overlapped with the interior kernel, which has no data "
+      "dependence on the collective), and exact XLA boundary-strip "
+      "completion; 0 builds no halo-folded payloads and restores the "
+      "per-sweep halo-exchange composition bit-for-bit; 2 also attaches "
+      "them OFF the fused Pallas runtime (the pure-XLA window-sweep "
+      "route — still one collective per fused call; the CPU bench-mesh "
+      "opt-in, default-1 rigs without the kernels change nothing)",
+      1, (0, 1, 2))
     # resilience subsystem (amgx_tpu/resilience/)
     R("health_guards", int, "in-trace NaN/breakdown guards in the solve "
       "loop (status classification rides the existing residual check; "

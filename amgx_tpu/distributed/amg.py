@@ -145,6 +145,12 @@ def _shard_smoother_data(sm, A_sh: ShardMatrix, n_ranks: int, axis: str):
             # (solver.py chain_data) — every chain member is admitted
             # and sharded individually
             continue
+        if k == "fused":
+            # the SINGLE-CHIP quota-padded operand slabs (ops/smooth.py
+            # solver_fused_slabs) are global-layout; the sharded fused
+            # path carries its own halo-folded per-shard form instead
+            # ("dist_fused", attach_shard_fused below the caller)
+            continue
         if isinstance(v, CsrMatrix):
             out[k] = _shard(v, n_ranks, axis)
             continue
@@ -193,6 +199,16 @@ class _ConsolidationBoundaryLevel:
         xc_local = keep_local_slice(xc, self._axis, self._n_ranks,
                                     self._nc_local, self._nc_global)
         return self._level.prolongate(data, xc_local)
+
+    # Cycle-fusion hooks: none, and the wrapped level's must never be
+    # reached through __getattr__ delegation — they would
+    # restrict/prolongate in ITS (shard-local) space, skipping this
+    # wrapper's gather into the replicated-tail numbering. The cycle's
+    # class-resolved capability check (amg/cycles.py _fusion_caps)
+    # guarantees that: no class-level surface here means the plain
+    # compose runs, with the smoother's "dist_fused" payload fusing
+    # the sweeps and the gathered tail levels downstream qualifying
+    # for the single-chip VMEM coarse-tail megakernel unchanged.
 
 
 class DistributedCoarseSolver:
@@ -266,6 +282,14 @@ def shard_amg(amg, n_ranks: int, axis: str):
         if lvl.smoother is not None:
             ld["smoother"] = _shard_smoother_data(lvl.smoother, A_sh,
                                                   n_ranks, axis)
+            # halo-folded fused-smoother payload (distributed/fused.py):
+            # sharded DIA levels run all sweeps + the cycle residual in
+            # ONE per-shard kernel with one edge-window exchange;
+            # dist_cycle_fusion=0 (or an ineligible layout/smoother)
+            # attaches nothing and changes nothing
+            from .fused import attach_shard_fused
+            attach_shard_fused(ld["smoother"], lvl.A, lvl.smoother,
+                               n_ranks, A_sh.n_local, amg.cfg, amg.scope)
         levels_data.append(ld)
     # vectors in the sharded cycle are scalar-expanded: size counts are
     # in scalar unknowns (block rows never split across shards, so the

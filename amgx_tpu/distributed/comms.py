@@ -36,3 +36,17 @@ def collective_axis(name: Optional[str]):
 
 def active_axis() -> Optional[str]:
     return _ACTIVE_AXIS
+
+
+def edge_permutes(n_ranks: int):
+    """(forward, backward) ppermute pair lists for nearest-neighbor
+    edge exchange along a 1-D mesh axis: forward ships rank i's buffer
+    to rank i+1, backward ships rank i+1's buffer to rank i. Ranks with
+    no source (rank 0 forward, last rank backward) receive zeros from
+    `lax.ppermute` — exactly the DIA zero-padding semantics at the
+    global matrix edges. The single implementation shared by the ring
+    halo exchange (dist_matrix.py) and the fused-path edge-window
+    exchange (fused.py)."""
+    fwd = [(i, i + 1) for i in range(n_ranks - 1)]
+    bwd = [(i + 1, i) for i in range(n_ranks - 1)]
+    return fwd, bwd

@@ -122,12 +122,11 @@ class ShardMatrix:
             return jnp.zeros((self.n_halo,), x.dtype)
         ax = self.axis_name
         if self.exchange_mode == "ring":
+            from . import comms as _comms
             xp = jnp.concatenate([x, jnp.zeros((1,), x.dtype)])  # pad slot
             buf_next = xp[self.send_next]       # cols for rank+1
             buf_prev = xp[self.send_prev]       # cols for rank-1
-            n = self.n_ranks
-            fwd = [(i, i + 1) for i in range(n - 1)]
-            bwd = [(i + 1, i) for i in range(n - 1)]
+            fwd, bwd = _comms.edge_permutes(self.n_ranks)
             from_prev = jax.lax.ppermute(buf_next, ax, fwd)
             from_next = jax.lax.ppermute(buf_prev, ax, bwd)
             halo = jnp.zeros((self.n_halo + 1,), x.dtype)

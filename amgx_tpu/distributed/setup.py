@@ -713,13 +713,18 @@ class DistAMGLevel:
         from ..ops.spmv import spmv
         return spmv(data["P"], xc)
 
-    # cycle-fusion hooks (amg/cycles.py): sharded levels decline — the
-    # fused transfer kernels assume single-device aggregation layouts
-    def restrict_fused(self, data, b, x, sweeps: int):
-        return None
-
-    def prolongate_smooth(self, data, b, x, xc, sweeps: int):
-        return None
+    # Cycle-fusion hooks: none needed. The cycle consults
+    # `supports_fusion` through the CLASS (amg/cycles.py _fusion_caps)
+    # and this class defines no capability surface, so the plain
+    # smooth_residual -> restrict / prolongate -> smooth compose runs —
+    # which IS the fused distributed path: the halo-folded per-shard
+    # kernel (distributed/fused.py, attached as the smoother's
+    # "dist_fused" payload) dispatches inside smooth/smooth_residual
+    # (ops/smooth.fused_smooth), and the sharded R/P's owned-aggregate
+    # segment sums are shard-local by construction of the partition
+    # (remote members arrive through R's own halo map). The PR-5
+    # AttributeError class of bug is structurally impossible: an
+    # unimplemented hook is never invoked.
 
 
 class ShardedConsolidationLevel:
@@ -760,6 +765,17 @@ class ShardedConsolidationLevel:
         xc_local = jnp.where(
             k < cnt, xp[jnp.clip(lo + k, 0, self._nc_g)], 0.0)
         return self._level.prolongate(data, xc_local)
+
+    # Cycle-fusion hooks: none — and none may be ADDED via __getattr__
+    # delegation: the wrapped level's hooks would finish with ITS
+    # transfers (the shard-local R/P), skipping this wrapper's
+    # gather/compact into the replicated tail's numbering. The cycle's
+    # class-resolved capability check (amg/cycles.py _fusion_caps)
+    # guarantees the delegation is never consulted; the plain compose
+    # runs, the smoother's "dist_fused" dispatch fuses the sweeps, and
+    # the replicated tail levels below the boundary feed the
+    # single-chip VMEM coarse-tail megakernel
+    # (ops/smooth.coarse_tail_cycle) unchanged.
 
 
 def _mk_shard(fields: dict, n_global: int, n_local: int,
@@ -1128,13 +1144,18 @@ def _smoother_assignment(amg):
     return assign
 
 
-def build_sharded_hierarchy(amg, shard_A: ShardMatrix, mesh, axis: str):
+def build_sharded_hierarchy(amg, shard_A: ShardMatrix, mesh, axis: str,
+                            global_A=None):
     """Build the distributed AMG hierarchy per-shard (no global level is
     ever materialized above the consolidation boundary). Mutates `amg`
     (levels, coarse solver) and returns the stacked solve-data pytree
     {"levels": [...], "coarse": ...}, or None when the problem is too
     small for even one sharded level (caller falls back to the global
-    setup path)."""
+    setup path). `global_A`, when the caller holds it (the
+    non-pieces upload path), enables the halo-folded fused-smoother
+    payload on the finest level (distributed/fused.py) — its DIA slabs
+    are the only global view this build ever touches, and coarse
+    levels stay strictly per-shard."""
     from ..solvers.base import make_solver
     from .amg import _replicate
     cfg, scope = amg.cfg, amg.scope
@@ -1167,7 +1188,7 @@ def build_sharded_hierarchy(amg, shard_A: ShardMatrix, mesh, axis: str):
          ncl_last) = res
         return _finish_sharded(amg, mesh, axis, M, offsets, lvl,
                                levels, levels_data, offsets_last,
-                               ncl_last, R)
+                               ncl_last, R, global_A=global_A)
     sel = str(cfg.get("selector", scope)).upper()
     passes = _SHARDED_SELECTORS.get(sel, 1)
     if sel == "MULTI_PAIRWISE":
@@ -1345,11 +1366,13 @@ def build_sharded_hierarchy(amg, shard_A: ShardMatrix, mesh, axis: str):
     if not levels:
         return None
     return _finish_sharded(amg, mesh, axis, M, offsets, lvl, levels,
-                           levels_data, offsets_last, ncl_last, R)
+                           levels_data, offsets_last, ncl_last, R,
+                           global_A=global_A)
 
 
 def _finish_sharded(amg, mesh, axis, M, offsets, lvl, levels,
-                    levels_data, offsets_last, ncl_last, R):
+                    levels_data, offsets_last, ncl_last, R,
+                    global_A=None):
     """Shared tail of the sharded build (aggregation and classical):
     gather + compact the consolidation-boundary level, build the
     replicated tail with the existing global setup, attach smoothers."""
@@ -1379,6 +1402,29 @@ def _finish_sharded(amg, mesh, axis, M, offsets, lvl, levels,
         levels_data[k]["smoother"] = _smoother_data(
             name.upper(), levels_data[k]["A"], lv.smoother,
             mesh=mesh, axis=axis, offsets=lv.offsets)
+    # halo-folded fused payload for the FINEST level (its global DIA
+    # operator is the caller's upload; coarse levels are COO-built
+    # per-shard with no DIA view and keep the unfused path)
+    if global_A is not None and levels:
+        from .fused import attach_shard_fused, fusion_gates
+        # cheap gates FIRST: the dinv materialization below is a full
+        # device->host pull, wasted on every knob=0 / unfused-runtime
+        # setup if done unconditionally
+        if fusion_gates(cfg, scope, levels[0].smoother):
+            smd0 = levels_data[0]["smoother"]
+            dinv_src = smd0.get("dinv")
+            dinv_g = None
+            if dinv_src is not None:
+                # thunk + dinv_key: the flatten is a full device->host
+                # pull, deferred past the memo check (keyed on the
+                # stacked source array's identity — a slice would be a
+                # fresh object every setup) so repeated setups on the
+                # same values transfer nothing
+                dinv_g = lambda: np.asarray(dinv_src).reshape(-1)[
+                    : global_A.num_rows]
+            attach_shard_fused(smd0, global_A, levels[0].smoother, R,
+                               levels_data[0]["A"].n_local, cfg, scope,
+                               dinv_global=dinv_g, dinv_key=dinv_src)
     tail_data = []
     for k in range(boundary, len(amg.levels)):
         lv = amg.levels[k]

@@ -122,7 +122,7 @@ class DistributedSolver:
                 self._precond_shard_data[id(s)] = _shard_smoother_data(
                     s, self.shard_A, self.n_ranks, self.axis)
             if s.name == "AMG":
-                data = self._try_sharded_setup(s)
+                data = self._try_sharded_setup(s, A)
                 if data is not None:
                     self._sharded_amg[id(s)] = data
                 elif A is not None:
@@ -140,10 +140,12 @@ class DistributedSolver:
         self.setup_time = time.perf_counter() - t0
         return self
 
-    def _try_sharded_setup(self, s):
+    def _try_sharded_setup(self, s, global_A=None):
         """Run the per-shard hierarchy build when the config supports it
         (distributed_setup_mode=auto|sharded). Returns the stacked AMG
-        solve-data, or None to fall back to the global-setup path."""
+        solve-data, or None to fall back to the global-setup path.
+        `global_A` (absent on the pieces path) only feeds the finest
+        level's halo-folded fused-smoother payload."""
         from .setup import build_sharded_hierarchy, sharded_eligible
         mode = str(self.cfg.get("distributed_setup_mode", s.amg.scope))
         if mode == "global":
@@ -180,7 +182,7 @@ class DistributedSolver:
                     f"distributed_setup_mode=sharded: {reason}")
             return None
         data = build_sharded_hierarchy(s.amg, self.shard_A, self.mesh,
-                                       self.axis)
+                                       self.axis, global_A=global_A)
         if data is None and mode == "sharded":
             raise BadParametersError(
                 "distributed_setup_mode=sharded: problem too small for "

@@ -92,6 +92,65 @@ def smooth_dia_multi(A: CsrMatrix, B: jax.Array, X: jax.Array, taus,
     return X
 
 
+def affine_window_sweeps(offsets, vals_w, b_w, x_w, taus, dinv_w,
+                         W: int, with_residual: bool):
+    """Damped-relaxation sweeps on a contiguous 1-D element window —
+    the XLA mirror, in ELEMENT units, of the fused Pallas kernel's
+    temporal blocking (ops/pallas_spmv.py `_dia_smooth_kernel`).
+
+    Computes x' (and r when `with_residual`) EXACTLY for the W target
+    elements [t0, t0 + W) of a DIA operator, given windows wide enough
+    for the full dependence cone (m = max(0, -min(offsets)),
+    M = max(0, max(offsets)), n_app = len(taus) + residual):
+
+      x_w    (Wx,)   covering [t0 - n_app*m,       t0 + W + n_app*M)
+      vals_w (k, Wv), b_w / dinv_w (Wv,)
+                     covering [t0 - (n_app-1)*m,   t0 + W + (n_app-1)*M)
+
+    Out-of-range window elements must be ZERO-filled (the DIA
+    zero-padding semantics — a matrix edge and a zero-filled window
+    edge are indistinguishable). Each sweep recomputes the Wv interior
+    and zero-fills the shrinking cone edges, exactly like the kernel,
+    so the W target elements come out bit-exact in exact arithmetic.
+
+    This is the distributed fused path's workhorse (boundary-strip
+    completion next to the per-shard kernel, and the whole-shard f64 /
+    non-Pallas route — distributed/fused.py) and the parity reference
+    the kernel tests compare against."""
+    n_steps = int(taus.shape[0])
+    n_app = n_steps + (1 if with_residual else 0)
+    m = max(0, -min(offsets))
+    M = max(0, max(offsets))
+    Wv = W + (n_app - 1) * (m + M)
+    dt = x_w.dtype
+
+    def apply_a(s):
+        acc = jnp.zeros((Wv,), dt)
+        for i, d in enumerate(offsets):
+            acc = acc + vals_w[i] * jax.lax.slice_in_dim(
+                s, m + d, m + d + Wv, 1, 0)
+        return acc
+
+    s = x_w
+    for t in range(n_steps):
+        corr = taus[t] * (b_w - apply_a(s))
+        if dinv_w is not None:
+            corr = corr * dinv_w
+        mid = jax.lax.slice_in_dim(s, m, m + Wv, 1, 0) + corr
+        pieces = [mid]
+        if m:
+            pieces.insert(0, jnp.zeros((m,), dt))
+        if M:
+            pieces.append(jnp.zeros((M,), dt))
+        s = jnp.concatenate(pieces) if len(pieces) > 1 else mid
+    y = jax.lax.slice_in_dim(s, n_app * m, n_app * m + W, 1, 0)
+    if not with_residual:
+        return y
+    r = b_w - apply_a(s)
+    return y, jax.lax.slice_in_dim(r, (n_app - 1) * m,
+                                   (n_app - 1) * m + W, 1, 0)
+
+
 # ---------------------------------------------------------------------------
 # cycle fusion slab forms (the custom_vmap fallbacks of the fused
 # grid-transfer / coarse-tail kernels in ops/smooth.py — and the f64

@@ -330,10 +330,20 @@ def fused_smooth(data, b, x, taus, dinv=None, with_residual=True):
     """Try every fused route for the smoother data pytree: DIA first
     (full fusion), then SWELL (epilogue fusion). Returns x' (, r) or
     None — callers keep their unfused compose as the fallback, so a
-    missing layout/backend/dtype changes nothing."""
+    missing layout/backend/dtype changes nothing.
+
+    Distributed (ShardMatrix) levels route through the halo-folded
+    per-shard form when the setup attached a "dist_fused" payload
+    (distributed/fused.py): one edge-window exchange + one fused kernel
+    per shard instead of a full halo exchange per sweep."""
     A = data["A"]
     from ..matrix import CsrMatrix
     if not isinstance(A, CsrMatrix) or A.is_block:
+        fd = data.get("dist_fused")
+        if fd is not None:
+            from ..distributed.fused import dist_fused_smooth
+            return dist_fused_smooth(fd, b, x, jnp.asarray(taus, x.dtype),
+                                     dinv, with_residual)
         return None
     taus = jnp.asarray(taus, x.dtype)
     out = dia_fused_smooth(A, data.get("fused"), b, x, taus, dinv,
