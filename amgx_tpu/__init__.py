@@ -68,6 +68,14 @@ def create_solver(cfg: Config, scope: str = "default"):
     initialize()
     from .solvers.base import make_solver
     name, child_scope = cfg.get_solver("solver", scope)
+    # span fencing is a process-wide mode: the most recently
+    # constructed root solver's telemetry_sync setting wins, in BOTH
+    # directions (a debug solver must not leave fencing stuck on for
+    # later production solvers in the same process). The env toggle
+    # ORs in so AMGX_TPU_TELEMETRY_SYNC=1 survives config defaults.
+    from .telemetry import spans as _spans
+    _spans.set_sync(bool(int(cfg.get("telemetry_sync", child_scope)))
+                    or _spans.env_sync())
     slv = make_solver(name, cfg, child_scope)
     if str(cfg.get("fallback_policy", child_scope)).strip():
         from .resilience.policy import ResilientSolver

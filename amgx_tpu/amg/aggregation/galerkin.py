@@ -382,12 +382,15 @@ _GEO_STRUCT_DEV_MAX_BYTES = 2 << 30
 
 def _geo_csr_structure_device(coffsets, coarse_shape):
     import jax as _jax
+    from ...telemetry import metrics as _tm
     dev = _jax.config.jax_default_device or _jax.devices()[0]
     key = (coffsets, coarse_shape, dev)
     hit = _GEO_STRUCT_DEV.get(key)
     if hit is not None:
         _GEO_STRUCT_DEV[key] = _GEO_STRUCT_DEV.pop(key)   # LRU bump
+        _tm.inc("amg.geo_struct_cache.hit")
         return hit
+    _tm.inc("amg.geo_struct_cache.miss")
     out = tuple(jnp.asarray(a) for a in _geo_csr_structure(
         coffsets, coarse_shape))
     _GEO_STRUCT_DEV[key] = out

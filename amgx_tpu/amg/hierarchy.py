@@ -196,6 +196,8 @@ class AMG:
 
     def setup(self, A: CsrMatrix):
         import jax
+        from ..telemetry import metrics as _tm
+        _tm.inc("amg.setup.full")
         t0 = time.perf_counter()
         self.levels = []
         self._data_cache = None
@@ -204,6 +206,8 @@ class AMG:
         self._resetup_precast = None
         self._vr_plan = None     # value-resetup plan re-derives lazily
         self._last_resetup_value_only = False
+        self._tail_entry_level = None   # re-recorded at cycle trace time
+        self._telemetry_level_cache = None
         host = self._host_setup_device(A)
         if host is not None:
             self._setup_backend_used = "host"
@@ -378,6 +382,7 @@ class AMG:
                 A.num_rows != self.levels[0].A.num_rows:
             return self.setup(A)
         self._last_resetup_value_only = False
+        from ..telemetry import metrics as _tm
         if (reuse < 0 or reuse >= len(self.levels)) \
                 and self._ship_device is None:
             from .value_resetup import try_value_resetup
@@ -385,7 +390,15 @@ class AMG:
             with trace_region("amg.value_resetup"):
                 if try_value_resetup(self, A):
                     self._last_resetup_value_only = True
+                    _tm.inc("amg.resetup.value")
                     return self
+        _tm.inc("amg.resetup.structure")
+        # a structure resetup rebuilds levels and retraces the cycle:
+        # the recorded tail boundary and the memoized report level
+        # table are for the OLD hierarchy (the value-only path above
+        # keeps both valid — structure and traces survive)
+        self._tail_entry_level = None
+        self._telemetry_level_cache = None
         self._data_cache = None
         if self._ship_device is not None:
             host = jax.devices("cpu")[0]

@@ -73,6 +73,9 @@ class BatchedSolveResult:
     # one system's NaN storm or breakdown is distinguishable from a
     # neighbor's honest max-iters exit
     status: Optional[np.ndarray] = None
+    # per-system structured reports (telemetry/report.py SolveReport),
+    # built when the wrapped solver's `telemetry` knob is on
+    reports: Optional[List[Any]] = None
 
     @property
     def batch_size(self) -> int:
@@ -97,7 +100,9 @@ class BatchedSolveResult:
                 res_history=hist, setup_time=self.setup_time,
                 solve_time=self.solve_time,
                 status_code=int(self.status[i])
-                if self.status is not None else 1))
+                if self.status is not None else 1,
+                report=self.reports[i]
+                if self.reports is not None else None))
         return out
 
 
@@ -317,6 +322,8 @@ class BatchedSolver:
         from ..resilience import faultinject as _fi
         key = (B.shape, str(B.dtype), axes_sig, _fi.epoch())
         if key not in self._jit_cache:
+            from ..telemetry import metrics as _tm
+            _tm.inc("solver.retrace.solve_batched")
             _fi.evict_stale_epochs(self._jit_cache, key[-1])
             self._jit_cache[key] = self._build_batched_fn(data_axes)
         t0 = time.perf_counter()
@@ -339,10 +346,25 @@ class BatchedSolver:
             pad = np.full((hist_len,) + h.shape[1:], np.nan, h.dtype)
             pad[: h.shape[0]] = h
             hists.append(pad)
-        return BatchedSolveResult(
+        out = BatchedSolveResult(
             x=X, iterations=iters, converged=conv,
             res_norm=np.asarray(res_norm), norm0=np.asarray(norm0),
             res_history=np.asarray(hists)
             if slv.store_res_history else None,
             setup_time=self.setup_time, solve_time=solve_time,
             status=status)
+        if getattr(slv, "telemetry", False):
+            # per-system structured reports from the already-unpacked
+            # numpy stats (telemetry/report.py: zero added transfers,
+            # no per-system x slicing); each system's history is
+            # trimmed to its own stop iteration
+            from ..telemetry import build_report
+            out.reports = [
+                build_report(slv, SolveResult(
+                    x=None, iterations=int(iters[i]),
+                    converged=bool(conv[i]), res_norm=res_norm[i],
+                    norm0=norm0[i], setup_time=self.setup_time,
+                    solve_time=solve_time, status_code=int(status[i])),
+                    hist=hists[i][: iters[i] + 1])
+                for i in range(nb)]
+        return out

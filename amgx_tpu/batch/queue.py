@@ -140,6 +140,8 @@ class RequestBatcher:
                            x0=None if x0 is None else np.asarray(x0),
                            fingerprint=self._bucket_key(A, b))
         self._pending.setdefault(req.fingerprint, []).append(req)
+        from ..telemetry import metrics as _tm
+        _tm.inc("batch.requests")
         return req
 
     def pending_count(self) -> int:
@@ -188,7 +190,14 @@ class RequestBatcher:
         size = pad_to_bucket_size(len(reqs), self.batch_sizes)
         pad = size - len(reqs)
         self.dispatch_log.append((key, len(reqs), size))
+        # bucket occupancy + pad waste (telemetry/metrics.py): the
+        # serving-layer signal for whether the ladder rungs fit traffic
+        from ..telemetry import metrics as _tm
+        _tm.inc("batch.dispatches")
+        _tm.inc("batch.padded_systems", pad)
+        _tm.set_gauge("batch.bucket_occupancy", len(reqs) / size)
         solver = self._solver_for(key, reqs[0].A)
+        _tm.set_gauge("batch.live_buckets", len(self._solvers))
         matrices = [r.A for r in reqs] + [reqs[-1].A] * pad
         bs = np.stack([r.b for r in reqs] + [reqs[-1].b] * pad)
         if any(r.x0 is not None for r in reqs):

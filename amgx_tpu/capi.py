@@ -711,6 +711,51 @@ def AMGX_solver_get_batch_status(slv_h):
 
 @_api
 @_outputs(1)
+def AMGX_solver_get_report(slv_h):
+    """rc, report: the last solve's structured SolveReport as a plain
+    dict (telemetry/report.py; schema telemetry/report_schema.json) —
+    per-iteration residuals, final status, per-level kernel activity,
+    wall times. A batched solve returns a LIST of per-system report
+    dicts. Telemetry extension (no reference analog; the reference
+    exposes the same data only as printed tables). Raises
+    BAD_PARAMETERS when no solve ran or telemetry=0 disabled reports."""
+    s = _get(slv_h, _CSolver)
+    if s.result is None:
+        raise AMGXError("no solve performed", RC.BAD_PARAMETERS)
+    reports = getattr(s.result, "reports", None)      # batched result
+    if reports is not None:
+        return RC.OK, [r.to_dict() for r in reports]
+    report = getattr(s.result, "report", None)
+    if report is None:
+        raise AMGXError("no report on the last solve (telemetry=0?)",
+                        RC.BAD_PARAMETERS)
+    return RC.OK, report.to_dict()
+
+
+@_api
+@_outputs(1)
+def AMGX_read_metrics():
+    """rc, metrics: snapshot of the process-wide telemetry
+    counter/gauge registry (telemetry/metrics.py) — cache hit/miss,
+    setup-routing, batcher occupancy, fallback events, jit retraces,
+    memory watermarks. Telemetry extension (no reference analog)."""
+    from .telemetry import metrics
+    return RC.OK, metrics.snapshot()
+
+
+@_api
+def AMGX_print_timers():
+    """Print the accumulated trace-region timer table through the
+    registered print callback (src/amgx_timer.cu print-tree role;
+    profiling.format_timers)."""
+    from .output import amgx_output
+    from .profiling import format_timers
+    amgx_output(format_timers())
+    return RC.OK
+
+
+@_api
+@_outputs(1)
 def AMGX_solver_get_iterations_number(slv_h):
     s = _get(slv_h, _CSolver)
     if s.result is None:

@@ -351,6 +351,20 @@ def _register_default_parameters():
     R("stall_tolerance", float, "minimum relative residual decrease "
       "over the stall window; 0 = any non-decrease stalls", 0.0, None,
       0.0, 1.0)
+    # telemetry subsystem (amgx_tpu/telemetry/)
+    R("telemetry", int, "attach a structured SolveReport to solve "
+      "results and sample device-memory watermarks per phase "
+      "(telemetry/report.py). Host-side only: the report rides the "
+      "monitor's already-returned stats array, so the traced solve "
+      "program and its device->host transfer count are IDENTICAL "
+      "either way; 0 skips report construction", 1, BOOL01)
+    R("telemetry_sync", int, "fence device work at every span boundary "
+      "(telemetry/spans.py) so host spans bound device occupancy in "
+      "the exported Perfetto timeline. Debugging mode: it defeats the "
+      "overlapped level shipping and XLA async dispatch. Process-wide: "
+      "each create_solver/DistributedSolver construction latches the "
+      "mode from its config — in both directions, so building a "
+      "telemetry_sync=0 solver turns fencing back off", 0, BOOL01)
     R("fallback_policy", str, "resilience chains "
       "'STATUS>action[=arg]|...' (actions: retry, rescale_retry, "
       "switch_solver=<NAME>, escalate_sweeps), applied host-side by "

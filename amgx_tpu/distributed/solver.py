@@ -63,6 +63,11 @@ class DistributedSolver:
         self.axis = mesh.axis_names[0]
         self.n_ranks = mesh.devices.size
         name, sscope = cfg.get_solver("solver", scope)
+        # process-wide span-fencing mode, latched both ways like
+        # create_solver (env toggle ORed in; see telemetry/spans.py)
+        from ..telemetry import spans as _spans
+        _spans.set_sync(bool(int(cfg.get("telemetry_sync", sscope)))
+                        or _spans.env_sync())
         self.solver = make_solver(name, cfg, sscope)
         if self.solver.scaling not in ("NONE", ""):
             raise BadParametersError(
@@ -337,6 +342,8 @@ class DistributedSolver:
                 _fi.epoch():
             # the faultinject epoch invalidates the cached shard_map
             # program (same contract as the base solver's jit key)
+            from ..telemetry import metrics as _tm
+            _tm.inc("solver.retrace.distributed")
             self._fn = self._build_fn()
             self._fn_epoch = _fi.epoch()
         t0 = time.perf_counter()
@@ -344,7 +351,7 @@ class DistributedSolver:
         solve_time = time.perf_counter() - t0
         iters_i, conv, status, n0, rn, hist = self.solver.unpack_stats(
             stats, self.solver.max_iters + 1)
-        return SolveResult(
+        res = SolveResult(
             x=unpartition_vector(x, n), iterations=iters_i,
             converged=conv, res_norm=np.asarray(rn),
             norm0=np.asarray(n0),
@@ -352,6 +359,20 @@ class DistributedSolver:
             if self.solver.store_res_history else None,
             setup_time=self.setup_time, solve_time=solve_time,
             status_code=status)
+        if getattr(self.solver, "telemetry", False):
+            # controller = rank-0 analog: ONE report per solve, with
+            # the per-shard tallies (already on the controller via the
+            # partition metadata) gathered into the distributed block
+            from ..telemetry import build_report
+            res.report = build_report(
+                self.solver, res, hist=np.asarray(hist),
+                distributed={
+                    "n_ranks": int(self.n_ranks),
+                    "axis": str(self.axis),
+                    "n_global": int(n),
+                    "rows_per_shard": int(self.part.n_local),
+                })
+        return res
 
 
 def _dinv(diag):

@@ -70,6 +70,30 @@ def get_max_memory_usage() -> int:
     return sum(_peak_per_dev.values())
 
 
+def peak_bytes(devices=None) -> int:
+    """Allocator high-water mark over `devices` (all local devices by
+    default), preferring the backend's own `peak_bytes_in_use`
+    statistic — unlike the sampled peaks, it captures TRANSIENT
+    in-phase maxima (e.g. Galerkin temporaries freed before the phase
+    boundary where we sample). Falls back to the sampled current bytes
+    per device where the backend reports no peak, and folds every
+    sample into the shared per-device peaks."""
+    import jax
+    devs = devices if devices is not None else jax.local_devices()
+    total = 0
+    for d in devs:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        cur = int(stats.get("bytes_in_use", 0)) if stats else 0
+        peak = int(stats.get("peak_bytes_in_use", cur)) if stats else 0
+        key = repr(d)
+        _peak_per_dev[key] = max(_peak_per_dev.get(key, 0), peak, cur)
+        total += max(peak, cur)
+    return total
+
+
 def get_memory_usage_gb() -> float:
     import jax
     return _sample(jax.local_devices())[0] / 2**30

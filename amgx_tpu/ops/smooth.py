@@ -816,4 +816,9 @@ def coarse_tail_cycle(amg, shape: str, data, lvl: int, b, x):
     if 2 * total > _ps._SMOOTH_VMEM_BUDGET:
         return None
     spec = _ps.TailSpec(shape, tuple(specs), coarse)
+    # telemetry: remember (at trace time, zero solve-phase cost) the
+    # outermost level the VMEM tail megakernel absorbed — SolveReport's
+    # per-level activity table reads it back (telemetry/report.py)
+    prev = getattr(amg, "_tail_entry_level", None)
+    amg._tail_entry_level = lvl if prev is None else min(prev, lvl)
     return _tail_fn(spec)(tuple(arrs), b, x)

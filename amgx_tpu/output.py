@@ -25,6 +25,9 @@ def amgx_output(msg: str):
         _callback(msg, len(msg))
     else:
         sys.stdout.write(msg)
+        # flush: under redirected/block-buffered stdio a long-running
+        # solve otherwise buffers its status output indefinitely
+        sys.stdout.flush()
 
 
 def amgx_printf(*args, **kwargs):

@@ -3,12 +3,19 @@
 TPU-native analog of the reference's nvtx ranges + profiler hooks
 (src/amgx_timer.cu, include/profile.h nvtxRange, AMGX_pin_memory-era
 instrumentation): named trace regions that show up in a captured device
-profile, plus a lightweight wall-clock accumulator for setup/solve
-stage breakdowns (the reference's AMGX_timer tree).
+profile, plus wall-clock accumulation for setup/solve stage breakdowns
+(the reference's AMGX_timer tree).
+
+Since the telemetry subsystem landed, the recording engine lives in
+`telemetry/spans.py`: every region is a node in a parent/child span
+tree (exportable as Chrome/Perfetto trace-event JSON via
+`telemetry.spans.export_chrome_trace`), and this module is the stable
+thin API over it:
 
 - `trace_region(name)`: context manager annotating device work with
   `jax.profiler.TraceAnnotation` (visible in TensorBoard/Perfetto
-  traces) and accumulating host wall-clock per name.
+  traces), recording a hierarchical span, and accumulating host
+  wall-clock per name.
 - `start_trace(logdir)` / `stop_trace()`: capture a device profile for
   the enclosed region (jax.profiler wrapper; the XLA/TPU answer to
   nsight ranges).
@@ -18,35 +25,23 @@ stage breakdowns (the reference's AMGX_timer tree).
 Regions are cheap no-ops for device latency (annotation only); the
 wall-clock numbers measure host-observed span, which for async
 dispatch means "time until the region's Python body returned", not
-device occupancy — use start_trace for real device timelines.
+device occupancy — use start_trace for real device timelines, or set
+`telemetry_sync=1` to fence device work at span boundaries (debugging
+mode; it defeats the overlapped shipping/dispatch pipelining).
 """
 from __future__ import annotations
 
-import contextlib
-import threading
-import time
 from typing import Dict, Tuple
 
 import jax
 
-_lock = threading.Lock()
-_timers: Dict[str, Tuple[int, float]] = {}
+from .telemetry import spans as _spans
+
 _tracing = False
 
-
-@contextlib.contextmanager
-def trace_region(name: str):
-    """nvtxRange analog: annotate + accumulate wall-clock under `name`
-    (accounted even when the body raises)."""
-    t0 = time.perf_counter()
-    try:
-        with jax.profiler.TraceAnnotation(name):
-            yield
-    finally:
-        dt = time.perf_counter() - t0
-        with _lock:
-            calls, tot = _timers.get(name, (0, 0.0))
-            _timers[name] = (calls + 1, tot + dt)
+# the recording engine: hierarchical span + flat accumulator + optional
+# device fencing (telemetry/spans.py)
+trace_region = _spans.span
 
 
 def annotate(name: str):
@@ -77,33 +72,37 @@ def stop_trace():
 
 
 def timers() -> Dict[str, Tuple[int, float]]:
-    with _lock:
-        return dict(_timers)
+    return _spans.flat_timers()
 
 
 def reset_timers():
-    with _lock:
-        _timers.clear()
+    _spans.reset()
 
 
 def timers_total(prefix: str) -> float:
     """Total wall seconds accumulated under regions starting with
     `prefix`. The amg.* setup regions are maintained as DISJOINT leaf
-    spans (no nesting; the overlapped ship worker reports under ship.*)
-    precisely so `timers_total("amg.") / wall` is an honest accounted
-    fraction of a setup's main-thread wall time."""
-    with _lock:
-        return sum(tot for name, (_c, tot) in _timers.items()
-                   if name.startswith(prefix))
+    spans (no nesting; the overlapped ship worker reports under ship.*;
+    tools/check_spans.py lints the registry) precisely so
+    `timers_total("amg.") / wall` is an honest accounted fraction of a
+    setup's main-thread wall time."""
+    return _spans.timers_total(prefix)
 
 
 def format_timers() -> str:
-    """AMGX_timer-style report (src/amgx_timer.cu print tree role)."""
+    """AMGX_timer-style report (src/amgx_timer.cu print tree role),
+    printed through the output callback by capi.AMGX_print_timers:
+    regions sorted by total time, aligned columns, calls / mean /
+    share-of-recorded columns."""
     rows = sorted(timers().items(), key=lambda kv: -kv[1][1])
     if not rows:
         return "no trace regions recorded\n"
-    w = max(len(k) for k, _ in rows)
-    out = [f"{'region':<{w}}  calls   total_s     avg_ms"]
+    grand = sum(tot for _, (_c, tot) in rows) or 1e-30
+    w = max(len("region"), max(len(k) for k, _ in rows))
+    header = (f"{'region':<{w}}  {'calls':>6}  {'total_s':>9}  "
+              f"{'mean_ms':>9}  {'share':>6}")
+    out = [header, "-" * len(header)]
     for name, (calls, tot) in rows:
-        out.append(f"{name:<{w}}  {calls:5d}  {tot:8.3f}  {tot/calls*1e3:9.3f}")
+        out.append(f"{name:<{w}}  {calls:6d}  {tot:9.3f}  "
+                   f"{tot / calls * 1e3:9.3f}  {tot / grand:6.1%}")
     return "\n".join(out) + "\n"

@@ -166,6 +166,9 @@ class ResilientSolver:
 
     def _run_action(self, action: str, arg: str, b, x0,
                     zero_initial_guess: bool) -> SolveResult:
+        from ..telemetry import metrics as _tm
+        _tm.inc("resilience.fallback_attempts")
+        _tm.inc(f"resilience.fallback.{action}")
         if action == "retry":
             # same tree, zero guess: hierarchy and cached traces are
             # reused (the matrix is unchanged); a consumed injected

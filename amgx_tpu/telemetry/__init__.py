@@ -1,0 +1,30 @@
+"""Unified telemetry subsystem.
+
+One place every layer reports into (the reference exposes the same
+information through nvtx ranges, the AMGX_timer tree, and the verbose
+solve tables; ours is structured and machine-readable):
+
+- `telemetry.metrics` — process-wide counter/gauge registry (cache
+  hit/miss, setup-routing, batcher occupancy, fallback events, jit
+  retraces, memory watermarks); dump with `metrics.snapshot()` or the
+  C API's `AMGX_read_metrics`.
+- `telemetry.spans` — hierarchical host spans behind
+  `profiling.trace_region`, exported as Chrome/Perfetto trace-event
+  JSON (`spans.export_chrome_trace`); `telemetry_sync=1` fences device
+  work at span boundaries so host spans bound device occupancy.
+- `telemetry.report` — `SolveReport`: in-trace solve metrics (riding
+  the monitor's packed stats array at zero added device->host syncs)
+  plus static per-level kernel-activity metadata, attached to
+  `SolveResult.report` / `BatchedSolveResult.reports` / distributed
+  results and reachable from the C API (`AMGX_solver_get_report`);
+  validated against `report_schema.json`.
+
+The `telemetry` config knob (default 1) gates report construction and
+memory-watermark sampling per solver; counters and spans are always on
+(dict updates — the in-trace solve program is NEVER touched either
+way, so `telemetry=0` and `telemetry=1` compile identical XLA).
+"""
+from __future__ import annotations
+
+from . import metrics, spans  # noqa: F401
+from .report import SolveReport, build_report, validate_report  # noqa: F401

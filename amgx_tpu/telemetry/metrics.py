@@ -1,0 +1,179 @@
+"""Process-wide counter/gauge registry.
+
+The reference exposes its runtime behavior through scattered printf
+tables; a serving deployment needs the numbers a scrape endpoint or a
+periodic dump can read: cache hit rates, retrace counts, batcher
+occupancy, memory watermarks. This module is that registry — one flat,
+thread-safe, process-wide namespace of declared metrics.
+
+Design rules:
+
+- every metric is DECLARED up front (name + kind + doc) so the registry
+  doubles as the documentation of what the library measures; an
+  undeclared name raises with a did-you-mean suggestion instead of
+  silently forking a typo'd time series;
+- counters are monotonic within a process (`inc`); gauges are
+  last-value (`set_gauge`) or high-water (`max_gauge`);
+- recording is a dict update under one lock — cheap enough to stay
+  unconditional (the `telemetry` config knob gates report construction
+  and span fencing, not counter arithmetic);
+- `snapshot()` returns a plain dict (JSON-ready) of every metric that
+  has been touched, plus zeros for declared-but-untouched counters so a
+  dump always has a stable key set.
+
+Instrumented sites (see the declarations below for the full catalog):
+the GEO Galerkin structure-cache (amg/aggregation/galerkin.py), the
+setup/resetup routing (amg/hierarchy.py), the RequestBatcher
+(batch/queue.py), the fallback engine (resilience/policy.py), jit
+retraces per solver entry point (solvers/base.py, batch/core.py,
+distributed/solver.py), and device-memory watermarks per phase
+(memory_info sampled from solvers/base.py).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Union
+
+_lock = threading.Lock()
+_counters: Dict[str, int] = {}
+_gauges: Dict[str, float] = {}
+
+# name -> doc; the declaration IS the catalog
+COUNTERS: Dict[str, str] = {}
+GAUGES: Dict[str, str] = {}
+
+
+def declare_counter(name: str, doc: str):
+    COUNTERS[name] = doc
+
+
+def declare_gauge(name: str, doc: str):
+    GAUGES[name] = doc
+
+
+def _unknown(name: str, catalog: Dict[str, str], kind: str):
+    from ..errors import did_you_mean
+    raise KeyError(f"undeclared telemetry {kind} {name!r}"
+                   f"{did_you_mean(name, catalog)}")
+
+
+def inc(name: str, n: int = 1):
+    """Increment a declared counter."""
+    if name not in COUNTERS:
+        _unknown(name, COUNTERS, "counter")
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + int(n)
+
+
+def set_gauge(name: str, value: Union[int, float]):
+    """Set a declared gauge to its latest value."""
+    if name not in GAUGES:
+        _unknown(name, GAUGES, "gauge")
+    with _lock:
+        _gauges[name] = value
+
+
+def max_gauge(name: str, value: Union[int, float]):
+    """Fold a sample into a declared high-water-mark gauge."""
+    if name not in GAUGES:
+        _unknown(name, GAUGES, "gauge")
+    with _lock:
+        _gauges[name] = max(_gauges.get(name, value), value)
+
+
+def get(name: str) -> Union[int, float]:
+    """Current value (0 for a declared counter/gauge never touched)."""
+    if name in COUNTERS:
+        with _lock:
+            return _counters.get(name, 0)
+    if name in GAUGES:
+        with _lock:
+            return _gauges.get(name, 0)
+    _unknown(name, {**COUNTERS, **GAUGES}, "metric")
+
+
+def snapshot() -> Dict[str, Union[int, float]]:
+    """JSON-ready dump: every declared counter (zeros included, so the
+    key set is stable run to run) plus every gauge that has a sample."""
+    with _lock:
+        out: Dict[str, Union[int, float]] = {
+            name: _counters.get(name, 0) for name in COUNTERS}
+        out.update(_gauges)
+        return out
+
+
+def reset():
+    """Zero every counter and drop every gauge sample (declarations
+    stay — a reset registry still documents its catalog)."""
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+
+
+# ---------------------------------------------------------------------------
+# the catalog
+# ---------------------------------------------------------------------------
+
+# AMG setup routing (amg/hierarchy.py): how coefficient updates reach
+# the hierarchy — the 17.4s-vs-0.43s difference between a full setup
+# and a value-resetup is THE serving-layer routing decision to watch
+declare_counter("amg.setup.full",
+                "full hierarchy builds (structure re-coarsened)")
+declare_counter("amg.resetup.value",
+                "fused value-only resetups (structure + traces kept)")
+declare_counter("amg.resetup.structure",
+                "structure-reuse resetups (kept levels re-valued, "
+                "deeper levels rebuilt)")
+
+# GEO Galerkin CSR-structure device cache (amg/aggregation/galerkin.py):
+# a miss at 256^3 re-uploads ~1 GB of structure arrays per warm setup
+declare_counter("amg.geo_struct_cache.hit",
+                "GEO coarse CSR-structure device-cache hits")
+declare_counter("amg.geo_struct_cache.miss",
+                "GEO coarse CSR-structure device-cache misses "
+                "(host build + device upload paid)")
+
+# RequestBatcher (batch/queue.py)
+declare_counter("batch.requests", "solve requests submitted")
+declare_counter("batch.dispatches", "batched dispatches issued")
+declare_counter("batch.padded_systems",
+                "pad-waste systems dispatched (ladder rung minus real "
+                "requests, summed over dispatches)")
+declare_gauge("batch.bucket_occupancy",
+              "real/padded ratio of the last dispatch (1.0 = no waste)")
+declare_gauge("batch.live_buckets",
+              "live pattern buckets (each holds a hierarchy + compiled "
+              "programs)")
+
+# resilience fallback engine (resilience/policy.py)
+declare_counter("resilience.fallback_attempts",
+                "total fallback-chain steps executed")
+declare_counter("resilience.fallback.retry", "plain retry actions run")
+declare_counter("resilience.fallback.rescale_retry",
+                "rescale_retry actions run")
+declare_counter("resilience.fallback.switch_solver",
+                "switch_solver actions run")
+declare_counter("resilience.fallback.escalate_sweeps",
+                "escalate_sweeps actions run")
+
+# jit retraces per solver entry point: a retrace in steady-state serving
+# is a latency cliff (first-request trace cost paid again)
+declare_counter("solver.retrace.solve",
+                "single-solve jit cache misses (Solver.solve)")
+declare_counter("solver.retrace.solve_batched",
+                "batched-solve jit cache misses "
+                "(BatchedSolver.solve_many)")
+declare_counter("solver.retrace.distributed",
+                "distributed-solve shard_map rebuilds "
+                "(DistributedSolver.solve)")
+
+# device-memory watermarks per phase (memory_info allocator statistics
+# sampled at phase boundaries; the backend's own peak_bytes_in_use is
+# preferred so transient in-phase maxima — Galerkin temporaries freed
+# before the boundary — are captured; zero on backends reporting none)
+declare_gauge("memory.setup_peak_bytes",
+              "device-allocator high-water mark (bytes) sampled at "
+              "setup/resetup completion")
+declare_gauge("memory.solve_peak_bytes",
+              "device-allocator high-water mark (bytes) sampled at "
+              "solve completion")

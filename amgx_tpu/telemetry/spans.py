@@ -1,0 +1,250 @@
+"""Hierarchical host spans with Perfetto export.
+
+The reference's AMGX_timer tree (src/amgx_timer.cu) keeps parent/child
+timing relationships; the port's original `profiling.py` flattened them
+into a name->total dict. This module restores the tree: every
+`span(name)` records a (name, start, duration, depth, parent, thread)
+event into a bounded process-wide buffer, alongside the flat
+(calls, total) accumulator the existing `profiling.timers()` /
+`timers_total()` API keeps reading — the accounted-fraction contract
+(`timers_total("amg.") / wall`, PR 3) is unchanged because the amg.*
+setup regions remain DISJOINT LEAF spans by construction (the span
+REGISTRY below is statically linted for that by tools/check_spans.py).
+
+Spans measure HOST wall clock. Under async dispatch that means "time
+until the region's Python body returned", not device occupancy — the
+honest default for orchestration spans. Set `telemetry_sync=1` (config)
+or AMGX_TPU_TELEMETRY_SYNC=1 (env) to fence device work at every span
+boundary so host spans bound device occupancy; this perturbs pipelining
+(the overlapped level shipping, XLA async dispatch), so it is a
+debugging mode, not a production default.
+
+`export_chrome_trace(path)` writes the recorded spans as Chrome
+trace-event JSON ("X" complete events, microseconds), loadable by
+Perfetto / chrome://tracing — the host-side timeline that sits next to
+the device timeline `profiling.start_trace` captures via jax.profiler.
+"""
+from __future__ import annotations
+
+import contextlib
+import fnmatch
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# span-name registry
+# ---------------------------------------------------------------------------
+
+# Every span/trace_region name used in the package must match one of
+# these fnmatch patterns (tools/check_spans.py enforces it statically).
+# Patterns under ACCOUNTED_PREFIX are additionally checked to be
+# pairwise non-nesting: the setup_accounted_fraction >= 0.9 contract
+# sums them, so no amg.* span may ever double-count a child.
+DECLARED_SPANS: Tuple[str, ...] = (
+    # amg.* accounted setup leaves (disjoint by contract)
+    "amg.l0_layout",
+    "amg.host_pull",
+    "amg.value_resetup",
+    "amg.L*.selector",
+    "amg.L*.strength",
+    "amg.L*.cfsplit",
+    "amg.L*.interp",
+    "amg.L*.layoutP",
+    "amg.L*.transposeR",
+    "amg.L*.rap",
+    "amg.L*.galerkin",
+    "amg.L*.layout",
+    "amg.L*.smoother_setup",
+    "amg.coarse_solver_setup",
+    "amg.ship_resolve",
+    "amg.device_sync",
+    # overlapped ship worker (reports on its own thread; NOT summed
+    # into the amg.* accounted fraction)
+    "ship.cast_put",
+    "ship.resolve_stragglers",
+    # solver-tree entry points (dynamic solver names: CG.solve, ...).
+    # NO catch-all patterns belong here: a `<anything>.*` entry would
+    # let any typo'd two-segment name pass the static registry check
+    # (telemetry's own engine spans live in the checker-exempt
+    # spans.py and need no declaration)
+    "*.setup",
+    "*.resetup",
+    "*.solve",
+)
+
+ACCOUNTED_PREFIX = "amg."
+
+
+def is_declared(name: str) -> bool:
+    return any(fnmatch.fnmatchcase(name, p) for p in DECLARED_SPANS)
+
+
+# ---------------------------------------------------------------------------
+# recording
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_tls = threading.local()
+_records: List[dict] = []
+_MAX_RECORDS = 100_000      # oldest half dropped past this
+_flat: Dict[str, Tuple[int, float]] = {}
+_t0 = time.perf_counter()   # trace epoch (ts offsets in the export)
+
+def env_sync() -> bool:
+    """The AMGX_TPU_TELEMETRY_SYNC environment toggle (read at call
+    time). The root-construction latch ORs this in, so the env var
+    keeps fencing on even when configs leave telemetry_sync=0."""
+    return os.environ.get("AMGX_TPU_TELEMETRY_SYNC", "0") not in (
+        "", "0", "false", "False")
+
+
+_sync = env_sync()
+
+
+def set_sync(on: bool):
+    """Enable/disable device fencing at span boundaries (the
+    telemetry_sync knob)."""
+    global _sync
+    _sync = bool(on)
+
+
+def sync_enabled() -> bool:
+    return _sync
+
+
+def _fence():
+    """Best-effort device fence so a host span bounds device occupancy.
+    Backends without a synchronization surface degrade to a no-op (the
+    span then measures dispatch, as documented)."""
+    try:
+        import jax
+        for d in jax.local_devices():
+            try:
+                d.synchronize_all_activity()
+            except Exception:
+                pass
+    except Exception:
+        pass
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+@contextlib.contextmanager
+def span(name: str, annotate: bool = True):
+    """Record one hierarchical span (and accumulate the flat timer).
+    With annotate=True the region is also a jax.profiler
+    TraceAnnotation, so it shows up in captured device profiles — the
+    nvtxRange analog `profiling.trace_region` has always been."""
+    if _sync:
+        _fence()
+    stack = _stack()
+    parent = stack[-1] if stack else None
+    stack.append(name)
+    t_start = time.perf_counter()
+    ctx = contextlib.nullcontext()
+    if annotate:
+        try:
+            import jax
+            ctx = jax.profiler.TraceAnnotation(name)
+        except Exception:
+            pass
+    try:
+        with ctx:
+            yield
+    finally:
+        if _sync:
+            _fence()
+        t_end = time.perf_counter()
+        stack.pop()
+        dt = t_end - t_start
+        rec = {"name": name, "ts": t_start - _t0, "dur": dt,
+               "depth": len(stack), "parent": parent,
+               "tid": threading.get_ident()}
+        with _lock:
+            _records.append(rec)
+            if len(_records) > _MAX_RECORDS:
+                del _records[: _MAX_RECORDS // 2]
+            calls, tot = _flat.get(name, (0, 0.0))
+            _flat[name] = (calls + 1, tot + dt)
+
+
+def records() -> List[dict]:
+    """Copy of the recorded span events (oldest first)."""
+    with _lock:
+        return [dict(r) for r in _records]
+
+
+def flat_timers() -> Dict[str, Tuple[int, float]]:
+    """The flat (calls, total_seconds) view per span name — the
+    accumulator `profiling.timers()` has always returned."""
+    with _lock:
+        return dict(_flat)
+
+
+def timers_total(prefix: str) -> float:
+    """Total wall seconds under span names starting with `prefix`. The
+    amg.* setup regions are maintained as DISJOINT leaf spans (enforced
+    by the registry above + tools/check_spans.py) precisely so
+    `timers_total("amg.") / wall` is an honest accounted fraction."""
+    with _lock:
+        return sum(tot for name, (_c, tot) in _flat.items()
+                   if name.startswith(prefix))
+
+
+def reset():
+    """Drop recorded spans and flat accumulations (open spans on any
+    thread keep recording into the fresh buffers when they close)."""
+    with _lock:
+        _records.clear()
+        _flat.clear()
+
+
+# ---------------------------------------------------------------------------
+# Perfetto / chrome://tracing export
+# ---------------------------------------------------------------------------
+
+
+def chrome_trace_events() -> List[dict]:
+    """The recorded spans as Chrome trace-event 'X' (complete) events:
+    ts/dur in microseconds from the trace epoch, one track per host
+    thread. Nesting is positional (Perfetto stacks overlapping events
+    on a track), so parent linkage needs no explicit ids."""
+    evs = []
+    for r in records():
+        evs.append({
+            "name": r["name"],
+            "cat": (ACCOUNTED_PREFIX.rstrip(".")
+                    if r["name"].startswith(ACCOUNTED_PREFIX)
+                    else r["name"].split(".", 1)[0]),
+            "ph": "X",
+            "ts": round(r["ts"] * 1e6, 3),
+            "dur": round(r["dur"] * 1e6, 3),
+            "pid": os.getpid(),
+            "tid": r["tid"],
+            "args": {"depth": r["depth"], "parent": r["parent"]},
+        })
+    return evs
+
+
+def export_chrome_trace(path: str) -> int:
+    """Write the recorded spans as a Perfetto-loadable trace-event JSON
+    file; returns the number of events written."""
+    evs = chrome_trace_events()
+    payload = {
+        "traceEvents": evs,
+        "displayTimeUnit": "ms",
+        "otherData": {"source": "amgx_tpu.telemetry.spans"},
+    }
+    with span("telemetry.export", annotate=False):
+        with open(path, "w") as f:
+            json.dump(payload, f)
+            f.write("\n")
+    return len(evs)

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Static span-registry checker.
+
+Two contracts guard the telemetry subsystem's honesty, and both are
+checkable without running anything:
+
+1. REGISTRY COVERAGE — every span name used in the package (a string or
+   f-string literal passed to `trace_region(...)` / `span(...)`) must
+   match a pattern declared in `telemetry.spans.DECLARED_SPANS`. A
+   typo'd region name would otherwise silently fork a new time series
+   (and, under `amg.*`, silently leak out of the accounted fraction).
+
+2. LEAF DISJOINTNESS — the declared patterns under the accounted prefix
+   (`amg.*`) must be pairwise NON-NESTING: `profiling.timers_total`
+   sums them flat, so a declared span that is an ancestor of another
+   declared span would double-count its child's wall time and the PR-3
+   `setup_accounted_fraction >= 0.9` contract would silently report
+   fractions > honest.
+
+f-string placeholders (`{expr}`) are normalized to `*`, so
+`f"amg.L{k}.galerkin"` checks as `amg.L*.galerkin`. Calls whose name is
+not a literal cannot be checked statically and are reported (there are
+deliberately none in the package).
+
+Exit code 0 = clean; 1 = violations (printed one per line). Wired into
+the test suite by tests/test_telemetry.py.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+sys.path.insert(0, _ROOT)
+
+PKG = os.path.join(_ROOT, "amgx_tpu")
+
+# the recording engine itself (generic `name` parameters, the decorator
+# helper): it defines the machinery, it does not USE span names
+_EXEMPT = (
+    os.path.join("amgx_tpu", "profiling.py"),
+    os.path.join("amgx_tpu", "telemetry", "spans.py"),
+)
+
+_CALL_NAMES = {"trace_region", "span"}
+
+
+def _call_name(node: ast.Call):
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _normalize(node):
+    """A Call's first argument as a wildcard pattern: plain string
+    literals pass through, f-string placeholders become '*', anything
+    else returns None (not statically checkable)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:                       # FormattedValue
+                parts.append("*")
+        return "".join(parts)
+    return None
+
+
+def extract_span_literals(root: str = PKG):
+    """(file, line, normalized_name) for every span-name use; name is
+    None for calls whose argument is not a (f-)string literal. AST-
+    based, so docstrings and comments never false-positive."""
+    out = []
+    for dirpath, _dirs, files in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, _ROOT)
+            if rel in _EXEMPT:
+                continue
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) \
+                        or _call_name(node) not in _CALL_NAMES \
+                        or not node.args:
+                    continue
+                out.append((path, node.lineno, _normalize(node.args[0])))
+    return out
+
+
+def _compatible(used: str, declared: str) -> bool:
+    """Could the used (possibly wildcarded) name match the declared
+    pattern? A used '*' is an f-string placeholder — a solver name or
+    a level index, assumed DOT-FREE (every placeholder in the package
+    substitutes an identifier/number), so segment counts must agree
+    and comparison is per dot-segment. The used name's LITERAL
+    segments and the literal prefix/suffix around its placeholders
+    must fit the declared pattern exactly — a typo in any literal part
+    ('*.solv', 'amg.L*.stregth') fails against every declared entry.
+    Exact fnmatch for the fully-literal case."""
+    if "*" not in used:
+        return fnmatch.fnmatchcase(used, declared)
+    us, ds = used.split("."), declared.split(".")
+    if len(us) != len(ds):
+        return False            # placeholders never contain dots
+    for u, d in zip(us, ds):
+        if "*" in u:
+            # unknown placeholder content: compatible when the
+            # declared segment is itself a wildcard, or the used
+            # segment's literal prefix/suffix around '*' fits the
+            # declared literal
+            if "*" in d:
+                continue
+            pre, _, suf = u.partition("*")
+            if not (d.startswith(pre) and d.endswith(suf)):
+                return False
+        elif not fnmatch.fnmatchcase(u, d):
+            return False
+    return True
+
+
+def check():
+    from amgx_tpu.telemetry import spans as S
+
+    errors = []
+
+    # 1. registry coverage
+    for path, line, name in extract_span_literals():
+        rel = os.path.relpath(path, _ROOT)
+        if name is None:
+            errors.append(f"{rel}:{line}: span name is not a string "
+                          f"literal (cannot be checked statically)")
+            continue
+        if not any(_compatible(name, d) for d in S.DECLARED_SPANS):
+            errors.append(f"{rel}:{line}: span {name!r} matches no "
+                          f"declared pattern (telemetry/spans.py "
+                          f"DECLARED_SPANS)")
+
+    # 2. accounted-leaf disjointness: concretize '*' and require that
+    # no declared amg.* pattern is a dotted ancestor of another
+    acc = [d for d in S.DECLARED_SPANS
+           if d.startswith(S.ACCOUNTED_PREFIX)]
+    conc = {d: d.replace("*", "X") for d in acc}
+    for a in acc:
+        for b in acc:
+            if a != b and conc[b].startswith(conc[a] + "."):
+                errors.append(
+                    f"declared span {a!r} is an ancestor of {b!r}: "
+                    f"the accounted amg.* sum would double-count")
+    return errors
+
+
+def main() -> int:
+    errors = check()
+    if errors:
+        for e in errors:
+            print(e)
+        print(f"check_spans: {len(errors)} violation(s)")
+        return 1
+    print("check_spans: OK (registry coverage + accounted-leaf "
+          "disjointness)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
