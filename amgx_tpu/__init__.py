@@ -92,6 +92,9 @@ def __getattr__(name):
     if name == "resilience":
         from . import resilience
         return resilience
+    if name == "serving":
+        from . import serving
+        return serving
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 

@@ -24,8 +24,8 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import hashlib
+import time
 import weakref
-from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -92,6 +92,9 @@ class SolveRequest:
     x0: Optional[np.ndarray] = None
     fingerprint: str = ""
     result: Optional[SolveResult] = None
+    # submission wall time (time.monotonic): drain() dispatches buckets
+    # oldest-first by their earliest pending submit
+    submit_t: float = 0.0
 
     @property
     def done(self) -> bool:
@@ -105,7 +108,7 @@ class RequestBatcher:
 
     def __init__(self, cfg: Config, scope: str = "default",
                  batch_sizes: Sequence[int] = PAD_SIZES,
-                 max_buckets: int = 16):
+                 max_buckets: int = 16, max_bucket_bytes: int = 0):
         if not batch_sizes or list(batch_sizes) != sorted(set(batch_sizes)):
             raise BadParametersError(
                 "RequestBatcher: batch_sizes must be a sorted ladder of "
@@ -113,17 +116,36 @@ class RequestBatcher:
         self.cfg = cfg
         self.scope = scope
         self.batch_sizes = tuple(int(s) for s in batch_sizes)
-        # LRU cap on live buckets: each holds a full hierarchy plus up
-        # to len(batch_sizes) compiled programs — a long-running server
-        # seeing many distinct meshes must not grow without bound
+        # bounded LRU of live buckets: each holds a full hierarchy plus
+        # up to len(batch_sizes) compiled programs — a long-running
+        # server seeing many distinct meshes must not grow without
+        # bound, in entry count OR in device bytes (serving/cache.py;
+        # max_bucket_bytes=0 leaves the byte budget off). Evictions and
+        # the live-bucket count surface through the declared telemetry
+        # gauges (batch.bucket_evictions / batch.live_buckets).
         self.max_buckets = int(max_buckets)
-        self._solvers: "OrderedDict[str, BatchedSolver]" = OrderedDict()
+        self.max_bucket_bytes = int(max_bucket_bytes)
+        from ..serving.cache import HierarchyCache
+        self._solvers = HierarchyCache(
+            budget_bytes=self.max_bucket_bytes,
+            max_entries=self.max_buckets,
+            counters={"evict": "batch.bucket_evictions",
+                      "entries": "batch.live_buckets"},
+            on_evict=lambda key, _bs: self._templates.pop(key, None))
         # the matrix object each bucket's solver currently holds values
         # from (detects when a shared-matrix bucket needs a resetup)
         self._templates: Dict[str, CsrMatrix] = {}
         self._pending: Dict[str, List[SolveRequest]] = {}
         # observability: dispatch log of (bucket_key, real, padded)
         self.dispatch_log: List[Tuple[str, int, int]] = []
+
+    @property
+    def live_buckets(self) -> int:
+        return len(self._solvers)
+
+    @property
+    def bucket_evictions(self) -> int:
+        return self._solvers.evictions
 
     # -- submit/drain -----------------------------------------------------
     def _bucket_key(self, A: CsrMatrix, b) -> str:
@@ -138,7 +160,8 @@ class RequestBatcher:
                 f"submit: b must be one system's rhs, got shape {b.shape}")
         req = SolveRequest(A=A, b=b,
                            x0=None if x0 is None else np.asarray(x0),
-                           fingerprint=self._bucket_key(A, b))
+                           fingerprint=self._bucket_key(A, b),
+                           submit_t=time.monotonic())
         self._pending.setdefault(req.fingerprint, []).append(req)
         from ..telemetry import metrics as _tm
         _tm.inc("batch.requests")
@@ -150,10 +173,19 @@ class RequestBatcher:
     def drain(self) -> List[SolveRequest]:
         """Dispatch every pending bucket (each as one or more batched
         solves, padded to the ladder) and fill the tickets. Returns the
-        completed requests in submission order per bucket."""
+        completed requests in submission order per bucket.
+
+        Buckets dispatch OLDEST-FIRST by their earliest pending submit
+        time — not in dict-insertion order — so a hot fingerprint's
+        backlog cannot starve a cold tenant's single request: the
+        longest-waiting request's bucket always goes first, whatever
+        interleaving produced the pending map."""
         done: List[SolveRequest] = []
         pending, self._pending = self._pending, {}
-        for key, reqs in pending.items():
+        for key in sorted(pending,
+                          key=lambda k: min(r.submit_t
+                                            for r in pending[k])):
+            reqs = pending[key]
             top = self.batch_sizes[-1]
             for i in range(0, len(reqs), top):
                 self._dispatch(key, reqs[i:i + top])
@@ -173,17 +205,16 @@ class RequestBatcher:
 
     # -- dispatch ---------------------------------------------------------
     def _solver_for(self, key: str, template: CsrMatrix) -> BatchedSolver:
-        bs = self._solvers.get(key)
+        bs = self._solvers.get(key)          # LRU-touching lookup
         if bs is None:
+            from ..serving.cache import solve_data_bytes
             bs = BatchedSolver(self.cfg, self.scope)
             bs.setup(template)
-            self._solvers[key] = bs
             self._templates[key] = template
-            while len(self._solvers) > self.max_buckets:
-                old_key, _ = self._solvers.popitem(last=False)   # LRU
-                self._templates.pop(old_key, None)
-        else:
-            self._solvers.move_to_end(key)
+            # put() evicts LRU buckets past the entry/byte budgets
+            # (bytes = the hierarchy's solve-data footprint estimate)
+            self._solvers.put(key, bs,
+                              nbytes=solve_data_bytes(bs.solver))
         return bs
 
     def _dispatch(self, key: str, reqs: List[SolveRequest]):
@@ -197,7 +228,6 @@ class RequestBatcher:
         _tm.inc("batch.padded_systems", pad)
         _tm.set_gauge("batch.bucket_occupancy", len(reqs) / size)
         solver = self._solver_for(key, reqs[0].A)
-        _tm.set_gauge("batch.live_buckets", len(self._solvers))
         matrices = [r.A for r in reqs] + [reqs[-1].A] * pad
         bs = np.stack([r.b for r in reqs] + [reqs[-1].b] * pad)
         if any(r.x0 is not None for r in reqs):

@@ -791,6 +791,127 @@ def AMGX_solver_get_iteration_residual(slv_h, it: int, idx: int = 0):
 
 
 # ---------------------------------------------------------------------------
+# serving API (amgx_tpu/serving/; no reference analog — the reference
+# is consumed AS a service library behind this C surface, so the
+# service loop always lived on the caller's side of the API. These
+# entry points move it inside: continuous batching, the hierarchy
+# cache, AOT warm paths and per-tenant deadlines behind handles.)
+# ---------------------------------------------------------------------------
+
+
+class _CService:
+    def __init__(self, resources, mode, cfg: Config):
+        self.resources = resources
+        self.mode = mode
+        self.cfg = cfg
+        from .serving import SolveService
+        self.service = SolveService(cfg)
+
+
+@_api
+@_outputs(1)
+def AMGX_service_create(rsrc_h, mode: str, cfg_h):
+    """rc, service handle. The config's serving_* parameters size the
+    buckets, cache, AOT store and deadline semantics."""
+    rs = _get(rsrc_h, _CResources)
+    cfg = _get(cfg_h, Config)
+    from . import initialize
+    initialize()
+    return RC.OK, _new_handle(_CService(rs, parse_mode(mode), cfg))
+
+
+@_api
+def AMGX_service_destroy(svc_h):
+    svc = _handles.pop(svc_h, None)
+    if svc is not None and isinstance(svc, _CService):
+        svc.service.stop()
+    return RC.OK
+
+
+@_api
+@_outputs(1)
+def AMGX_service_submit(svc_h, mtx_h, rhs_h, tenant: str = "default",
+                        deadline_s=None):
+    """rc, ticket handle. Enqueues one system; issues no device work
+    of its own and never waits on a hierarchy build (it can contend
+    with the scheduler's cycle lock for up to one chunk of stepping).
+    `deadline_s` is a relative latency budget — expiry completes the
+    ticket with DEADLINE_EXCEEDED instead of stalling its bucket."""
+    svc = _get(svc_h, _CService)
+    m = _get(mtx_h, _CMatrix)
+    b = _get(rhs_h, _CVector)
+    if m.A is None or b.v is None:
+        raise AMGXError("matrix/rhs not uploaded", RC.BAD_PARAMETERS)
+    ticket = svc.service.submit(m.A, b.v, tenant=tenant,
+                                deadline_s=deadline_s)
+    return RC.OK, _new_handle(ticket)
+
+
+@_api
+@_outputs(1)
+def AMGX_service_step(svc_h):
+    """rc, completed count: run ONE scheduler cycle (expire / admit /
+    advance every bucket by serving_chunk_iters / finalize)."""
+    svc = _get(svc_h, _CService)
+    with svc.resources.res.device_context():
+        return RC.OK, len(svc.service.step())
+
+
+@_api
+@_outputs(1)
+def AMGX_service_drain(svc_h, timeout_s=None):
+    """rc, completed count: step until every queued and in-flight
+    request completed (or timeout). Counts completions during the
+    call whether the scheduler runs inline or on its thread."""
+    svc = _get(svc_h, _CService)
+    before = svc.service.completed_total
+    with svc.resources.res.device_context():
+        svc.service.drain(timeout_s=timeout_s)
+    return RC.OK, svc.service.completed_total - before
+
+
+@_api
+@_outputs(2)
+def AMGX_service_ticket_status(tkt_h):
+    """rc, done (0/1), AMGX_SOLVE_* status (None while pending)."""
+    from .serving import ServiceTicket
+    t = _get(tkt_h, ServiceTicket)
+    if not t.done:
+        return RC.OK, 0, None
+    return RC.OK, 1, to_amgx_status(t.result.status_code)
+
+
+@_api
+def AMGX_service_ticket_download(tkt_h, sol_h):
+    """Download a completed ticket's solution into a vector handle."""
+    from .serving import ServiceTicket
+    t = _get(tkt_h, ServiceTicket)
+    x = _get(sol_h, _CVector)
+    if not t.done:
+        raise AMGXError("ticket not completed (drain or step the "
+                        "service first)", RC.BAD_PARAMETERS)
+    x.v = np.asarray(t.result.x)
+    x.batch = None
+    return RC.OK
+
+
+@_api
+def AMGX_service_ticket_destroy(tkt_h):
+    _handles.pop(tkt_h, None)
+    return RC.OK
+
+
+@_api
+@_outputs(1)
+def AMGX_service_stats(svc_h):
+    """rc, stats dict: queue depth, in-flight count, live buckets,
+    cache bytes/evictions, per-tenant tallies (service-local; the
+    process-wide serving.* counters live in AMGX_read_metrics)."""
+    svc = _get(svc_h, _CService)
+    return RC.OK, svc.service.stats()
+
+
+# ---------------------------------------------------------------------------
 # system IO API
 # ---------------------------------------------------------------------------
 

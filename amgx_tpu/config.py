@@ -365,6 +365,37 @@ def _register_default_parameters():
       "each create_solver/DistributedSolver construction latches the "
       "mode from its config — in both directions, so building a "
       "telemetry_sync=0 solver turns fencing back off", 0, BOOL01)
+    # serving subsystem (amgx_tpu/serving/)
+    R("serving_chunk_iters", int, "continuous-batching cycle length: "
+      "iterations every in-flight system advances per scheduler cycle "
+      "before the service checks convergence/deadlines and refills "
+      "drained bucket slots (serving/engine.py). Smaller = lower "
+      "admission latency, more host syncs per solve", 8, None, 1)
+    R("serving_bucket_slots", int, "in-flight systems per serving "
+      "bucket: the fixed batch width of the continuous-batching engine "
+      "(one trace serves the bucket forever; empty slots ride along "
+      "converged and cost nothing)", 4, None, 1)
+    R("serving_cache_bytes", int, "byte budget for the hierarchy/LRU "
+      "cache of live serving buckets (solve-data footprint estimate); "
+      "idle least-recently-used buckets are evicted past it. 0 = "
+      "unbounded", 0, None, 0)
+    R("serving_cache_entries", int, "max live serving buckets "
+      "regardless of bytes (each holds a hierarchy + engine traces)",
+      16, None, 1)
+    R("serving_aot_dir", str, "directory persisting AOT-exported bucket "
+      "executables (jax.export) keyed by (pattern fingerprint, bucket "
+      "geometry): a restarted service loads them and skips the "
+      "first-request trace latency. '' = AOT off", "")
+    R("serving_deadline_action", str, "what an expired in-flight "
+      "request completes with: 'partial' = its current iterate "
+      "(best-effort degrade), 'reject' = the initial/zero iterate; "
+      "either way the status is DEADLINE_EXCEEDED and the bucket keeps "
+      "cycling — deadlines never stall neighbors", "partial",
+      ("partial", "reject"))
+    R("serving_max_queue", int, "admission control: submits beyond "
+      "this many queued requests complete immediately with "
+      "DEADLINE_EXCEEDED instead of growing the queue without bound "
+      "(0 = unbounded)", 0, None, 0)
     R("fallback_policy", str, "resilience chains "
       "'STATUS>action[=arg]|...' (actions: retry, rescale_retry, "
       "switch_solver=<NAME>, escalate_sweeps), applied host-side by "

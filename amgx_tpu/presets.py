@@ -52,6 +52,25 @@ RESILIENT_CG = (
     " fallback_policy=NAN_DETECTED>retry|BREAKDOWN>switch_solver=GMRES"
     "|STALLED>escalate_sweeps, max_fallback_attempts=2")
 
+# Serving preset (amgx_tpu/serving/): the continuous-batching service
+# shape whose coefficient updates take the FUSED value-only resetup
+# (amg/value_resetup.py — GEO/DIA hierarchy, CHEBYSHEV_POLY smoothing,
+# DENSE_LU coarse): a hierarchy-cache hit then admits a repeat-pattern
+# system through the one-dispatch value splice, the 0.43 s-vs-17 s
+# routing decision the serving telemetry watches. Needs a structured
+# grid (gallery matrices carry grid_shape); unstructured request
+# streams should serve BATCHED_CG instead (same service, generic
+# structure-reuse resetup routing).
+SERVING_CG = (
+    "solver(s)=PCG, s:max_iters=100, s:tolerance=1e-8,"
+    " s:convergence=RELATIVE_INI, s:norm=L2, s:monitor_residual=1,"
+    " s:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION,"
+    " amg:selector=GEO, amg:smoother=CHEBYSHEV_POLY,"
+    " amg:chebyshev_polynomial_order=2, amg:presweeps=1,"
+    " amg:postsweeps=1, amg:cycle=V, amg:max_iters=1,"
+    " amg:coarse_solver=DENSE_LU_SOLVER, amg:min_coarse_rows=32,"
+    " amg:max_levels=20, amg:structure_reuse_levels=-1")
+
 # GMRES variant for nonsymmetric request streams (same AMG shape).
 BATCHED_GMRES = (
     "solver(s)=GMRES, s:max_iters=100, s:tolerance=1e-8,"

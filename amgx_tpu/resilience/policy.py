@@ -13,7 +13,11 @@ Grammar (`fallback_policy` config parameter)::
     STATUS>action[=arg] | STATUS>action[=arg] | ...
 
 - STATUS: a SolveStatus name (NAN_DETECTED / BREAKDOWN / DIVERGED /
-  STALLED / MAX_ITERS; NAN is accepted as an alias), or ANY.
+  STALLED / MAX_ITERS / DEADLINE_EXCEEDED; NAN and DEADLINE are
+  accepted as aliases), or ANY. DEADLINE_EXCEEDED is produced by the
+  serving layer (amgx_tpu/serving/) when a request's deadline expires
+  mid-flight; a chain keyed on it lets a sync re-solve of the expired
+  system run a recovery action like any other failure class.
 - actions:
   * ``retry``            — re-solve with the SAME solver from a zero
     guess (no setup cost: hierarchy + traces reused; a consumed
@@ -47,7 +51,7 @@ ACTIONS = ("retry", "rescale_retry", "switch_solver", "escalate_sweeps")
 
 ANY = "ANY"
 
-_STATUS_ALIASES = {"NAN": "NAN_DETECTED"}
+_STATUS_ALIASES = {"NAN": "NAN_DETECTED", "DEADLINE": "DEADLINE_EXCEEDED"}
 
 Chain = List[Tuple[str, str]]
 
@@ -73,7 +77,8 @@ def parse_fallback_policy(spec: str) -> Dict[object, Chain]:
             try:
                 key = int(SolveStatus[sname])
             except KeyError:
-                names = [s.name for s in SolveStatus] + [ANY, "NAN"]
+                names = [s.name for s in SolveStatus] + \
+                    [ANY] + list(_STATUS_ALIASES)
                 raise BadConfigurationError(
                     f"fallback_policy: unknown status {sname!r}"
                     f"{did_you_mean(sname, names)}") from None

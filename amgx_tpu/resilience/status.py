@@ -34,6 +34,10 @@ class SolveStatus(enum.IntEnum):
     BREAKDOWN = 4      # Krylov recurrence degenerated (p.Ap <= 0, rho/
     #                    omega underflow, Givens degeneracy, ...)
     NAN_DETECTED = 5   # non-finite residual norm reached the monitor
+    DEADLINE_EXCEEDED = 6  # serving-layer deadline expired before the
+    #                    solve reached a terminal status (the request
+    #                    completes with its current iterate or a
+    #                    rejection, never a hung bucket; serving/)
 
 
 # AMGX_SOLVE_STATUS codes (include/amgx_c.h) for the C-API surface.
@@ -49,6 +53,7 @@ _TO_AMGX = {
     SolveStatus.DIVERGED: AMGX_SOLVE_DIVERGED,
     SolveStatus.BREAKDOWN: AMGX_SOLVE_FAILED,
     SolveStatus.NAN_DETECTED: AMGX_SOLVE_FAILED,
+    SolveStatus.DEADLINE_EXCEEDED: AMGX_SOLVE_NOT_CONVERGED,
 }
 
 _STRINGS = {
@@ -58,6 +63,7 @@ _STRINGS = {
     SolveStatus.DIVERGED: "diverged",
     SolveStatus.BREAKDOWN: "breakdown",
     SolveStatus.NAN_DETECTED: "nan_detected",
+    SolveStatus.DEADLINE_EXCEEDED: "deadline_exceeded",
 }
 
 

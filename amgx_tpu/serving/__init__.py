@@ -1,0 +1,33 @@
+"""Production serving subsystem.
+
+The async multi-tenant solve service over the batch/resilience/
+telemetry machinery (ROADMAP item 3). Four pieces:
+
+- **continuous batching** (`engine.BucketEngine` on the chunked solve
+  entry `Solver._build_chunk_fns`): in-flight systems advance in
+  fixed-size buckets chunk-by-chunk; a converged slot is refilled at
+  the next cycle boundary instead of waiting for the batch to drain;
+- **hierarchy/LRU cache** (`cache.HierarchyCache`): live buckets keyed
+  on pattern fingerprint, bytes-budgeted; repeat-structure traffic
+  routes through value-resetup instead of a full AMG setup;
+- **AOT warm paths** (`aot.AotStore`): bucket executables exported
+  with `jax.export` and persisted, so a restarted service skips
+  first-request trace latency;
+- **per-tenant deadlines + admission control** (`service.SolveService`):
+  expiry completes tickets with `SolveStatus.DEADLINE_EXCEEDED` —
+  never a hung bucket — and `serving_max_queue` bounds the queue.
+
+Quick start::
+
+    from amgx_tpu.serving import SolveService
+    svc = SolveService(Config.from_string(BATCHED_CG + ", ..."))
+    t = svc.submit(A, b, tenant="alice", deadline_s=0.5)
+    svc.drain()          # or svc.start() for the background scheduler
+    print(t.result.status, t.latency_s)
+"""
+from __future__ import annotations
+
+from .aot import AotStore  # noqa: F401
+from .cache import HierarchyCache, solve_data_bytes  # noqa: F401
+from .engine import BucketEngine  # noqa: F401
+from .service import ServiceTicket, SolveService  # noqa: F401

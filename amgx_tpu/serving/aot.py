@@ -1,0 +1,122 @@
+"""AOT-exported bucket executables (`jax.export`).
+
+The first request of a serving bucket pays the Python trace of the
+whole preconditioned solve cycle — at 256^3 that is seconds of host
+work before the first byte of device compute. The hierarchy cache
+amortizes it within a process; this store amortizes it ACROSS
+processes: each bucket's engine functions (single-system init, batched
+chunk step, batched finalize) are exported with `jax.export`, the
+serialized StableHLO persisted under a key derived from the pattern
+fingerprint and the bucket geometry, and a restarted service loads
+them instead of retracing (`serving.retrace` stays 0; XLA compilation
+of the embedded module still runs, but that hits the persistent
+compilation cache).
+
+The exported functions are FLAT (positional array leaves in, tuple of
+array leaves out): pytree containers never enter the serialized
+artifact, so custom nodes (CsrMatrix, level payloads) need no
+serialization support — the engine flattens/unflattens around the
+call using treedefs it reconstructs from the bundle's sidecar
+metadata (the solve state is a flat dict of arrays; its sorted key
+list fully determines the treedef).
+
+Artifacts are keyed additionally on the jax version and backend
+platform: a mismatched module fails deserialization anyway, the key
+just makes the miss cheap and the store multi-platform-safe.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..profiling import trace_region
+
+
+def _digest(parts) -> str:
+    import jax
+    h = hashlib.blake2b(digest_size=16)
+    h.update(repr((tuple(parts), jax.__version__,
+                   jax.default_backend())).encode())
+    return h.hexdigest()
+
+
+class AotStore:
+    """Directory-backed store of exported bucket executables."""
+
+    def __init__(self, directory: str):
+        self.directory = str(directory)
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _path(self, key: str, name: str) -> str:
+        return os.path.join(self.directory, f"{key}.{name}")
+
+    def key(self, parts: Sequence[Any]) -> str:
+        return _digest(parts)
+
+    # -- save -------------------------------------------------------------
+    def save_bundle(self, key: str, fns: Dict[str, Any],
+                    meta: Dict[str, Any]) -> bool:
+        """Export and persist `fns` ({name: (flat_jit_fn, flat_args)})
+        plus the sidecar metadata. All-or-nothing: a failed export
+        removes the partial bundle and reports False (the engine keeps
+        its traced functions; `serving.aot.error` counts it)."""
+        from ..telemetry import metrics as _tm
+        try:
+            from jax import export as jexport
+            with trace_region("serving.aot_export"):
+                blobs = {}
+                for name, (fn, args) in fns.items():
+                    exp = jexport.export(fn)(*args)
+                    blobs[name] = exp.serialize()
+                for name, blob in blobs.items():
+                    with open(self._path(key, name) + ".bin", "wb") as f:
+                        f.write(blob)
+                with open(self._path(key, "meta") + ".json", "w") as f:
+                    json.dump(meta, f)
+            _tm.inc("serving.aot.export")
+            return True
+        except Exception:
+            _tm.inc("serving.aot.error")
+            for name in list(fns) + ["meta"]:
+                for ext in (".bin", ".json"):
+                    try:
+                        os.remove(self._path(key, name) + ext)
+                    except OSError:
+                        pass
+            return False
+
+    # -- load -------------------------------------------------------------
+    def load_meta(self, key: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self._path(key, "meta") + ".json") as f:
+                return json.load(f)
+        except Exception:
+            return None
+
+    def load_bundle(self, key: str, names: List[str]):
+        """Load `{name: callable(*flat_leaves) -> tuple(leaves)}` for a
+        complete bundle, or None (missing/corrupt/mismatched — the
+        engine then traces as usual). The deserialized calls are
+        wrapped in one jax.jit each so repeat invocations replay the
+        compiled module instead of re-staging it."""
+        from ..telemetry import metrics as _tm
+        meta = self.load_meta(key)
+        if meta is None:
+            return None
+        try:
+            import jax
+            from jax import export as jexport
+            with trace_region("serving.aot_load"):
+                out = {}
+                for name in names:
+                    with open(self._path(key, name) + ".bin", "rb") as f:
+                        blob = f.read()
+                    out[name] = jax.jit(jexport.deserialize(blob).call)
+            _tm.inc("serving.aot.load")
+            out["meta"] = meta
+            return out
+        except Exception:
+            _tm.inc("serving.aot.error")
+            return None

@@ -1,0 +1,368 @@
+"""Continuous-batching bucket engine.
+
+One `BucketEngine` owns a fixed-width batch of in-flight systems that
+share a sparsity pattern (and therefore one AMG hierarchy structure
+and one set of XLA traces). Instead of the `RequestBatcher`'s
+drain-and-wait dispatch — where a batch admitted together must finish
+together — the engine steps every occupied slot by `chunk` iterations
+per scheduler cycle using the chunked solve entry
+(`Solver._build_chunk_fns`), checks the per-slot done flags at the
+cycle boundary, finalizes and frees converged slots, and lets the
+scheduler refill them with queued requests immediately. A drained
+slot's state is frozen by the loop predicate (the same per-system
+convergence freeze the batched subsystem relies on), so empty and
+finished slots ride along at zero cost.
+
+Slot refill never retraces: the per-slot half of the solve-data pytree
+(discovered ONCE by a probe value-resetup at bucket build — the leaves
+a value-only resetup replaces) is scattered row-wise, the shared
+structure half stays aliased, and the engine's three functions
+(single-system init, batched step, batched finalize) keep their
+original traces for the bucket's lifetime. With an `AotStore` the
+traces themselves are loaded from disk (`jax.export`), so a restarted
+service never traces at all.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..batch.core import BatchedSolver
+from ..config import Config
+from ..errors import BadParametersError
+from ..matrix import CsrMatrix
+from ..profiling import trace_region
+from ..solvers.base import Solver, SolveResult
+from .aot import AotStore
+
+_ENGINE_FNS = ("init1", "step", "finish")
+
+
+def _flat_fn(pyfn, in_tree):
+    """Positional-leaves wrapper around a (data, b, state) pytree fn —
+    the exportable form (serving/aot.py: containers never enter the
+    serialized artifact)."""
+    def flat(*leaves):
+        data, b, st = jax.tree.unflatten(in_tree, list(leaves))
+        return tuple(jax.tree.leaves(pyfn(data, b, st)))
+    return flat
+
+
+class BucketEngine:
+    """Continuous-batching engine for one (pattern, dtype) bucket."""
+
+    def __init__(self, cfg: Config, scope: str, template: CsrMatrix,
+                 *, slots: int, chunk: int, dtype,
+                 fingerprint: str = "", aot: Optional[AotStore] = None):
+        self.fingerprint = fingerprint
+        self.slots = int(slots)
+        self.chunk = int(chunk)
+        self.dtype = jnp.dtype(dtype)
+        self.trace_count = 0     # python traces of the engine functions
+        self.aot_warm = False    # True when the fns came from the store
+        with trace_region("serving.bucket_build"):
+            t0 = time.perf_counter()
+            self.bs = BatchedSolver(cfg, scope)
+            self.bs.setup(template)
+            slv = self.bs.solver
+            if slv.scaler is not None:
+                raise BadParametersError(
+                    "serving: equation scaling is unsupported in "
+                    "continuous batching (set scaling=NONE)")
+            self.bs._check_multi_matrix_config()
+            self.max_iters = slv.max_iters
+            self.hist_len = slv.max_iters + 1
+            self.n = template.num_rows * template.block_dimx
+            self._split_data(template)
+            self._build_fns(aot)
+            self._B = jnp.zeros((self.slots, self.n), self.dtype)
+            self._state = self._initial_state()
+            self.build_time = time.perf_counter() - t0
+        # slot bookkeeping is the scheduler's: the engine stores the
+        # occupant object opaquely (a ticket, a request, anything)
+        self.occupant: List[Optional[Any]] = [None] * self.slots
+
+    # -- structure/value split --------------------------------------------
+    def _split_data(self, template: CsrMatrix):
+        """Discover which solve-data leaves a value-only resetup
+        replaces (the per-slot half) by probing with a same-valued
+        copy of the template: structure leaves survive the resetup as
+        the SAME objects (the identity contract the batched subsystem
+        is built on, batch/core.py), value leaves come back fresh.
+        The axes signature is then FIXED for the bucket's lifetime, so
+        every future admit is a row scatter, never a retrace."""
+        slv = self.bs.solver
+        d0_flat, treedef = jax.tree.flatten(slv.solve_data())
+        probe = template.with_values(jnp.asarray(template.values) + 0)
+        with self.bs._keep_batched_traces():
+            slv.resetup(probe)
+        d1_flat, treedef1 = jax.tree.flatten(slv.solve_data())
+        if treedef1 != treedef:
+            raise BadParametersError(
+                "serving: solve-data structure changed across a "
+                "value-only resetup; continuous batching needs "
+                "structure_reuse_levels=-1 so the hierarchy structure "
+                "(and the engine traces) survive per-system value "
+                "splices")
+        self._data_treedef = treedef
+        self._axes_flat = [None if a is b else 0
+                           for a, b in zip(d0_flat, d1_flat)]
+        # shared leaves stay aliased; per-slot leaves start as copies
+        # of the probe's row and are overwritten at admit
+        self._shared_ref = list(d1_flat)
+        self._data_flat = [
+            jnp.stack([leaf] * self.slots) if ax == 0 else leaf
+            for ax, leaf in zip(self._axes_flat, d1_flat)]
+        self._snap_A: Optional[CsrMatrix] = probe
+        self._snap_flat = d1_flat
+
+    def _data_tree(self):
+        return jax.tree.unflatten(self._data_treedef, self._data_flat)
+
+    def _snapshot_for(self, A: CsrMatrix):
+        """Per-system solve-data leaves for A, via the value-resetup
+        path against the bucket's shared hierarchy structure (memoized
+        on the matrix object: a stream resubmitting the same matrix
+        pays zero resetups)."""
+        if A is self._snap_A:
+            return self._snap_flat
+        with self.bs._keep_batched_traces():
+            self.bs.solver.resetup(A)
+        flat, td = jax.tree.flatten(self.bs.solver.solve_data())
+        if td != self._data_treedef:
+            raise BadParametersError(
+                "serving: hierarchy structure drifted across an admit "
+                "resetup (same-fingerprint systems must share one "
+                "structure; check structure_reuse_levels=-1)")
+        for i, ax in enumerate(self._axes_flat):
+            if ax is None and flat[i] is not self._shared_ref[i]:
+                raise BadParametersError(
+                    "serving: a solve-data leaf the bucket shares "
+                    "across slots changed on a value resetup — the "
+                    "probe misclassified it; this solver configuration "
+                    "cannot run under continuous batching")
+        self._snap_A = A
+        self._snap_flat = flat
+        return flat
+
+    # -- engine functions --------------------------------------------------
+    def _counted(self, fn):
+        eng = self
+
+        def counted(data, b, st):
+            eng.trace_count += 1
+            from ..telemetry import metrics as _tm
+            _tm.inc("serving.retrace")
+            return fn(data, b, st)
+
+        return jax.jit(counted)
+
+    def _aot_key(self, aot: AotStore) -> str:
+        # the SOLVER CONFIG is part of the key: tolerance, convergence
+        # mode, sweep counts, guard settings are all baked into the
+        # traced program, so a config edit + restart must MISS the
+        # store (and re-export), never silently serve the old program
+        cfg = self.bs.solver.cfg
+        cfg_sig = (tuple(sorted(cfg.values.items())),
+                   tuple(sorted(cfg.param_scopes.items())))
+        return aot.key((self.fingerprint, self.slots, self.chunk,
+                        self.n, str(self.dtype), self.hist_len,
+                        tuple(0 if a == 0 else -1
+                              for a in self._axes_flat), cfg_sig))
+
+    def _build_fns(self, aot: Optional[AotStore]):
+        slv = self.bs.solver
+        init1, step1, finish1 = slv._build_chunk_fns(self.chunk)
+        data_axes = jax.tree.unflatten(self._data_treedef,
+                                       self._axes_flat)
+        bstep = jax.vmap(step1, in_axes=(data_axes, 0, 0))
+        bfinish = jax.vmap(finish1, in_axes=(data_axes, 0, 0))
+        self._py_fns = {"init1": init1, "step": bstep,
+                        "finish": bfinish}
+        self._aot_store, self._aot_saved = aot, False
+        loaded = None
+        if aot is not None:
+            loaded = aot.load_bundle(self._aot_key(aot),
+                                     list(_ENGINE_FNS))
+        if loaded is not None:
+            self._install_loaded(loaded)
+            self.aot_warm = True
+        else:
+            self._init1 = self._counted(init1)
+            self._bstep = self._counted(bstep)
+            self._bfinish = self._counted(bfinish)
+
+    def _install_loaded(self, loaded):
+        """Serve through AOT-loaded flat executables (store load, or
+        the bucket's own fresh export)."""
+        self._state_keys = list(loaded["meta"]["state_keys"])
+        unflat = self._unflatten_state
+
+        def wrap_state(fn):
+            return lambda data, b, st: unflat(
+                fn(*jax.tree.leaves((data, b, st))))
+
+        self._init1 = wrap_state(loaded["init1"])
+        self._bstep = wrap_state(loaded["step"])
+        fin = loaded["finish"]
+
+        def bfin(data, b, st):
+            out = fin(*jax.tree.leaves((data, b, st)))
+            return out[0], out[1]
+
+        self._bfinish = bfin
+
+    def _unflatten_state(self, leaves) -> Dict[str, Any]:
+        # the solve state is a flat dict of arrays, so its sorted key
+        # list (the sidecar metadata) fully determines the treedef
+        return dict(zip(self._state_keys, leaves))
+
+    def _zeros_single(self):
+        return jnp.zeros((self.n,), self.dtype)
+
+    def _initial_state(self):
+        """All-slots-empty batched state: one init on a zero rhs (the
+        zero-norm0 path marks it CONVERGED at 0 iterations, so empty
+        slots are frozen from the first cycle) stacked S-fold."""
+        z = self._zeros_single()
+        row = self._init1(jax.tree.unflatten(self._data_treedef,
+                                             self._snap_flat), z, z)
+        if not self.aot_warm:
+            self._state_keys = sorted(row)
+        state = {k: jnp.stack([v] * self.slots) for k, v in row.items()}
+        self._maybe_export(state)
+        return state
+
+    def _maybe_export(self, state):
+        """Persist the engine functions once the example operands all
+        exist (serving/aot.py; failures degrade to plain tracing)."""
+        aot = self._aot_store
+        if aot is None or self.aot_warm or self._aot_saved:
+            return
+        self._aot_saved = True
+        z = self._zeros_single()
+        single = jax.tree.unflatten(self._data_treedef, self._snap_flat)
+        args1 = (single, z, z)
+        argsb = (self._data_tree(), self._B, state)
+        fns = {}
+        for name, args in (("init1", args1), ("step", argsb),
+                           ("finish", argsb)):
+            in_tree = jax.tree.structure(args)
+            fns[name] = (jax.jit(_flat_fn(self._py_fns[name], in_tree)),
+                         tuple(jax.tree.leaves(args)))
+        key = self._aot_key(aot)
+        if aot.save_bundle(key, fns,
+                           {"state_keys": self._state_keys,
+                            "n": self.n, "slots": self.slots,
+                            "chunk": self.chunk}):
+            # serve through the just-exported executables: the export
+            # already traced every engine function, so keeping the
+            # separate _counted jits would trace the same programs a
+            # second time on first use (double cold-bucket cost)
+            loaded = aot.load_bundle(key, list(_ENGINE_FNS))
+            if loaded is not None:
+                self._install_loaded(loaded)
+
+    # -- scheduling surface ------------------------------------------------
+    @property
+    def idle(self) -> bool:
+        return all(o is None for o in self.occupant)
+
+    @property
+    def inflight(self) -> int:
+        return sum(o is not None for o in self.occupant)
+
+    def free_slot(self) -> Optional[int]:
+        for j, o in enumerate(self.occupant):
+            if o is None:
+                return j
+        return None
+
+    def footprint_tree(self):
+        """The byte-accounting view (serving/cache.py
+        solve_data_bytes): the stacked data plus the carried state."""
+        return (self._data_flat, list(self._state.values()), self._B)
+
+    def admit(self, slot: int, A: CsrMatrix, b, x0=None,
+              occupant: Any = True):
+        """Fill `slot` with a new system at a cycle boundary: splice
+        its values into the per-slot data rows (value-resetup path),
+        scatter its freshly initialized solve state, mark occupied."""
+        if self.occupant[slot] is not None:
+            raise BadParametersError(f"serving: slot {slot} is occupied")
+        with trace_region("serving.admit"):
+            snap = self._snapshot_for(A)
+            for i, ax in enumerate(self._axes_flat):
+                if ax == 0:
+                    self._data_flat[i] = \
+                        self._data_flat[i].at[slot].set(snap[i])
+            b = jnp.asarray(b, self.dtype)
+            if b.shape != (self.n,):
+                raise BadParametersError(
+                    f"serving: rhs shape {b.shape} does not fit the "
+                    f"bucket's ({self.n},) systems")
+            x0 = self._zeros_single() if x0 is None \
+                else jnp.asarray(x0, self.dtype)
+            self._B = self._B.at[slot].set(b)
+            row = self._init1(
+                jax.tree.unflatten(self._data_treedef, snap), b, x0)
+            self._state = {
+                k: self._state[k].at[slot].set(row[k])
+                for k in self._state}
+        self.occupant[slot] = occupant
+
+    def step(self) -> List[int]:
+        """One engine cycle: every occupied, unfinished slot advances
+        up to `chunk` iterations (finished/empty slots are frozen by
+        the loop predicate). Returns the occupied slots that are now
+        terminal (converged, failed, or out of iterations) — ONE small
+        device->host sync per cycle, the scheduling cadence cost."""
+        if self.idle:
+            return []
+        with trace_region("serving.step"):
+            self._state = self._bstep(self._data_tree(), self._B,
+                                      self._state)
+            # one eager reduction, ONE awaited buffer: remote rigs pay
+            # a full round trip per awaited output (solvers/base.py)
+            term = np.asarray(
+                self._state["done"]
+                | (self._state["iters"] >= self.max_iters))
+        return [j for j in range(self.slots)
+                if self.occupant[j] is not None and bool(term[j])]
+
+    def finalize(self, slot_list: List[int]) -> Dict[int, SolveResult]:
+        """Per-slot SolveResults for `slot_list` (one batched finalize
+        pass; mid-flight neighbors' states are read, not disturbed).
+        Does NOT free the slots — the scheduler does, after deadline
+        bookkeeping."""
+        if not slot_list:
+            return {}
+        with trace_region("serving.finalize"):
+            X, stats = self._bfinish(self._data_tree(), self._B,
+                                     self._state)
+            stats = np.asarray(stats)
+        out = {}
+        store_hist = bool(getattr(self.bs.solver, "store_res_history",
+                                  False))
+        for j in slot_list:
+            it, cv, sc, n0, rn, h = Solver.unpack_stats(
+                stats[j], self.hist_len)
+            out[j] = SolveResult(
+                x=X[j], iterations=it, converged=cv,
+                res_norm=np.asarray(rn), norm0=np.asarray(n0),
+                res_history=np.asarray(h) if store_hist else None,
+                setup_time=self.bs.setup_time, status_code=sc)
+        return out
+
+    def release(self, slot: int):
+        """Free a slot and FREEZE its lane: a released-but-unfinished
+        system (deadline expiry) must not keep burning batched
+        iterations in the vacant slot, so `done` is forced True —
+        idempotent for terminal slots; the next admit overwrites the
+        whole state row anyway."""
+        self._state["done"] = self._state["done"].at[slot].set(True)
+        self.occupant[slot] = None

@@ -590,6 +590,150 @@ class Solver:
 
         return solve_fn
 
+    # -- chunked stepping (serving/engine.py continuous batching) --------
+    def _build_chunk_fns(self, chunk: int):
+        """Resumable chunked-iteration solve entry — the substrate of the
+        serving layer's continuous batching (serving/engine.py). Returns
+        three pure, jittable, vmap-compatible functions::
+
+            init_fn(data, b, x0)      -> state
+            step_fn(data, b, state)   -> state   # <= `chunk` more iters
+            finish_fn(data, b, state) -> (x, stats)
+
+        The state is the SAME recurrence `_build_solve_fn`'s while_loop
+        carries, with `norm0` carried as an explicit state leaf so
+        stepping can resume across host boundaries: a system stepped in
+        chunks visits bit-identical iterates to a one-shot solve, and a
+        converged/terminal system's state is frozen by the loop
+        predicate — so a drained batch slot costs nothing while its
+        neighbors finish, and the scheduler can refill it at the next
+        cycle boundary instead of waiting for the whole batch. The
+        chunk window is per-system relative (`iters < entry_iters +
+        chunk`), so freshly admitted systems and veterans advance the
+        same number of iterations per engine cycle. `finish_fn` packs
+        the identical stats vector `unpack_stats` inverts."""
+        max_iters = self.max_iters
+        monitor = self.monitor_residual
+        hist_len = max_iters + 1
+        div_tol = self.rel_div_tolerance
+        conv = self.convergence
+        guards = self.health_guards
+        stall_w = self.stall_window if guards else 0
+        stall_tol = self.stall_tolerance
+        S = SolveStatus
+        chunk = int(chunk)
+
+        def init_fn(data, b, x0):
+            A = data["A"]
+            r0 = _residual(A, x0, b)
+            norm0 = self._norm(r0)
+            state = {"x": x0, "r": r0}
+            state.update(self.solve_init(data, b, x0, r0))
+            state["iters"] = jnp.asarray(0, jnp.int32)
+            zero0 = jnp.all(norm0 == 0)
+            conv0 = conv.check(norm0, norm0) if monitor \
+                else jnp.asarray(False)
+            done0 = conv0 | zero0
+            state["done"] = done0
+            state["converged"] = done0
+            state["status"] = jnp.where(done0, jnp.int32(S.CONVERGED),
+                                        jnp.int32(_ST_RUNNING))
+            state["res_norm"] = norm0
+            state["norm0"] = norm0
+            state["res_hist"] = jnp.zeros(
+                (hist_len,) + np.shape(norm0), norm0.dtype
+            ).at[0].set(norm0)
+            return state
+
+        # mirror of _build_solve_fn's loop body, reading norm0 from the
+        # carried state instead of a closure (bit-identical per-system
+        # iterates is the chunked/one-shot parity contract test_serving
+        # checks)
+        def body(data, b, st):
+            norm0 = st["norm0"]
+            iters = st["iters"]
+            core = {k: v for k, v in st.items()
+                    if k not in ("iters", "done", "converged",
+                                 "res_norm", "res_hist", "status",
+                                 "norm0")}
+            with _fi.iteration_scope(iters):
+                core = self.solve_iteration(data, b, core)
+            new = dict(st)
+            new.update(core)
+            new["iters"] = iters + 1
+            if monitor:
+                rn_int = self.internal_res_norm(core)
+                if rn_int is not None:
+                    rn = jnp.broadcast_to(jnp.asarray(rn_int),
+                                          np.shape(norm0))
+                elif self.computes_residual():
+                    rn = self._norm(core["r"])
+                else:
+                    rn = self._norm(_residual(data["A"], core["x"], b))
+                new["res_norm"] = rn
+                new["res_hist"] = st["res_hist"].at[iters + 1].set(rn)
+                cvg = conv.check(rn, norm0)
+                false_ = jnp.asarray(False)
+                diverged = false_
+                if div_tol > 0:
+                    diverged = jnp.any(rn > div_tol * norm0)
+                bad = ~jnp.all(jnp.isfinite(rn)) if guards else false_
+                brk = core.get("breakdown", false_) if guards \
+                    else false_
+                stalled = false_
+                if stall_w > 0:
+                    past = jax.lax.dynamic_index_in_dim(
+                        new["res_hist"],
+                        jnp.maximum(iters + 1 - stall_w, 0),
+                        axis=0, keepdims=False)
+                    stalled = (iters + 1 >= stall_w) & jnp.all(
+                        rn >= (1.0 - stall_tol) * past)
+                status_now = jnp.where(
+                    cvg, jnp.int32(S.CONVERGED),
+                    jnp.where(brk, jnp.int32(S.BREAKDOWN),
+                    jnp.where(bad, jnp.int32(S.NAN_DETECTED),
+                    jnp.where(diverged, jnp.int32(S.DIVERGED),
+                    jnp.where(stalled, jnp.int32(S.STALLED),
+                              jnp.int32(_ST_RUNNING))))))
+                new["status"] = jnp.where(
+                    st["status"] == _ST_RUNNING, status_now,
+                    st["status"])
+                new["converged"] = \
+                    new["status"] == jnp.int32(S.CONVERGED)
+                new["done"] = new["status"] != jnp.int32(_ST_RUNNING)
+            return new
+
+        def step_fn(data, b, state):
+            entry = state["iters"]
+
+            def cond(st):
+                return ((~st["done"]) & (st["iters"] < max_iters)
+                        & (st["iters"] < entry + chunk))
+
+            out = jax.lax.while_loop(
+                cond, lambda st: body(data, b, st), state)
+            if _fi.any_loop_fault_armed():
+                _fi.consume_loop_faults()
+            return out
+
+        def finish_fn(data, b, state):
+            norm0 = state["norm0"]
+            x_final = self.finalize(data, b, state)
+            status = jnp.where(state["status"] == _ST_RUNNING,
+                               jnp.int32(S.MAX_ITERS), state["status"])
+            rdt = jnp.promote_types(jnp.asarray(norm0).dtype,
+                                    jnp.float32)
+            stats = jnp.concatenate([
+                jnp.reshape(state["iters"].astype(rdt), (1,)),
+                jnp.reshape(state["converged"].astype(rdt), (1,)),
+                jnp.reshape(status.astype(rdt), (1,)),
+                jnp.ravel(jnp.asarray(norm0)),
+                jnp.ravel(jnp.asarray(state["res_norm"])),
+                jnp.ravel(jnp.asarray(state["res_hist"]))])
+            return x_final, stats
+
+        return init_fn, step_fn, finish_fn
+
     @staticmethod
     def unpack_stats(stats, hist_len: int):
         """Invert the stats packing of _build_solve_fn: returns

@@ -136,6 +136,9 @@ declare_counter("amg.geo_struct_cache.miss",
 # RequestBatcher (batch/queue.py)
 declare_counter("batch.requests", "solve requests submitted")
 declare_counter("batch.dispatches", "batched dispatches issued")
+declare_counter("batch.bucket_evictions",
+                "pattern buckets evicted from the RequestBatcher's "
+                "bounded solver store (count or bytes budget exceeded)")
 declare_counter("batch.padded_systems",
                 "pad-waste systems dispatched (ladder rung minus real "
                 "requests, summed over dispatches)")
@@ -166,6 +169,54 @@ declare_counter("solver.retrace.solve_batched",
 declare_counter("solver.retrace.distributed",
                 "distributed-solve shard_map rebuilds "
                 "(DistributedSolver.solve)")
+
+# serving subsystem (amgx_tpu/serving/): the production solve service —
+# continuous batching, hierarchy cache routing, AOT warm paths and
+# per-tenant deadlines all report here
+declare_counter("serving.requests",
+                "solve requests submitted to the service")
+declare_counter("serving.completed",
+                "requests completed (any terminal status)")
+declare_counter("serving.rejected",
+                "requests rejected without solving (admission control "
+                "queue bound, or reject-on-deadline action)")
+declare_counter("serving.deadline_miss",
+                "requests whose deadline expired before convergence "
+                "(completed with DEADLINE_EXCEEDED, queued or in-flight)")
+declare_counter("serving.cache.hit",
+                "hierarchy-cache hits: request fingerprint matched a "
+                "live bucket, so admission routes through value-resetup "
+                "instead of a full AMG setup")
+declare_counter("serving.cache.miss",
+                "hierarchy-cache misses (full setup paid to build a "
+                "new bucket)")
+declare_counter("serving.cache.evictions",
+                "idle buckets evicted to fit the cache byte budget")
+declare_counter("serving.retrace",
+                "serving-engine python traces (init/step/finish); zero "
+                "in steady state and zero from the first request when "
+                "the AOT store warmed the bucket")
+declare_counter("serving.aot.export",
+                "bucket executables exported + persisted via jax.export")
+declare_counter("serving.aot.load",
+                "bucket executables loaded from the AOT store (trace "
+                "latency skipped)")
+declare_counter("serving.aot.error",
+                "AOT export/load failures degraded to plain tracing")
+declare_counter("serving.deadline_action.partial",
+                "expired in-flight requests completed with their "
+                "current iterate")
+declare_counter("serving.deadline_action.reject",
+                "expired requests completed with the zero/initial "
+                "iterate (reject action)")
+declare_gauge("serving.queue_depth",
+              "requests waiting for a bucket slot")
+declare_gauge("serving.inflight",
+              "requests currently occupying bucket slots")
+declare_gauge("serving.live_buckets",
+              "live serving buckets (each: hierarchy + engine traces)")
+declare_gauge("serving.cache.bytes",
+              "estimated device bytes held by live serving buckets")
 
 # device-memory watermarks per phase (memory_info allocator statistics
 # sampled at phase boundaries; the backend's own peak_bytes_in_use is

@@ -65,6 +65,14 @@ DECLARED_SPANS: Tuple[str, ...] = (
     # into the amg.* accounted fraction)
     "ship.cast_put",
     "ship.resolve_stragglers",
+    # serving subsystem (amgx_tpu/serving/): the scheduler's cycle
+    # phases + the AOT store round-trips
+    "serving.step",
+    "serving.admit",
+    "serving.finalize",
+    "serving.bucket_build",
+    "serving.aot_export",
+    "serving.aot_load",
     # solver-tree entry points (dynamic solver names: CG.solve, ...).
     # NO catch-all patterns belong here: a `<anything>.*` entry would
     # let any typo'd two-segment name pass the static registry check

@@ -687,6 +687,124 @@ def bench_batched(n: int = 32, batch_sizes=(1, 8, 32), reps: int = 3):
     return out
 
 
+def bench_serving(n: int = 32, smoke: bool = False,
+                  aot_dir: str = None):
+    """Serving phase (amgx_tpu/serving/): a synthetic OPEN-LOOP load —
+    arrivals follow a fixed schedule, independent of completions —
+    against the continuous-batching solve service. Traffic shape: a
+    hot tenant streaming same-pattern systems with per-request value
+    perturbations (the hierarchy-cache + value-resetup steady state), a
+    cold tenant submitting a second pattern, and a slice of
+    impossible-deadline requests that must complete with
+    DEADLINE_EXCEEDED rather than stall their bucket.
+
+    Two service processes are simulated: a WARMUP service traces the
+    buckets and exports them to the AOT store, then a fresh MEASURED
+    service starts from that store — so `retraces_after_warmup` counts
+    the python traces a restarted production service would pay (the
+    acceptance gate is ZERO). Figures of merit: sustained solves/sec
+    over the measured window, p50/p99 submit-to-complete latency, the
+    cache-hit rate and the setup-routing proof (value-resetups vs full
+    setups during the window)."""
+    import tempfile
+    from amgx_tpu.presets import SERVING_CG
+    from amgx_tpu.serving import SolveService
+    from amgx_tpu.telemetry import metrics as _tm
+    from amgx_tpu.resilience.status import SolveStatus
+
+    if smoke:
+        n, n_requests, arrival_dt = 10, 14, 0.0
+    else:
+        n_requests, arrival_dt = 60, 0.002
+    if aot_dir is None:
+        aot_dir = tempfile.mkdtemp(prefix="amgx_serving_aot_")
+    cfg = Config.from_string(
+        SERVING_CG + f", serving_bucket_slots=4, serving_chunk_iters=4,"
+        f" serving_aot_dir={aot_dir}")
+
+    hot = amgx.gallery.poisson("7pt", n, n, n).init()
+    cold = amgx.gallery.poisson("7pt", n + 2, n + 2, n + 2).init()
+    rng = np.random.default_rng(11)
+
+    def shifted(A, c):
+        vals = np.asarray(A.values).copy()
+        vals[np.asarray(A.diag_idx)] += c
+        return A.with_values(vals)
+
+    # request schedule: (matrix, rhs, tenant, deadline). ~1/5 of the
+    # traffic is the cold pattern, every 7th hot request carries an
+    # already-expired deadline
+    sched = []
+    for i in range(n_requests):
+        if i % 5 == 4:
+            sched.append((cold, rng.standard_normal(cold.num_rows),
+                          "cold", None))
+        else:
+            A_i = shifted(hot, 0.1 * (i % 3))
+            dl = 0.0 if i % 7 == 3 else None
+            sched.append((A_i, rng.standard_normal(hot.num_rows),
+                          "hot", dl))
+
+    # warmup service: builds both buckets, traces, exports to the store
+    warm = SolveService(cfg)
+    for A_i, b_i, tn, _dl in (sched[0], sched[4]):  # one per pattern
+        warm.submit(A_i, b_i, tenant=tn)
+    warm.drain(timeout_s=600)
+
+    # measured service: a "restarted process" starting from the store
+    base = _tm.snapshot()
+    svc = SolveService(cfg)
+    tickets = []
+    t_start = time.perf_counter()
+    next_i = 0
+    while next_i < len(sched) or not svc.idle:
+        now = time.perf_counter() - t_start
+        while next_i < len(sched) and now >= next_i * arrival_dt:
+            A_i, b_i, tn, dl = sched[next_i]
+            tickets.append(svc.submit(A_i, b_i, tenant=tn,
+                                      deadline_s=dl))
+            next_i += 1
+        svc.step()
+        if time.perf_counter() - t_start > 600:   # pragma: no cover
+            break
+    window_s = time.perf_counter() - t_start
+
+    cur = _tm.snapshot()
+
+    def delta(name):
+        return int(cur.get(name, 0) - base.get(name, 0))
+
+    lat_ms = sorted(1e3 * t.latency_s for t in tickets if t.done
+                    and t.deadline_t is None)
+    n_solved = len(lat_ms)
+    dl_tickets = [t for t in tickets if t.deadline_t is not None]
+    dl_ok = all(
+        t.done and t.result.status_code
+        == int(SolveStatus.DEADLINE_EXCEEDED) for t in dl_tickets)
+    hits, misses = delta("serving.cache.hit"), delta("serving.cache.miss")
+    out = {
+        "grid": f"{n}^3 poisson7pt (+ {n + 2}^3 cold pattern)",
+        "requests": len(tickets),
+        "window_s": round(window_s, 3),
+        "solves_per_s": round(n_solved / max(window_s, 1e-9), 2),
+        "p50_ms": round(lat_ms[len(lat_ms) // 2], 2) if lat_ms else -1,
+        "p99_ms": round(lat_ms[min(len(lat_ms) - 1,
+                                   int(0.99 * len(lat_ms)))], 2)
+        if lat_ms else -1,
+        "cache_hit_rate": round(hits / max(hits + misses, 1), 3),
+        "value_resetups_routed": delta("amg.resetup.value"),
+        "full_setups": delta("amg.setup.full"),
+        "retraces_after_warmup": delta("serving.retrace"),
+        "aot_loads": delta("serving.aot.load"),
+        "deadline_requests": len(dl_tickets),
+        "deadline_miss": delta("serving.deadline_miss"),
+        "deadline_statuses_ok": bool(dl_ok),
+        "all_completed": bool(all(t.done for t in tickets)),
+        "smoke": bool(smoke),
+    }
+    return out
+
+
 def bench_resilience(n: int = 32, iters: int = 300, reps: int = 9):
     """Resilience smoke phase: per-iteration cost of the guarded solve
     loop (health_guards=1, the default: NaN/breakdown/divergence
@@ -990,6 +1108,32 @@ def main():
         extra["batched_error"] = str(e)[:200]
     gc.collect()
 
+    # serving phase: open-loop load against the continuous-batching
+    # solve service — sustained solves/sec, p50/p99 latency, cache-hit
+    # rate, zero-retrace-after-AOT and deadline-miss proof (nested
+    # payload -> artifact; scalar headlines -> compact line)
+    try:
+        old = signal.signal(signal.SIGALRM, _on_alarm)
+        signal.alarm(240)
+        try:
+            sv = bench_serving()
+            extra["serving"] = sv
+            extra["serving_solves_per_s"] = sv["solves_per_s"]
+            extra["serving_p50_ms"] = sv["p50_ms"]
+            extra["serving_p99_ms"] = sv["p99_ms"]
+            extra["serving_cache_hit_rate"] = sv["cache_hit_rate"]
+            extra["serving_retraces_after_warmup"] = \
+                sv["retraces_after_warmup"]
+            extra["serving_deadline_ok"] = sv["deadline_statuses_ok"]
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)
+    except _Budget:  # pragma: no cover - timing dependent
+        extra["serving_error"] = "wall-clock budget exceeded"
+    except Exception as e:  # pragma: no cover - bench robustness
+        extra["serving_error"] = str(e)[:200]
+    gc.collect()
+
     # resilience smoke phase: guarded vs unguarded iteration-loop cost
     # (BENCH_* tracks that the health guards stay within 2% of baseline)
     try:
@@ -1215,6 +1359,32 @@ if __name__ == "__main__":
             "vs_baseline": 0.0,
             "artifact": "BENCH_obs.json",
             "extra": compact,
+        }), flush=True)
+    elif sys.argv[1:2] == ["serving"]:
+        # standalone serving phase: `python bench.py serving` (full) or
+        # `python bench.py serving --smoke` (the tier-1 fast path:
+        # tiny grids, arrival schedule collapsed)
+        amgx.initialize()
+        res = bench_serving(smoke="--smoke" in sys.argv[2:])
+        try:
+            import os
+            art = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BENCH_serving.json")
+            with open(art, "w") as f:
+                json.dump(res, f, indent=1)
+                f.write("\n")
+        except Exception as e:  # pragma: no cover - bench robustness
+            res["artifact_error"] = str(e)[:120]
+        print(json.dumps({
+            "metric": "serving sustained throughput under open-loop "
+                      "load (continuous batching)",
+            "value": res["solves_per_s"],
+            "unit": "solves/s",
+            "vs_baseline": 0.0,
+            "artifact": "BENCH_serving.json",
+            "extra": {k: v for k, v in res.items()
+                      if not isinstance(v, (dict, list))},
         }), flush=True)
     elif sys.argv[1:] == ["resilience"]:
         # standalone smoke phase: `python bench.py resilience`

@@ -1,0 +1,501 @@
+"""Serving subsystem tests (amgx_tpu/serving/): chunked-solve parity,
+continuous-batching parity vs one-shot solve_many, slot refill without
+retrace, per-tenant deadlines (expiry -> DEADLINE_EXCEEDED, never a
+hung bucket), hierarchy-cache routing to value-resetup, bytes-budgeted
+eviction, AOT round-trip with zero retraces, batcher fairness/LRU
+satellites, and the capi + bench surfaces. No reference analog — AMGX
+is consumed AS a service library; the service loop itself is new."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import amgx_tpu as amgx
+from amgx_tpu import gallery
+from amgx_tpu.batch import BatchedSolver, RequestBatcher
+from amgx_tpu.batch.queue import pattern_fingerprint
+from amgx_tpu.config import Config
+from amgx_tpu.presets import BATCHED_CG, SERVING_CG
+from amgx_tpu.resilience.policy import parse_fallback_policy
+from amgx_tpu.resilience.status import (SolveStatus, status_string,
+                                        to_amgx_status)
+from amgx_tpu.serving import (HierarchyCache, SolveService,
+                              solve_data_bytes)
+from amgx_tpu.solvers.base import Solver
+from amgx_tpu.telemetry import metrics
+
+amgx.initialize()
+
+
+@pytest.fixture(scope="module")
+def poisson16():
+    return gallery.poisson("5pt", 16, 16).init()
+
+
+@pytest.fixture(scope="module")
+def geo10():
+    return gallery.poisson("7pt", 10, 10, 10).init()
+
+
+def _shift(A, c):
+    vals = np.asarray(A.values).copy()
+    vals[np.asarray(A.diag_idx)] += c
+    return A.with_values(vals)
+
+
+def _rhs(A, seed=0):
+    return np.random.default_rng(seed).standard_normal(A.num_rows)
+
+
+def _svc_cfg(base=BATCHED_CG, extra=""):
+    return Config.from_string(
+        base + ", serving_bucket_slots=2, serving_chunk_iters=4"
+        + (", " + extra if extra else ""))
+
+
+def _key(A, b):
+    return f"{pattern_fingerprint(A)}/{np.asarray(b).dtype}"
+
+
+# ---------------------------------------------------------------------------
+# chunked solve entry
+# ---------------------------------------------------------------------------
+
+
+def test_chunk_fns_match_one_shot_solve(poisson16):
+    """Stepping the chunked entry to completion reproduces solve()
+    exactly: same iterates, same packed stats, bit-identical x."""
+    slv = amgx.create_solver(Config.from_string(BATCHED_CG))
+    slv.setup(poisson16)
+    b = _rhs(poisson16, 1)
+    ref = slv.solve(b)
+    init, step, fin = slv._build_chunk_fns(3)
+    data = slv.solve_data()
+    bj = jnp.asarray(b)
+    st = jax.jit(init)(data, bj, jnp.zeros_like(bj))
+    jstep = jax.jit(step)
+    for _ in range(100):
+        st = jstep(data, bj, st)
+        if bool(st["done"]) or int(st["iters"]) >= slv.max_iters:
+            break
+    x, stats = jax.jit(fin)(data, bj, st)
+    it, cv, sc, n0, rn, hist = Solver.unpack_stats(
+        stats, slv.max_iters + 1)
+    assert it == ref.iterations and cv == ref.converged
+    assert sc == ref.status_code
+    np.testing.assert_array_equal(np.asarray(x), np.asarray(ref.x))
+    np.testing.assert_allclose(rn, ref.res_norm, rtol=1e-12)
+
+
+def test_chunk_window_is_per_system_relative(poisson16):
+    """A chunk advances at most `chunk` iterations from the ENTRY
+    count, whatever iteration the system resumed at."""
+    slv = amgx.create_solver(Config.from_string(BATCHED_CG))
+    slv.setup(poisson16)
+    b = jnp.asarray(_rhs(poisson16, 2))
+    init, step, _fin = slv._build_chunk_fns(5)
+    st = jax.jit(init)(slv.solve_data(), b, jnp.zeros_like(b))
+    st = jax.jit(step)(slv.solve_data(), b, st)
+    assert int(st["iters"]) == 5
+    st = jax.jit(step)(slv.solve_data(), b, st)
+    assert int(st["iters"]) == 10
+
+
+# ---------------------------------------------------------------------------
+# continuous batching parity + refill
+# ---------------------------------------------------------------------------
+
+
+def test_service_parity_vs_one_shot_solve_many(poisson16):
+    """Continuous batching delivers the same per-system iterates as a
+    one-shot batched solve_many over the same systems (same hierarchy
+    structure, same while_loop body — only the chunking differs)."""
+    mats = [_shift(poisson16, 0.3 * i) for i in range(4)]
+    bs_rhs = np.stack([_rhs(poisson16, i) for i in range(4)])
+    svc = SolveService(_svc_cfg())
+    tickets = [svc.submit(m, b) for m, b in zip(mats, bs_rhs)]
+    svc.drain(timeout_s=300)
+    one = BatchedSolver(Config.from_string(BATCHED_CG))
+    one.setup(mats[0])
+    ref = one.solve_many(bs_rhs, matrices=mats)
+    assert ref.all_converged
+    for i, t in enumerate(tickets):
+        assert t.done and t.result.converged
+        assert t.result.iterations == int(ref.iterations[i])
+        np.testing.assert_allclose(np.asarray(t.result.x),
+                                   np.asarray(ref.x[i]),
+                                   rtol=1e-12, atol=1e-12)
+
+
+def test_slot_refill_without_retrace(poisson16):
+    """5 systems through a 2-slot bucket: drained slots are refilled
+    mid-flight and the engine's three functions trace exactly once."""
+    mats = [_shift(poisson16, 0.2 * i) for i in range(5)]
+    base = metrics.get("serving.retrace")
+    svc = SolveService(_svc_cfg())
+    tickets = [svc.submit(m, _rhs(m, i)) for i, m in enumerate(mats)]
+    svc.drain(timeout_s=300)
+    assert all(t.result.converged for t in tickets)
+    assert len(svc.buckets) == 1
+    eng = svc.buckets.peek(tickets[0].fingerprint)
+    assert eng.slots == 2 and eng.idle
+    assert eng.trace_count == 3          # init1 / step / finish, once
+    assert metrics.get("serving.retrace") - base == 3
+
+
+def test_background_build_failure_rejects_tickets(poisson16):
+    """A bucket build that raises on a builder thread rejects the
+    queued tickets (BREAKDOWN + .error) instead of retrying forever
+    or killing the scheduler."""
+    cfg = _svc_cfg(extra="scaling=DIAGONAL_SYMMETRIC")  # engine refuses
+    svc = SolveService(cfg)
+    svc.start()
+    try:
+        t = svc.submit(poisson16, _rhs(poisson16, 20))
+        assert t.wait(timeout=300)
+        assert t.result.status_code == int(SolveStatus.BREAKDOWN)
+        assert t.error is not None and "scaling" in str(t.error)
+        assert svc.idle
+    finally:
+        svc.stop()
+
+
+def test_sync_build_failure_rejects_tickets(poisson16):
+    """The inline (no background thread) build-failure path matches
+    the threaded one: tickets complete with BREAKDOWN, step() never
+    raises, the queue never wedges."""
+    svc = SolveService(_svc_cfg(extra="scaling=DIAGONAL_SYMMETRIC"))
+    t = svc.submit(poisson16, _rhs(poisson16, 21))
+    done = svc.step()                  # build fails inside this cycle
+    assert t in done and t.done
+    assert t.result.status_code == int(SolveStatus.BREAKDOWN)
+    assert t.error is not None
+    assert svc.idle and svc.step() == []
+
+
+def test_submit_validates_rhs_length(poisson16):
+    with pytest.raises(Exception, match="rhs length"):
+        SolveService(_svc_cfg()).submit(poisson16, np.ones(7))
+
+
+def test_service_background_thread(poisson16):
+    """The async mode: submit from the caller thread, the scheduler
+    thread completes the ticket."""
+    svc = SolveService(_svc_cfg())
+    svc.start()
+    try:
+        t = svc.submit(poisson16, _rhs(poisson16, 3))
+        assert t.wait(timeout=300) and t.result.converged
+        assert t.latency_s > 0
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# deadlines + admission control
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_inflight_partial_never_hangs(poisson16):
+    """Mid-flight expiry completes the ticket with DEADLINE_EXCEEDED
+    and the current (partial) iterate; the bucket keeps serving."""
+    cfg = _svc_cfg(extra="serving_chunk_iters=1, s:tolerance=1e-14")
+    svc = SolveService(cfg)
+    b = _rhs(poisson16, 4)
+    miss0 = metrics.get("serving.deadline_miss")
+    t = svc.submit(poisson16, b, tenant="late", deadline_s=1e9)
+    svc.step()                       # admitted + one cycle
+    assert not t.done
+    t.deadline_t = 0.0               # force expiry at the next boundary
+    svc.step()
+    assert t.done
+    assert t.result.status_code == int(SolveStatus.DEADLINE_EXCEEDED)
+    assert t.result.status == "deadline_exceeded"
+    assert not t.result.converged
+    assert float(np.linalg.norm(np.asarray(t.result.x))) > 0  # partial
+    assert metrics.get("serving.deadline_miss") - miss0 == 1
+    assert svc.stats()["tenants"]["late"]["deadline_miss"] == 1
+    # the bucket is not hung: the next request completes normally
+    t2 = svc.submit(poisson16, b)
+    svc.drain(timeout_s=300)
+    assert t2.result.converged
+
+
+def test_deadline_queued_expiry_rejects(poisson16):
+    """A request that expires while still queued never touches a slot:
+    it completes with DEADLINE_EXCEEDED and the initial iterate."""
+    svc = SolveService(_svc_cfg())
+    t = svc.submit(poisson16, _rhs(poisson16, 5), deadline_s=0.0)
+    svc.step()
+    assert t.done and t.result.iterations == 0
+    assert t.result.status_code == int(SolveStatus.DEADLINE_EXCEEDED)
+    assert float(np.linalg.norm(np.asarray(t.result.x))) == 0
+
+
+def test_deadline_action_reject_returns_initial_iterate(poisson16):
+    """serving_deadline_action=reject: an expired in-flight request
+    completes with the initial iterate, not the partial one."""
+    cfg = _svc_cfg(extra="serving_deadline_action=reject, "
+                         "serving_chunk_iters=1, s:tolerance=1e-14")
+    svc = SolveService(cfg)
+    t = svc.submit(poisson16, _rhs(poisson16, 6), deadline_s=1e9)
+    svc.step()
+    t.deadline_t = 0.0
+    svc.step()
+    assert t.done
+    assert t.result.status_code == int(SolveStatus.DEADLINE_EXCEEDED)
+    assert float(np.linalg.norm(np.asarray(t.result.x))) == 0
+
+
+def test_admission_control_queue_bound(poisson16):
+    """serving_max_queue: over-budget submits complete immediately
+    with DEADLINE_EXCEEDED instead of growing the queue."""
+    svc = SolveService(_svc_cfg(extra="serving_max_queue=1"))
+    rej0 = metrics.get("serving.rejected")
+    t1 = svc.submit(poisson16, _rhs(poisson16, 7))
+    t2 = svc.submit(poisson16, _rhs(poisson16, 8))
+    assert not t1.done
+    assert t2.done and t2.result.status_code == \
+        int(SolveStatus.DEADLINE_EXCEEDED)
+    assert metrics.get("serving.rejected") - rej0 == 1
+    svc.drain(timeout_s=300)
+    assert t1.result.converged
+
+
+def test_deadline_status_in_fallback_grammar():
+    """The new status plugs into the existing policy grammar (with the
+    DEADLINE alias) and the capi status mapping."""
+    pol = parse_fallback_policy("DEADLINE_EXCEEDED>retry")
+    assert pol == {int(SolveStatus.DEADLINE_EXCEEDED):
+                   [("retry", "")]}
+    assert parse_fallback_policy("DEADLINE>retry") == pol
+    assert status_string(SolveStatus.DEADLINE_EXCEEDED) == \
+        "deadline_exceeded"
+    assert to_amgx_status(SolveStatus.DEADLINE_EXCEEDED) == 3
+
+
+# ---------------------------------------------------------------------------
+# hierarchy cache
+# ---------------------------------------------------------------------------
+
+
+def test_cache_hit_routes_to_value_resetup(geo10):
+    """The setup-routing proof: after the bucket exists, every
+    repeat-pattern admit goes through the fused value-resetup (the
+    0.43 s path) — the full-setup counter stays flat."""
+    svc = SolveService(_svc_cfg(base=SERVING_CG))
+    base = metrics.snapshot()
+    t0 = svc.submit(geo10, _rhs(geo10, 0))
+    svc.drain(timeout_s=300)
+    mid = metrics.snapshot()
+    assert mid["amg.setup.full"] - base["amg.setup.full"] == 1
+    assert mid["serving.cache.miss"] - base["serving.cache.miss"] == 1
+    # repeat-pattern, different-values traffic: hits + value-resetups
+    tickets = [svc.submit(_shift(geo10, 0.2 * i), _rhs(geo10, i))
+               for i in range(1, 4)]
+    svc.drain(timeout_s=300)
+    cur = metrics.snapshot()
+    assert all(t.result.converged for t in tickets + [t0])
+    assert cur["amg.setup.full"] == mid["amg.setup.full"]
+    assert cur["amg.resetup.value"] - mid["amg.resetup.value"] >= 3
+    assert cur["serving.cache.hit"] > mid["serving.cache.hit"]
+
+
+def test_cache_eviction_by_bytes(poisson16):
+    """A 1-byte budget keeps at most one idle bucket live: the second
+    pattern evicts the first, with eviction counters + gauges."""
+    ev0 = metrics.get("serving.cache.evictions")
+    svc = SolveService(_svc_cfg(extra="serving_cache_bytes=1"))
+    other = gallery.poisson("5pt", 12, 12).init()
+    svc.submit(poisson16, _rhs(poisson16, 9))
+    svc.drain(timeout_s=300)
+    svc.submit(other, _rhs(other, 10))
+    svc.drain(timeout_s=300)
+    assert len(svc.buckets) == 1
+    assert svc.buckets.evictions >= 1
+    assert metrics.get("serving.cache.evictions") - ev0 >= 1
+    assert metrics.get("serving.live_buckets") == 1
+
+
+def test_cache_never_evicts_busy_or_newest_bucket():
+    """Eviction skips buckets with in-flight slots AND the most
+    recently used entry (a just-built oversized bucket must survive
+    its own insertion); draining the busy one makes it evictable."""
+    class E:
+        def __init__(self, idle):
+            self.idle = idle
+
+    cache = HierarchyCache(budget_bytes=10, counters={},
+                           can_evict=lambda e: e.idle)
+    busy, idle = E(False), E(True)
+    cache.put("busy", busy, nbytes=100)
+    assert "busy" in cache            # newest: survives its own insert
+    cache.put("idle", idle, nbytes=100)
+    assert "busy" in cache and "idle" in cache   # over budget, all held
+    busy.idle = True
+    cache.evict_to_budget()           # now the oldest is evictable
+    assert "busy" not in cache and "idle" in cache
+    assert cache.evictions == 1
+
+
+def test_solve_data_bytes_counts_unique_leaves(poisson16):
+    slv = amgx.create_solver(Config.from_string(BATCHED_CG))
+    slv.setup(poisson16)
+    nb = solve_data_bytes(slv)
+    # at least the fine matrix values must be accounted
+    assert nb >= np.asarray(poisson16.values).nbytes
+    # shared leaves count once
+    leaf = jnp.ones(1000)
+    assert solve_data_bytes([leaf, leaf]) == leaf.nbytes
+
+
+# ---------------------------------------------------------------------------
+# AOT warm paths
+# ---------------------------------------------------------------------------
+
+
+def test_aot_round_trip_zero_retrace(poisson16, tmp_path):
+    """A fresh service against a warmed AOT store solves without a
+    single engine trace (the restart story), with identical results."""
+    cfg = _svc_cfg(extra=f"serving_aot_dir={tmp_path}")
+    b = _rhs(poisson16, 11)
+    exp0 = metrics.get("serving.aot.export")
+    err0 = metrics.get("serving.aot.error")
+    svc1 = SolveService(cfg)
+    t1 = svc1.submit(poisson16, b)
+    svc1.drain(timeout_s=300)
+    assert metrics.get("serving.aot.export") - exp0 == 1
+    assert metrics.get("serving.aot.error") - err0 == 0
+
+    retr0 = metrics.get("serving.retrace")
+    load0 = metrics.get("serving.aot.load")
+    svc2 = SolveService(cfg)           # the "restarted process"
+    t2 = svc2.submit(poisson16, b)
+    svc2.drain(timeout_s=300)
+    assert metrics.get("serving.retrace") - retr0 == 0
+    assert metrics.get("serving.aot.load") - load0 == 1
+    eng = svc2.buckets.peek(t2.fingerprint)
+    assert eng.aot_warm and eng.trace_count == 0
+    assert t2.result.iterations == t1.result.iterations
+    np.testing.assert_array_equal(np.asarray(t2.result.x),
+                                  np.asarray(t1.result.x))
+
+
+# ---------------------------------------------------------------------------
+# batcher satellites
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_dispatches_oldest_first(poisson16):
+    """drain() orders buckets by earliest pending submit, not by
+    pending-map insertion: the longest-waiting request's bucket goes
+    first even when a hot fingerprint entered the map before it."""
+    rb = RequestBatcher(Config.from_string(BATCHED_CG), max_buckets=4)
+    cold_A = gallery.poisson("5pt", 12, 12).init()
+    hot = [rb.submit(poisson16, _rhs(poisson16, i)) for i in range(3)]
+    cold = rb.submit(cold_A, _rhs(cold_A, 3))
+    # simulate the cold request having waited longest
+    cold.submit_t = hot[0].submit_t - 1.0
+    rb.drain()
+    assert all(r.done for r in hot + [cold])
+    assert rb.dispatch_log[0][0] == cold.fingerprint
+    assert rb.dispatch_log[1][0] == hot[0].fingerprint
+
+
+def test_batcher_bytes_lru_bound(poisson16):
+    """max_bucket_bytes bounds the solver store; evictions surface
+    through the telemetry counter and the live_buckets property."""
+    ev0 = metrics.get("batch.bucket_evictions")
+    rb = RequestBatcher(Config.from_string(BATCHED_CG),
+                        max_buckets=8, max_bucket_bytes=1)
+    other = gallery.poisson("5pt", 12, 12).init()
+    rb.submit(poisson16, _rhs(poisson16, 0))
+    rb.drain()
+    assert rb.live_buckets == 1
+    rb.submit(other, _rhs(other, 1))
+    rb.drain()
+    assert rb.live_buckets == 1          # first bucket evicted
+    assert rb.bucket_evictions >= 1
+    assert metrics.get("batch.bucket_evictions") - ev0 >= 1
+    assert metrics.get("batch.live_buckets") == 1
+
+
+# ---------------------------------------------------------------------------
+# capi surface
+# ---------------------------------------------------------------------------
+
+
+def test_capi_service_roundtrip(poisson16):
+    from amgx_tpu import capi
+    assert capi.AMGX_initialize() == 0
+    rc, cfg_h = capi.AMGX_config_create(
+        BATCHED_CG + ", serving_bucket_slots=2")
+    assert rc == 0
+    rc, rsrc_h = capi.AMGX_resources_create_simple(cfg_h)
+    assert rc == 0
+    rc, svc_h = capi.AMGX_service_create(rsrc_h, "dDDI", cfg_h)
+    assert rc == 0
+    rc, m_h = capi.AMGX_matrix_create(rsrc_h, "dDDI")
+    rc, b_h = capi.AMGX_vector_create(rsrc_h, "dDDI")
+    rc, x_h = capi.AMGX_vector_create(rsrc_h, "dDDI")
+    ro = np.asarray(poisson16.row_offsets)
+    ci = np.asarray(poisson16.col_indices)
+    v = np.asarray(poisson16.values)
+    assert capi.AMGX_matrix_upload_all(
+        m_h, poisson16.num_rows, v.size, 1, 1, ro, ci, v, None) == 0
+    b = _rhs(poisson16, 12)
+    assert capi.AMGX_vector_upload(b_h, b.size, 1, b) == 0
+    rc, tkt = capi.AMGX_service_submit(svc_h, m_h, b_h, "acme", None)
+    assert rc == 0
+    rc, done, st = capi.AMGX_service_ticket_status(tkt)
+    assert rc == 0 and done == 0 and st is None
+    rc, n_done = capi.AMGX_service_drain(svc_h, 300)
+    assert rc == 0 and n_done == 1
+    rc, done, st = capi.AMGX_service_ticket_status(tkt)
+    assert rc == 0 and done == 1 and st == 0      # AMGX_SOLVE_SUCCESS
+    assert capi.AMGX_service_ticket_download(tkt, x_h) == 0
+    rc, x = capi.AMGX_vector_download(x_h)
+    assert rc == 0 and x.shape == (poisson16.num_rows,)
+    rc, stats = capi.AMGX_service_stats(svc_h)
+    assert rc == 0 and stats["tenants"]["acme"]["completed"] == 1
+    assert capi.AMGX_service_ticket_destroy(tkt) == 0
+    assert capi.AMGX_service_destroy(svc_h) == 0
+
+
+# ---------------------------------------------------------------------------
+# telemetry catalog + bench smoke
+# ---------------------------------------------------------------------------
+
+
+def test_serving_metrics_declared():
+    snap = metrics.snapshot()
+    for name in ("serving.requests", "serving.completed",
+                 "serving.rejected", "serving.deadline_miss",
+                 "serving.cache.hit", "serving.cache.miss",
+                 "serving.cache.evictions", "serving.retrace",
+                 "serving.aot.export", "serving.aot.load",
+                 "serving.aot.error", "batch.bucket_evictions"):
+        assert name in snap
+
+
+def test_bench_serving_smoke():
+    """The `bench.py serving --smoke` fast path: the tier-1-runnable
+    slice of the acceptance gates (cache-hit rate > 0, value-resetup
+    routing, zero retraces after AOT warmup, deadline statuses)."""
+    import bench
+    # bench.py switches the process compile-cache dir at import; point
+    # it back at the suite's cache so later tests stay warm
+    jax.config.update("jax_compilation_cache_dir",
+                      "/tmp/amgx_tpu_jax_cache")
+    res = bench.bench_serving(smoke=True)
+    assert res["all_completed"]
+    assert res["solves_per_s"] > 0
+    assert res["p50_ms"] > 0 and res["p50_ms"] <= res["p99_ms"]
+    assert res["cache_hit_rate"] > 0
+    assert res["value_resetups_routed"] > 0
+    assert res["retraces_after_warmup"] == 0
+    assert res["aot_loads"] >= 1
+    assert res["deadline_requests"] > 0
+    assert res["deadline_statuses_ok"]
