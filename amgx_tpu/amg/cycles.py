@@ -13,6 +13,7 @@ import jax.numpy as jnp
 
 from ..ops import blas
 from ..ops.spmv import residual, spmv
+from ..telemetry import diagnostics as _diag
 
 
 def _smooth(level, data, b, x, sweeps: int):
@@ -113,7 +114,14 @@ def _cycle(amg, shape: str, data, lvl: int, b, x):
     levels = amg.levels
     if lvl == len(levels):
         return _coarse_solve(amg, data, b, x)
-    if amg.cycle_fusion:
+    # convergence diagnostics (telemetry/diagnostics.py): while a probe
+    # cycle is being traced, record the level's stage residual norms
+    # and compose the correction/postsmooth boundary explicitly so each
+    # stage exists to measure. `rec` is None for every normal cycle
+    # trace — the probe is a separate trace at the end of the solve
+    # program, so the solve iterations keep their fused kernels.
+    rec = _diag.current()
+    if amg.cycle_fusion and rec is None:
         # VMEM-resident coarse tail: when every level from here down
         # fits VMEM together, the whole sub-cycle (smooth -> restrict
         # -> ... -> coarsest solve -> ... -> prolongate -> smooth) is
@@ -124,8 +132,12 @@ def _cycle(amg, shape: str, data, lvl: int, b, x):
             return out
     level = levels[lvl]
     ldata = data["levels"][lvl]
+    if rec is not None:
+        rec.record(lvl, 0, ldata["A"], x, b)
     x, bc = _smooth_restrict(amg, level, ldata, b, x,
                              amg._sweeps(lvl, pre=True))
+    if rec is not None:
+        rec.record(lvl, 1, ldata["A"], x, b)
     xc = jnp.zeros_like(bc)
     if shape == "V":
         xc = _cycle(amg, "V", data, lvl + 1, bc, xc)
@@ -139,6 +151,12 @@ def _cycle(amg, shape: str, data, lvl: int, b, x):
             xc = _cycle(amg, "V", data, lvl + 1, bc, xc)
     else:
         raise ValueError(f"unknown fixed cycle {shape!r}")
+    if rec is not None:
+        x = x + level.prolongate(ldata, xc)
+        rec.record(lvl, 2, ldata["A"], x, b)
+        x = _smooth(level, ldata, b, x, amg._sweeps(lvl, pre=False))
+        rec.record(lvl, 3, ldata["A"], x, b)
+        return x
     return _prolongate_smooth(amg, level, ldata, b, x, xc,
                               amg._sweeps(lvl, pre=False))
 
@@ -152,8 +170,13 @@ def _kcycle(amg, data, lvl: int, b, x, flex: bool):
         return _coarse_solve(amg, data, b, x)
     level = levels[lvl]
     ldata = data["levels"][lvl]
+    rec = _diag.current()
+    if rec is not None:
+        rec.record(lvl, 0, ldata["A"], x, b)
     x, bc = _smooth_restrict(amg, level, ldata, b, x,
                              amg._sweeps(lvl, pre=True))
+    if rec is not None:
+        rec.record(lvl, 1, ldata["A"], x, b)
     Ac_data_lvl = lvl + 1
 
     def M(v):
@@ -192,6 +215,12 @@ def _kcycle(amg, data, lvl: int, b, x, flex: bool):
         beta = num / jnp.where(rz == 0, 1.0, rz) * (rz != 0)
         rz = rz_new
         p = z + beta * p
+    if rec is not None:
+        x = x + level.prolongate(ldata, xc)
+        rec.record(lvl, 2, ldata["A"], x, b)
+        x = _smooth(level, ldata, b, x, amg._sweeps(lvl, pre=False))
+        rec.record(lvl, 3, ldata["A"], x, b)
+        return x
     return _prolongate_smooth(amg, level, ldata, b, x, xc,
                               amg._sweeps(lvl, pre=False))
 

@@ -146,6 +146,10 @@ class AMG:
             cfg.get("setup_device_min_rows", scope))
         self.convergence_analysis = int(cfg.get("convergence_analysis",
                                                 scope))
+        # convergence diagnostics (telemetry/diagnostics.py): when on,
+        # the solve driver appends one instrumented probe cycle whose
+        # per-level stage norms ride the packed stats
+        self.diagnostics = bool(int(cfg.get("diagnostics", scope)))
         self.levels: List[AMGLevel] = []
         self.coarse_solver = None
         self.setup_time = 0.0
@@ -729,30 +733,71 @@ class AMG:
         return x.astype(out_dtype)
 
     # -- observability ----------------------------------------------------
-    def grid_stats(self) -> str:
-        """Grid-statistics report (print_grid_stats analog,
-        src/amg.cu:1231-1350)."""
-        rows = []
+    @staticmethod
+    def _layout_of(M) -> str:
+        if getattr(M, "dia_vals", None) is not None:
+            return "dia"
+        if getattr(M, "swell_vals", None) is not None:
+            return "swell"
+        if getattr(M, "ell_vals", None) is not None:
+            return "ell"
+        return "csr"
+
+    def grid_stats_dict(self) -> Dict[str, Any]:
+        """Grid statistics as STRUCTURED data (the single source of
+        truth — `grid_stats()` renders its text from this, and it feeds
+        `SolveReport.hierarchy` + the C API's
+        `AMGX_solver_get_grid_stats`). Everything reads host metadata
+        (shapes, layout presence) — building the dict issues no device
+        transfers, so the per-solve report path may call it freely."""
+        mats = [lv.A for lv in self.levels]
+        coarsest = getattr(self, "coarsest_A", None)
+        if coarsest is not None:
+            mats = mats + [coarsest]
+        rows: List[Dict[str, Any]] = []
         total_nnz = 0
         total_rows = 0
-        mats = [lv.A for lv in self.levels] + [self.coarsest_A]
         for i, M in enumerate(mats):
             nnz = M.nnz * M.block_size + (
                 M.num_rows * M.block_size if M.has_external_diag else 0)
-            rows.append((i, M.num_rows, nnz,
-                         nnz / max(M.num_rows, 1) ** 2))
+            rows.append({
+                "level": i,
+                "rows": int(M.num_rows),
+                "nnz": int(nnz),
+                "sparsity": nnz / max(M.num_rows, 1) ** 2,
+                "layout": self._layout_of(M),
+            })
             total_nnz += nnz
             total_rows += M.num_rows
-        fine = mats[0]
-        fine_nnz = rows[0][2]
-        lines = ["AMG Grid:", f"         Number of Levels: {len(mats)}",
+        fine_rows = rows[0]["rows"] if rows else 0
+        fine_nnz = rows[0]["nnz"] if rows else 0
+        return {
+            "algorithm": self.algorithm,
+            "cycle": self.cycle_name,
+            "num_levels": len(mats),
+            "levels": rows,
+            "total_rows": int(total_rows),
+            "total_nnz": int(total_nnz),
+            "grid_complexity": total_rows / max(fine_rows, 1),
+            "operator_complexity": total_nnz / max(fine_nnz, 1),
+        }
+
+    def grid_stats(self) -> str:
+        """Grid-statistics report (print_grid_stats analog,
+        src/amg.cu:1231-1350). Rendered from `grid_stats_dict()` so the
+        text and the structured surface can never drift apart."""
+        d = self.grid_stats_dict()
+        lines = ["AMG Grid:",
+                 f"         Number of Levels: {d['num_levels']}",
                  "            LVL         ROWS               NNZ    SPRSTY",
                  "         " + "-" * 50]
-        for (i, n, nnz, sp) in rows:
-            lines.append(f"           {i:3d}  {n:11d}  {nnz:16d}  {sp:8.3g}")
+        for row in d["levels"]:
+            lines.append(f"           {row['level']:3d}  "
+                         f"{row['rows']:11d}  {row['nnz']:16d}  "
+                         f"{row['sparsity']:8.3g}")
         lines.append("         " + "-" * 50)
         lines.append(f"         Grid Complexity: "
-                     f"{total_rows / max(fine.num_rows, 1):.5g}")
+                     f"{d['grid_complexity']:.5g}")
         lines.append(f"         Operator Complexity: "
-                     f"{total_nnz / max(fine_nnz, 1):.5g}")
+                     f"{d['operator_complexity']:.5g}")
         return "\n".join(lines)

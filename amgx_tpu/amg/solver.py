@@ -57,3 +57,6 @@ class AlgebraicMultigridSolver(Solver):
 
     def grid_stats(self):
         return self.amg.grid_stats()
+
+    def grid_stats_dict(self):
+        return self.amg.grid_stats_dict()

@@ -267,7 +267,9 @@ class BatchedSolver:
 
     # -- solve -----------------------------------------------------------
     def _build_batched_fn(self, data_axes):
-        solve_fn = self.solver._build_solve_fn()
+        # diag=False: the per-row stats unpack below assumes the bare
+        # layout; the diagnostics probe is a single-solve surface
+        solve_fn = self.solver._build_solve_fn(diag=False)
 
         def batched(data, b, x0):
             self.trace_count += 1

@@ -734,13 +734,43 @@ def AMGX_solver_get_report(slv_h):
 
 @_api
 @_outputs(1)
+def AMGX_solver_get_grid_stats(slv_h):
+    """rc, stats dict: the solver tree's AMG grid statistics as
+    STRUCTURED data (AMG.grid_stats_dict(): per-level rows/nnz/layout,
+    grid + operator complexity) — the machine-readable form of the
+    reference's printed grid-statistics table (src/amg.cu:1231-1350;
+    the `print_grid_stats=1` text renders from this same dict). Raises
+    BAD_PARAMETERS when the tree owns no set-up AMG hierarchy."""
+    from .telemetry.report import _amg_of
+    s = _get(slv_h, _CSolver)
+    amg = _amg_of(s.solver)
+    if amg is None or not getattr(amg, "levels", None):
+        raise AMGXError("no set-up AMG hierarchy in the solver tree",
+                        RC.BAD_PARAMETERS)
+    return RC.OK, amg.grid_stats_dict()
+
+
+@_api
+@_outputs(1)
 def AMGX_read_metrics():
     """rc, metrics: snapshot of the process-wide telemetry
-    counter/gauge registry (telemetry/metrics.py) — cache hit/miss,
-    setup-routing, batcher occupancy, fallback events, jit retraces,
-    memory watermarks. Telemetry extension (no reference analog)."""
+    counter/gauge/histogram registry (telemetry/metrics.py) — cache
+    hit/miss, setup-routing, batcher occupancy, fallback events, jit
+    retraces, memory watermarks, latency histograms. Telemetry
+    extension (no reference analog)."""
     from .telemetry import metrics
     return RC.OK, metrics.snapshot()
+
+
+@_api
+@_outputs(1)
+def AMGX_read_metrics_openmetrics():
+    """rc, text: the whole metrics registry as an OpenMetrics text
+    exposition (counters/gauges/histograms, `# EOF`-terminated) — the
+    payload a /metrics scrape endpoint serves to Prometheus-compatible
+    collectors (telemetry/metrics.py to_openmetrics)."""
+    from .telemetry import metrics
+    return RC.OK, metrics.to_openmetrics()
 
 
 @_api

@@ -358,6 +358,17 @@ def _register_default_parameters():
       "monitor's already-returned stats array, so the traced solve "
       "program and its device->host transfer count are IDENTICAL "
       "either way; 0 skips report construction", 1, BOOL01)
+    R("diagnostics", int, "convergence diagnostics "
+      "(telemetry/diagnostics.py): append ONE instrumented probe cycle "
+      "to the traced solve recording per-level residual norms at the "
+      "entry/post-presmooth/post-correction/post-postsmooth cycle "
+      "stages, packed into the stats the monitor already returns (zero "
+      "added device->host transfers); host-side derivation attaches "
+      "per-level reduction factors, smoother effectiveness, an "
+      "asymptotic convergence-factor estimate and a bottleneck-level "
+      "attribution to SolveReport.diagnostics. Cost when on: ~one "
+      "extra cycle's work per solve; 0 (default) compiles a jaxpr "
+      "identical to a pre-diagnostics build", 0, BOOL01)
     R("telemetry_sync", int, "fence device work at every span boundary "
       "(telemetry/spans.py) so host spans bound device occupancy in "
       "the exported Perfetto timeline. Debugging mode: it defeats the "

@@ -301,7 +301,10 @@ class DistributedSolver:
 
     # -- solve -----------------------------------------------------------
     def _build_fn(self):
-        raw = self.solver._build_solve_fn()
+        # diag=False: a sharded probe would record per-shard norms
+        # (needs a psum to mean anything); the stats unpack below
+        # assumes the bare layout
+        raw = self.solver._build_solve_fn(diag=False)
         axis = self.axis
 
         def shard_fn(data, b, x0):

@@ -191,6 +191,12 @@ class SolveService:
         self._tenant(t.tenant)["completed"] += 1
         self._completed_total += 1
         t._complete(result)
+        # per-tenant solve-latency distribution: recorded for EVERY
+        # terminal status (a deadline miss is latency the caller saw
+        # too) so the p50/p99 the scrape reports are honest
+        _tm.observe("serving.solve_latency_s",
+                    t.complete_t - t.submit_t,
+                    labels={"tenant": t.tenant})
 
     def _fail_ticket(self, t: ServiceTicket, err: Exception):
         """Complete a ticket whose bucket build or admission raised:
@@ -338,6 +344,9 @@ class SolveService:
                 if not t.cache_counted:
                     _tm.inc("serving.cache.hit")
                     t.cache_counted = True
+                _tm.observe("serving.queue_wait_s",
+                            time.monotonic() - t.submit_t,
+                            labels={"tenant": t.tenant})
                 try:
                     eng.admit(slot, t.A, t.b, x0=t.x0, occupant=t)
                 except Exception as e:
@@ -471,6 +480,17 @@ class SolveService:
                 "live_buckets": len(self.buckets),
                 "cache_bytes": self.buckets.total_bytes,
                 "evictions": self.buckets.evictions,
+                # live latency quantiles from the process-wide
+                # histograms (all tenants aggregated; per-tenant
+                # series live in metrics.snapshot()/OpenMetrics)
+                "solve_latency_p50_s":
+                    _tm.quantile("serving.solve_latency_s", 0.50),
+                "solve_latency_p99_s":
+                    _tm.quantile("serving.solve_latency_s", 0.99),
+                "queue_wait_p50_s":
+                    _tm.quantile("serving.queue_wait_s", 0.50),
+                "queue_wait_p99_s":
+                    _tm.quantile("serving.queue_wait_s", 0.99),
                 "tenants": {k: dict(v)
                             for k, v in self._tenants.items()},
             }

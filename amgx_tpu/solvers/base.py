@@ -144,6 +144,11 @@ class Solver:
     # layout-only slim view may replace it (KACZMARZ reads COO structure
     # per sweep and opts out)
     slim_A_ok = True
+    # solve_data key under which this solver stores its preconditioner's
+    # subtree (REFINEMENT overrides: it names the child "inner") — the
+    # diagnostics probe walks it to reach the AMG hierarchy's data at
+    # any nesting depth
+    _child_data_key = "precond"
 
     def __init__(self, cfg: Config, scope: str = "default",
                  name: str = "?"):
@@ -420,6 +425,32 @@ class Solver:
         system axis."""
         raise NotImplementedError
 
+    def _diag_probe_spec(self):
+        """(amg, data_keys) when this solver tree owns an AMG hierarchy
+        with convergence diagnostics ON (telemetry/diagnostics.py) —
+        `data_keys` is the solve_data path from this tree's root to the
+        hierarchy's subtree, so the traced driver can hand the probe
+        cycle its data at any preconditioner nesting depth. None when
+        the knob is off, the hierarchy is empty (no smoothed levels to
+        attribute), or the levels are not plain single-chip AMGLevels
+        (sharded hierarchies record per-shard norms that would need a
+        psum — the distributed path builds with diag=False anyway)."""
+        s, keys = self, []
+        for _ in range(8):
+            if s is None:
+                return None
+            amg = getattr(s, "amg", None)
+            if amg is not None:
+                from ..amg.hierarchy import AMGLevel
+                if (getattr(amg, "diagnostics", False) and amg.levels
+                        and all(isinstance(lv, AMGLevel)
+                                for lv in amg.levels)):
+                    return amg, keys + ["amg"]
+                return None
+            keys.append(s._child_data_key)
+            s = s.preconditioner
+        return None
+
     def computes_residual(self) -> bool:
         """True when solve_iteration maintains state['r'] itself; else the
         driver recomputes r = b - Ax for monitoring."""
@@ -450,7 +481,7 @@ class Solver:
         return st["x"]
 
     # -- the jitted driver ----------------------------------------------
-    def _build_solve_fn(self):
+    def _build_solve_fn(self, diag: bool = True):
         """Return the raw (unjitted) solve function; jit happens in
         solve(), and the distributed layer shard_maps it instead.
 
@@ -460,7 +491,18 @@ class Solver:
         state — everything derives from the residual norm the monitor
         already computed (plus the solver-maintained `breakdown` flag),
         so guarded solves add no device->host synchronization per
-        iteration."""
+        iteration.
+
+        Convergence diagnostics (telemetry/diagnostics.py): with the
+        `diagnostics=1` knob on an AMG member of the tree, ONE
+        instrumented probe cycle on the final residual is appended to
+        the traced program and its per-level stage norms ride the SAME
+        packed stats vector — no extra output buffers, no extra
+        transfers. `diag=False` opts a consumer out (the batched vmap
+        and shard_map wrappers, and REFINEMENT's inner fn, whose stats
+        unpacking assumes the bare layout); with the knob off the
+        emitted jaxpr is identical either way."""
+        diag_spec = self._diag_probe_spec() if diag else None
         max_iters = self.max_iters
         monitor = self.monitor_residual
         hist_len = max_iters + 1
@@ -579,13 +621,27 @@ class Solver:
             # at least f32 so iteration counts survive the cast exactly
             # even for bf16/f16 solves
             rdt = jnp.promote_types(jnp.asarray(norm0).dtype, jnp.float32)
-            stats = jnp.concatenate([
+            pieces = [
                 jnp.reshape(final["iters"].astype(rdt), (1,)),
                 jnp.reshape(final["converged"].astype(rdt), (1,)),
                 jnp.reshape(status.astype(rdt), (1,)),
                 jnp.ravel(jnp.asarray(norm0)),
                 jnp.ravel(jnp.asarray(final["res_norm"])),
-                jnp.ravel(jnp.asarray(final["res_hist"]))])
+                jnp.ravel(jnp.asarray(final["res_hist"]))]
+            if diag_spec is not None:
+                # diagnostics probe: one instrumented cycle on the
+                # residual equation A d = r_final, appended INSIDE the
+                # traced program; its stage norms pack onto the stats
+                # tail (_solve_traced strips them by the same spec)
+                from ..telemetry import diagnostics as _dg
+                amg_, keys_ = diag_spec
+                sub = data
+                for k_ in keys_:
+                    sub = sub[k_]
+                r_fin = _residual(A, x_final, b)
+                pieces.append(jnp.ravel(
+                    _dg.probe_cycle(amg_, sub, r_fin, rdt)))
+            stats = jnp.concatenate(pieces)
             return x_final, stats
 
         return solve_fn
@@ -792,6 +848,18 @@ class Solver:
         if self.scaler is not None:
             x = self.scaler.from_scaled_x(x)
         solve_time = time.perf_counter() - t0
+        # diagnostics probe output rides the stats tail (same buffer,
+        # no extra transfer); strip it by the same spec the trace used
+        # before the bare-layout unpack
+        diag_spec = self._diag_probe_spec()
+        diag_raw = None
+        stats = np.asarray(stats)
+        if diag_spec is not None:
+            from ..telemetry import diagnostics as _dg
+            dlen = _dg.slots_len(diag_spec[0])
+            if dlen:
+                diag_raw = stats[stats.size - dlen:]
+                stats = stats[:stats.size - dlen]
         iters_i, converged, status, norm0, res_norm, hist = \
             self.unpack_stats(stats, self.max_iters + 1)
         res = SolveResult(
@@ -807,7 +875,14 @@ class Solver:
             # metadata — no device data is touched
             from ..memory_info import peak_bytes
             from ..telemetry import build_report, metrics as _tm
-            res.report = build_report(self, res, hist=np.asarray(hist))
+            diag_struct = None
+            if diag_raw is not None:
+                from ..telemetry import diagnostics as _dg
+                diag_struct = _dg.derive(
+                    diag_raw, len(diag_spec[0].levels),
+                    res_hist=np.asarray(hist))
+            res.report = build_report(self, res, hist=np.asarray(hist),
+                                      diagnostics=diag_struct)
             _tm.max_gauge("memory.solve_peak_bytes", peak_bytes())
         if self.print_solve_stats:
             self._print_stats(res, np.asarray(hist))

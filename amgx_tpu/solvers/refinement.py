@@ -44,6 +44,9 @@ class RefinementSolver(Solver):
     is_smoother = False
     uses_preconditioner = True
     inner_dtype = jnp.float32
+    # solve_data stores the child tree under "inner" (not the base's
+    # "precond") — the diagnostics probe walks this key
+    _child_data_key = "inner"
 
     def precond_operator(self, A):
         # the inner chain (and its own preconditioner tree, e.g. the AMG
@@ -56,7 +59,10 @@ class RefinementSolver(Solver):
             raise BadParametersError(
                 "REFINEMENT needs an inner solver in the `preconditioner` "
                 "role (e.g. preconditioner(in)=FGMRES)")
-        self._inner_fn = self.preconditioner._build_solve_fn()
+        # diag=False: the inner fn's stats are discarded each outer step
+        # (only d matters); the diagnostics probe belongs to the OUTER
+        # driver, which walks the tree to the AMG itself
+        self._inner_fn = self.preconditioner._build_solve_fn(diag=False)
 
     def solve_data(self):
         # overrides the base: the inner data is the f32 solve tree; the

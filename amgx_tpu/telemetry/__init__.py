@@ -4,10 +4,19 @@ One place every layer reports into (the reference exposes the same
 information through nvtx ranges, the AMGX_timer tree, and the verbose
 solve tables; ours is structured and machine-readable):
 
-- `telemetry.metrics` — process-wide counter/gauge registry (cache
-  hit/miss, setup-routing, batcher occupancy, fallback events, jit
-  retraces, memory watermarks); dump with `metrics.snapshot()` or the
-  C API's `AMGX_read_metrics`.
+- `telemetry.metrics` — process-wide counter/gauge/histogram registry
+  (cache hit/miss, setup-routing, batcher occupancy, fallback events,
+  jit retraces, memory watermarks, per-tenant serving-latency
+  distributions); dump with `metrics.snapshot()` / the C API's
+  `AMGX_read_metrics`, or scrape the whole registry as an OpenMetrics
+  text exposition (`metrics.to_openmetrics()` /
+  `AMGX_read_metrics_openmetrics`).
+- `telemetry.diagnostics` — opt-in convergence diagnostics
+  (`diagnostics=1`): an in-trace probe cycle records per-level
+  residual norms at the cycle stages, and host-side derivation turns
+  them into reduction factors, smoother effectiveness, an asymptotic
+  convergence-factor estimate and a bottleneck-level attribution on
+  `SolveReport.diagnostics`.
 - `telemetry.spans` — hierarchical host spans behind
   `profiling.trace_region`, exported as Chrome/Perfetto trace-event
   JSON (`spans.export_chrome_trace`); `telemetry_sync=1` fences device
@@ -26,5 +35,5 @@ way, so `telemetry=0` and `telemetry=1` compile identical XLA).
 """
 from __future__ import annotations
 
-from . import metrics, spans  # noqa: F401
+from . import diagnostics, metrics, spans  # noqa: F401
 from .report import SolveReport, build_report, validate_report  # noqa: F401

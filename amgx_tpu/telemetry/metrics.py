@@ -13,13 +13,20 @@ Design rules:
   undeclared name raises with a did-you-mean suggestion instead of
   silently forking a typo'd time series;
 - counters are monotonic within a process (`inc`); gauges are
-  last-value (`set_gauge`) or high-water (`max_gauge`);
+  last-value (`set_gauge`) or high-water (`max_gauge`); histograms are
+  fixed-bucket-edge distributions (`observe`) with optional labels
+  (per-tenant latency series) and bucket-interpolated quantiles
+  (`quantile`) so p50/p99 are live service state, not bench-only;
 - recording is a dict update under one lock — cheap enough to stay
   unconditional (the `telemetry` config knob gates report construction
   and span fencing, not counter arithmetic);
 - `snapshot()` returns a plain dict (JSON-ready) of every metric that
-  has been touched, plus zeros for declared-but-untouched counters so a
-  dump always has a stable key set.
+  has been touched, plus zeros for declared-but-untouched counters and
+  empty histograms so a dump always has a stable key set;
+- `to_openmetrics()` renders the whole registry as an OpenMetrics text
+  exposition (`# EOF`-terminated, Prometheus-compatible) — the payload
+  a /metrics scrape endpoint serves; reachable from the C API as
+  `AMGX_read_metrics_openmetrics`.
 
 Instrumented sites (see the declarations below for the full catalog):
 the GEO Galerkin structure-cache (amg/aggregation/galerkin.py), the
@@ -31,16 +38,21 @@ distributed/solver.py), and device-memory watermarks per phase
 """
 from __future__ import annotations
 
+import bisect
 import threading
-from typing import Dict, Union
+from typing import Dict, Optional, Tuple, Union
 
 _lock = threading.Lock()
 _counters: Dict[str, int] = {}
 _gauges: Dict[str, float] = {}
+# (name, sorted-label-items tuple) -> {"counts": [..], "sum": ., "count": .}
+_hists: Dict[Tuple[str, tuple], dict] = {}
 
 # name -> doc; the declaration IS the catalog
 COUNTERS: Dict[str, str] = {}
 GAUGES: Dict[str, str] = {}
+HISTOGRAMS: Dict[str, str] = {}
+HISTOGRAM_EDGES: Dict[str, tuple] = {}
 
 
 def declare_counter(name: str, doc: str):
@@ -49,6 +61,21 @@ def declare_counter(name: str, doc: str):
 
 def declare_gauge(name: str, doc: str):
     GAUGES[name] = doc
+
+
+def declare_histogram(name: str, doc: str, edges):
+    """Declare a histogram with FIXED bucket upper bounds (`le`
+    semantics: bucket i counts samples <= edges[i]; one implicit
+    overflow bucket past the last edge). Edges are part of the
+    declaration — every process observes into the same buckets, so
+    snapshots merge across runs."""
+    edges = tuple(float(e) for e in edges)
+    if not edges or list(edges) != sorted(set(edges)):
+        raise ValueError(
+            f"histogram {name!r}: edges must be strictly increasing, "
+            f"got {edges}")
+    HISTOGRAMS[name] = doc
+    HISTOGRAM_EDGES[name] = edges
 
 
 def _unknown(name: str, catalog: Dict[str, str], kind: str):
@@ -81,33 +108,242 @@ def max_gauge(name: str, value: Union[int, float]):
         _gauges[name] = max(_gauges.get(name, value), value)
 
 
-def get(name: str) -> Union[int, float]:
-    """Current value (0 for a declared counter/gauge never touched)."""
+def _label_key(labels: Optional[Dict[str, str]]) -> tuple:
+    return tuple(sorted((str(k), str(v))
+                        for k, v in (labels or {}).items()))
+
+
+def observe(name: str, value: Union[int, float],
+            labels: Optional[Dict[str, str]] = None):
+    """Fold one sample into a declared histogram. `labels` splits the
+    series (e.g. {"tenant": ...} for per-tenant latency); each label
+    set keeps its own buckets, and quantile()/snapshot() can aggregate
+    across them."""
+    if name not in HISTOGRAMS:
+        _unknown(name, HISTOGRAMS, "histogram")
+    edges = HISTOGRAM_EDGES[name]
+    v = float(value)
+    idx = bisect.bisect_left(edges, v)    # first edge >= v (le bucket)
+    key = (name, _label_key(labels))
+    with _lock:
+        h = _hists.get(key)
+        if h is None:
+            h = _hists[key] = {"counts": [0] * (len(edges) + 1),
+                               "sum": 0.0, "count": 0}
+        h["counts"][idx] += 1
+        h["sum"] += v
+        h["count"] += 1
+
+
+def _merged_hist(name: str):
+    """Aggregate one histogram's label variants (caller holds _lock)."""
+    edges = HISTOGRAM_EDGES[name]
+    counts = [0] * (len(edges) + 1)
+    total, n = 0.0, 0
+    for (nm, _lk), h in _hists.items():
+        if nm != name:
+            continue
+        for i, c in enumerate(h["counts"]):
+            counts[i] += c
+        total += h["sum"]
+        n += h["count"]
+    return counts, total, n
+
+
+def _quantile_from_counts(edges, counts, q: float) -> Optional[float]:
+    """Bucket-interpolated quantile: find the bucket holding the q-th
+    sample, linearly interpolate within its [lower, upper] edge span
+    (lower = 0 for the first bucket; the overflow bucket reports the
+    last edge — the estimate saturates at the declared range)."""
+    n = sum(counts)
+    if n == 0:
+        return None
+    target = q * n
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= target:
+            if i >= len(edges):
+                return float(edges[-1])
+            lo = 0.0 if i == 0 else edges[i - 1]
+            hi = edges[i]
+            frac = (target - (cum - c)) / max(c, 1)
+            return float(lo + (hi - lo) * frac)
+    return float(edges[-1])
+
+
+def quantile(name: str, q: float,
+             labels: Optional[Dict[str, str]] = None
+             ) -> Optional[float]:
+    """Estimated q-quantile of a declared histogram (None = no
+    samples). labels=None aggregates every label variant; a labels dict
+    reads that one series."""
+    if name not in HISTOGRAMS:
+        _unknown(name, HISTOGRAMS, "histogram")
+    edges = HISTOGRAM_EDGES[name]
+    with _lock:
+        if labels is None:
+            counts, _tot, _n = _merged_hist(name)
+        else:
+            h = _hists.get((name, _label_key(labels)))
+            counts = h["counts"] if h else [0] * (len(edges) + 1)
+    return _quantile_from_counts(edges, counts, q)
+
+
+def get(name: str) -> Union[int, float, dict]:
+    """Current value (0 for a declared counter/gauge never touched; a
+    histogram returns its merged-across-labels snapshot entry)."""
     if name in COUNTERS:
         with _lock:
             return _counters.get(name, 0)
     if name in GAUGES:
         with _lock:
             return _gauges.get(name, 0)
-    _unknown(name, {**COUNTERS, **GAUGES}, "metric")
+    if name in HISTOGRAMS:
+        edges = HISTOGRAM_EDGES[name]
+        with _lock:
+            counts, total, n = _merged_hist(name)
+        return _hist_snapshot_entry(name, edges, counts, total, n)
+    _unknown(name, {**COUNTERS, **GAUGES, **HISTOGRAMS}, "metric")
 
 
-def snapshot() -> Dict[str, Union[int, float]]:
+def _hist_snapshot_entry(name, edges, counts, total, n):
+    return {
+        "count": n,
+        "sum": total,
+        "edges": list(edges),
+        "counts": list(counts),
+        "p50": _quantile_from_counts(edges, counts, 0.50),
+        "p90": _quantile_from_counts(edges, counts, 0.90),
+        "p99": _quantile_from_counts(edges, counts, 0.99),
+    }
+
+
+def snapshot() -> Dict[str, Union[int, float, dict]]:
     """JSON-ready dump: every declared counter (zeros included, so the
-    key set is stable run to run) plus every gauge that has a sample."""
+    key set is stable run to run), every gauge that has a sample, and
+    every declared histogram (aggregated across labels under its bare
+    name — empty ones included — plus one `name{k="v",...}` entry per
+    touched label set, each with counts/sum/edges and estimated
+    p50/p90/p99)."""
     with _lock:
-        out: Dict[str, Union[int, float]] = {
+        out: Dict[str, Union[int, float, dict]] = {
             name: _counters.get(name, 0) for name in COUNTERS}
         out.update(_gauges)
+        for name in HISTOGRAMS:
+            edges = HISTOGRAM_EDGES[name]
+            counts, total, n = _merged_hist(name)
+            out[name] = _hist_snapshot_entry(name, edges, counts,
+                                             total, n)
+        for (name, lk), h in _hists.items():
+            if not lk:
+                continue     # the unlabeled series IS the merged entry
+            disp = name + "{" + ",".join(
+                f'{k}="{_om_label_escape(v)}"' for k, v in lk) + "}"
+            out[disp] = _hist_snapshot_entry(
+                name, HISTOGRAM_EDGES[name], h["counts"], h["sum"],
+                h["count"])
         return out
 
 
 def reset():
-    """Zero every counter and drop every gauge sample (declarations
-    stay — a reset registry still documents its catalog)."""
+    """Zero every counter and drop every gauge/histogram sample
+    (declarations stay — a reset registry still documents its
+    catalog)."""
     with _lock:
         _counters.clear()
         _gauges.clear()
+        _hists.clear()
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics text exposition
+# ---------------------------------------------------------------------------
+
+
+def _om_name(name: str) -> str:
+    """Registry name -> OpenMetrics metric name: dots become
+    underscores under an `amgx_` namespace ('serving.cache.hit' ->
+    'amgx_serving_cache_hit')."""
+    return "amgx_" + name.replace(".", "_").replace("-", "_")
+
+
+def _om_escape(s: str) -> str:
+    return s.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _om_label_escape(s: str) -> str:
+    """Label-value escaping: the OpenMetrics grammar additionally
+    escapes double quotes inside label values — a caller-provided
+    tenant id containing a quote must not break the whole scrape."""
+    return _om_escape(s).replace('"', r'\"')
+
+
+def _om_num(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    v = float(v)
+    if v != v:
+        return "NaN"
+    if v in (float("inf"), float("-inf")):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(v)
+
+
+def _om_labels(items) -> str:
+    if not items:
+        return ""
+    return "{" + ",".join(
+        f'{k}="{_om_label_escape(v)}"' for k, v in items) + "}"
+
+
+def to_openmetrics() -> str:
+    """The whole registry as an OpenMetrics text exposition (the
+    /metrics scrape payload): HELP/TYPE metadata per family, `_total`
+    samples for counters, plain samples for gauges, cumulative
+    `_bucket{le=...}` + `_sum`/`_count` per histogram label set, and
+    the mandatory `# EOF` terminator. Declared-but-untouched counters
+    and histograms expose zeros (stable scrape shape); unsampled
+    gauges are omitted (a gauge has no meaningful zero)."""
+    lines = []
+    with _lock:
+        for name in sorted(COUNTERS):
+            om = _om_name(name)
+            lines.append(f"# HELP {om} {_om_escape(COUNTERS[name])}")
+            lines.append(f"# TYPE {om} counter")
+            lines.append(f"{om}_total {_om_num(_counters.get(name, 0))}")
+        for name in sorted(GAUGES):
+            if name not in _gauges:
+                continue
+            om = _om_name(name)
+            lines.append(f"# HELP {om} {_om_escape(GAUGES[name])}")
+            lines.append(f"# TYPE {om} gauge")
+            lines.append(f"{om} {_om_num(_gauges[name])}")
+        for name in sorted(HISTOGRAMS):
+            om = _om_name(name)
+            edges = HISTOGRAM_EDGES[name]
+            lines.append(f"# HELP {om} {_om_escape(HISTOGRAMS[name])}")
+            lines.append(f"# TYPE {om} histogram")
+            series = sorted(
+                (lk, h) for (nm, lk), h in _hists.items() if nm == name)
+            if not series:
+                series = [((), {"counts": [0] * (len(edges) + 1),
+                                "sum": 0.0, "count": 0})]
+            for lk, h in series:
+                cum = 0
+                for i, edge in enumerate(edges):
+                    cum += h["counts"][i]
+                    lab = _om_labels(lk + (("le", _om_num(edge)),))
+                    lines.append(f"{om}_bucket{lab} {cum}")
+                lab = _om_labels(lk + (("le", "+Inf"),))
+                lines.append(f"{om}_bucket{lab} {h['count']}")
+                base = _om_labels(lk)
+                lines.append(f"{om}_sum{base} {_om_num(h['sum'])}")
+                lines.append(f"{om}_count{base} {h['count']}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
 
 
 # ---------------------------------------------------------------------------
@@ -209,6 +445,25 @@ declare_counter("serving.deadline_action.partial",
 declare_counter("serving.deadline_action.reject",
                 "expired requests completed with the zero/initial "
                 "iterate (reject action)")
+# serving latency distributions (serving/service.py): fixed log-spaced
+# bucket edges covering sub-ms admission waits through multi-minute
+# cold-setup outliers; labeled by tenant so per-tenant p50/p99 are live
+# service state (service.stats(), the OpenMetrics scrape) rather than
+# bench-only aggregates
+_LATENCY_EDGES_S = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                    120.0)
+declare_histogram("serving.solve_latency_s",
+                  "submit-to-complete latency per request (seconds), "
+                  "labeled tenant=<id>; every terminal status counts "
+                  "(a deadline miss is latency the caller saw too)",
+                  _LATENCY_EDGES_S)
+declare_histogram("serving.queue_wait_s",
+                  "submit-to-slot-admission wait per request "
+                  "(seconds), labeled tenant=<id>; the queueing half "
+                  "of solve latency — what admission control and "
+                  "bucket sizing tune",
+                  _LATENCY_EDGES_S)
 declare_gauge("serving.queue_depth",
               "requests waiting for a bucket slot")
 declare_gauge("serving.inflight",

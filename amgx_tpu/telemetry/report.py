@@ -72,6 +72,15 @@ class SolveReport:
     #                                  (None: no tail fired)
     distributed: Optional[Dict[str, Any]] = None
     counters: Optional[Dict[str, Any]] = None
+    # structured grid statistics (AMG.grid_stats_dict(): per-level
+    # rows/nnz/layout, grid + operator complexity) — present whenever
+    # an AMG hierarchy is in the solver tree
+    hierarchy: Optional[Dict[str, Any]] = None
+    # convergence diagnostics (telemetry/diagnostics.py, diagnostics=1
+    # knob): per-level cycle-stage norms + reduction factors, smoother
+    # effectiveness, bottleneck-level attribution, asymptotic
+    # convergence factor
+    diagnostics: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -205,25 +214,34 @@ def _scalar(v):
 
 
 def build_report(solver, result, hist=None,
-                 distributed: Optional[Dict[str, Any]] = None
+                 distributed: Optional[Dict[str, Any]] = None,
+                 diagnostics: Optional[Dict[str, Any]] = None
                  ) -> SolveReport:
     """Assemble a SolveReport from a finished SolveResult-shaped record
     and the solver tree's static metadata. `hist` overrides the
     result's stored residual history (the solve path passes the already
-    unpacked numpy history even when store_res_history=0). Safe under
+    unpacked numpy history even when store_res_history=0).
+    `diagnostics` is the derived convergence-diagnostics block when the
+    probe ran (telemetry/diagnostics.py). Safe under
     jax.transfer_guard('disallow'): only host data and shapes are
-    read."""
+    read (grid_stats_dict included — it reads shape metadata only)."""
     hist = result.res_history if hist is None else hist
     residuals = [] if hist is None else np.asarray(hist).tolist()
     amg = _amg_of(solver)
     levels: List[Dict[str, Any]] = []
     tail = None
     cycle = None
+    hierarchy = None
     if amg is not None and distributed is None:
         levels, tail = _level_table(amg)
         cycle = getattr(amg, "cycle_name", None)
     elif amg is not None:
         cycle = getattr(amg, "cycle_name", None)
+    if amg is not None:
+        try:
+            hierarchy = amg.grid_stats_dict()
+        except Exception:
+            hierarchy = None   # partially built / stripped hierarchy
     return SolveReport(
         solver=str(getattr(solver, "name", type(solver).__name__)),
         status=result.status if isinstance(getattr(result, "status", None),
@@ -240,6 +258,8 @@ def build_report(solver, result, hist=None,
         levels=levels,
         tail_entry_level=tail,
         distributed=distributed,
+        hierarchy=hierarchy,
+        diagnostics=diagnostics,
     )
 
 

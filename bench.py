@@ -963,6 +963,32 @@ def bench_obs(n_flagship: int = 128, n_classical: int = 64,
     out[f"flagship_{n_flagship}^3_report_setup_s"] = round(
         rep["setup_time_s"], 3)
 
+    # ---- convergence diagnostics (diagnostics=1 probe) ----------------
+    # the flagship replayed with the diagnostics knob: the report must
+    # name a bottleneck level with per-level reduction factors — the
+    # per-round proof that the probe works at the flagship's
+    # REFINEMENT -> FGMRES -> AMG nesting depth on the real chip
+    try:
+        slv_d = amgx.create_solver(Config.from_string(
+            FLAGSHIP + ", diagnostics=1"))
+        slv_d.setup(A)
+        res_d = slv_d.solve(b)
+        dg = (res_d.report.diagnostics
+              if res_d.report is not None else None)
+        out["diagnostics"] = dg
+        out["diagnostics_bottleneck_level"] = (
+            None if dg is None else dg.get("bottleneck_level"))
+        out["diagnostics_acf"] = (
+            None if dg is None
+            else dg.get("asymptotic_convergence_factor"))
+        out["diagnostics_ok"] = bool(
+            dg is not None and dg.get("bottleneck_level") is not None
+            and all(r.get("level_reduction") is not None
+                    for r in dg.get("levels", [])))
+    except Exception as e:  # pragma: no cover - bench robustness
+        out["diagnostics_error"] = str(e)[:200]
+        out["diagnostics_ok"] = False
+
     # ---- classical replay ---------------------------------------------
     try:
         Ac = amgx.gallery.poisson("7pt", n_classical, n_classical,
@@ -999,11 +1025,65 @@ def bench_obs(n_flagship: int = 128, n_classical: int = 64,
     return out
 
 
+# artifact schema: version 2 adds the `round`/`schema_version` stamps
+# (tools/bench_history.py keys rounds on them instead of parsing
+# filenames) and the incremental checkpoint writes below
+BENCH_SCHEMA_VERSION = 2
+
+
+def _round_stamp():
+    """Stable round id for the artifact: the driver exports
+    AMGX_BENCH_ROUND when it knows the round number; None otherwise
+    (bench_history falls back to the wrapper's `n`, then filename)."""
+    import os
+    r = os.environ.get("AMGX_BENCH_ROUND", "").strip()
+    if not r:
+        return None
+    return int(r) if r.isdigit() else r
+
+
+def _write_artifact(payload):
+    """(Re)write BENCH.json. Called after EVERY phase, not only at the
+    end of main(): a round whose process dies mid-run (driver timeout,
+    OOM) still leaves the completed phases' numbers on disk instead of
+    an unrecorded round — the regression sentinel then sees a partial
+    round, not a hole."""
+    import os
+    art = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH.json")
+    with open(art, "w") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+
+
 def main():
     t_start = time.perf_counter()
     amgx.initialize()
     extra = {}
     spmv_gbps, spmv_s = 0.0, 1.0
+    _round = _round_stamp()
+
+    def _checkpoint(metric="bench_incomplete", value=-1.0, unit="none",
+                    error=None):
+        payload = {
+            "schema_version": BENCH_SCHEMA_VERSION,
+            "round": _round,
+            "metric": metric,
+            "value": value,
+            "unit": unit,
+            "vs_baseline": round(spmv_gbps / A100_HBM_GBPS, 4),
+            "extra": extra,
+        }
+        if error is not None:
+            payload["error"] = str(error)[:300]
+        elif metric == "bench_incomplete":
+            payload["error"] = "incomplete: process ended mid-run " \
+                               "(checkpoint write)"
+        try:
+            _write_artifact(payload)
+        except Exception as e:  # pragma: no cover - bench robustness
+            extra["artifact_error"] = str(e)[:120]
+        return payload
     try:
         sp = bench_spmv_vs_ceiling()
         spmv_gbps, spmv_s = sp["gbps"], sp["ms"] / 1e3
@@ -1015,6 +1095,7 @@ def main():
                                            round(sp["ratio_max"], 3)]
     except Exception as e:  # pragma: no cover - bench robustness
         extra["spmv_error"] = str(e)[:120]
+    _checkpoint()
     # every optional phase runs under a SIGALRM guard so the single
     # JSON line always prints
     import signal
@@ -1065,6 +1146,7 @@ def main():
         except Exception as e:  # pragma: no cover - bench robustness
             extra[f"classical_{cn}_error"] = str(e)[:200]
             break
+    _checkpoint()
     gc.collect()
 
     # spmv layout-efficiency phase (DIA/ELL/SWELL, fused vs unfused):
@@ -1090,6 +1172,7 @@ def main():
         extra["spmv_layouts_error"] = "wall-clock budget exceeded"
     except Exception as e:  # pragma: no cover - bench robustness
         extra["spmv_layouts_error"] = str(e)[:200]
+    _checkpoint()
     gc.collect()
 
     # batched-serving phase: cheap (32^3, f64 CG+AggAMG), guarded like
@@ -1106,6 +1189,7 @@ def main():
         extra["batched_error"] = "wall-clock budget exceeded"
     except Exception as e:  # pragma: no cover - bench robustness
         extra["batched_error"] = str(e)[:200]
+    _checkpoint()
     gc.collect()
 
     # serving phase: open-loop load against the continuous-batching
@@ -1132,6 +1216,7 @@ def main():
         extra["serving_error"] = "wall-clock budget exceeded"
     except Exception as e:  # pragma: no cover - bench robustness
         extra["serving_error"] = str(e)[:200]
+    _checkpoint()
     gc.collect()
 
     # resilience smoke phase: guarded vs unguarded iteration-loop cost
@@ -1148,6 +1233,7 @@ def main():
         extra["resilience_error"] = "wall-clock budget exceeded"
     except Exception as e:  # pragma: no cover - bench robustness
         extra["resilience_error"] = str(e)[:200]
+    _checkpoint()
     gc.collect()
 
     # observability phase: instrumented flagship+classical replays with
@@ -1167,6 +1253,9 @@ def main():
                 and obs.get("classical_report_valid", True))
             extra["obs_perfetto_valid"] = obs.get("perfetto_valid")
             extra["obs_perfetto_events"] = obs.get("perfetto_events")
+            extra["obs_diagnostics_ok"] = obs.get("diagnostics_ok")
+            extra["obs_diagnostics_bottleneck_level"] = \
+                obs.get("diagnostics_bottleneck_level")
         finally:
             signal.alarm(0)
             signal.signal(signal.SIGALRM, old)
@@ -1174,6 +1263,7 @@ def main():
         extra["obs_error"] = "wall-clock budget exceeded"
     except Exception as e:  # pragma: no cover - bench robustness
         extra["obs_error"] = str(e)[:200]
+    _checkpoint()
     gc.collect()
 
     try:
@@ -1224,6 +1314,8 @@ def main():
             value = spmv_s * 1e3
             metric = "poisson7pt_128^3 SpMV"
             unit = "ms"
+    _checkpoint(metric=metric, value=value, unit=unit,
+                error="incomplete: north-star phase still pending")
 
     # the 256^3 north star (BASELINE.md headline). Solo phase cost with
     # a cold compile cache is ~500 s (gallery + one cold setup + the
@@ -1271,29 +1363,18 @@ def main():
             extra["northstar_error"] = str(e)[:200]
 
     # full payload -> BENCH.json artifact (machine-readable by contract:
-    # json.load must work); stdout gets ONE COMPACT line — scalars only,
-    # no nested breakdowns — because the driver's stdout-tail capture is
-    # bounded and round 5's full-fat line outgrew it (parsed: null, the
-    # SpMV-efficiency / 64^3 / classical headline numbers lost).
-    payload = {
-        "metric": metric,
-        "value": value,
-        "unit": unit,
-        "vs_baseline": round(spmv_gbps / A100_HBM_GBPS, 4),
-        "extra": extra,
-    }
-    try:
-        import os
-        art = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BENCH.json")
-        with open(art, "w") as f:
-            json.dump(payload, f, indent=1)
-            f.write("\n")
-    except Exception as e:  # pragma: no cover - bench robustness
-        extra["artifact_error"] = str(e)[:120]
+    # json.load must work; already checkpoint-written after every phase
+    # above — this is the final, complete, error-free write); stdout
+    # gets ONE COMPACT line — scalars only, no nested breakdowns —
+    # because the driver's stdout-tail capture is bounded and round 5's
+    # full-fat line outgrew it (parsed: null, the SpMV-efficiency /
+    # 64^3 / classical headline numbers lost).
+    _checkpoint(metric=metric, value=value, unit=unit)
     compact = {k: v for k, v in extra.items()
                if not isinstance(v, (dict, list))}
     print(json.dumps({
+        "schema_version": BENCH_SCHEMA_VERSION,
+        "round": _round,
         "metric": metric,
         "value": value,
         "unit": unit,

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
-"""Static span-registry checker.
+"""Static span- and metric-registry checker.
 
-Two contracts guard the telemetry subsystem's honesty, and both are
+Three contracts guard the telemetry subsystem's honesty, and all are
 checkable without running anything:
 
 1. REGISTRY COVERAGE — every span name used in the package (a string or
@@ -16,6 +16,16 @@ checkable without running anything:
    declared span would double-count its child's wall time and the PR-3
    `setup_accounted_fraction >= 0.9` contract would silently report
    fractions > honest.
+
+3. METRIC-NAME COVERAGE — every literal metric name recorded through
+   the registry (`_tm.inc(...)` / `metrics.observe(...)` /
+   `set_gauge` / `max_gauge` on the package's conventional receivers)
+   must be declared in the matching catalog
+   (telemetry.metrics.COUNTERS / GAUGES / HISTOGRAMS). The registry
+   raises at runtime too, but only when the line executes — this
+   catches the typo'd counter in the error path nobody exercised.
+   Non-literal names (the serving cache's configurable counter map)
+   are skipped: the runtime check owns those.
 
 f-string placeholders (`{expr}`) are normalized to `*`, so
 `f"amg.L{k}.galerkin"` checks as `amg.L*.galerkin`. Calls whose name is
@@ -46,6 +56,18 @@ _EXEMPT = (
 )
 
 _CALL_NAMES = {"trace_region", "span"}
+
+# metric-recording surface: attribute calls on the package's
+# conventional registry receivers (`_tm.inc(...)`, `metrics.observe`).
+# Receiver-qualified on purpose: other objects legitimately own methods
+# with these names (determinism.DeterminismChecker.observe)
+_METRIC_RECEIVERS = {"_tm", "metrics", "_metrics"}
+_METRIC_KINDS = {"inc": "counter", "set_gauge": "gauge",
+                 "max_gauge": "gauge", "observe": "histogram",
+                 "quantile": "histogram"}
+_METRIC_EXEMPT = (
+    os.path.join("amgx_tpu", "telemetry", "metrics.py"),
+)
 
 
 def _call_name(node: ast.Call):
@@ -97,6 +119,41 @@ def extract_span_literals(root: str = PKG):
                         or not node.args:
                     continue
                 out.append((path, node.lineno, _normalize(node.args[0])))
+    return out
+
+
+def extract_metric_literals(root: str = PKG):
+    """(file, line, kind, name) for every literal metric name recorded
+    through the registry's conventional receivers. Dynamic names
+    (variables threaded through a config map) are skipped — the
+    runtime registry's did-you-mean raise owns those."""
+    out = []
+    for dirpath, _dirs, files in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, _ROOT)
+            if rel in _METRIC_EXEMPT:
+                continue
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                f_ = node.func
+                if not (isinstance(f_, ast.Attribute)
+                        and f_.attr in _METRIC_KINDS
+                        and isinstance(f_.value, ast.Name)
+                        and f_.value.id in _METRIC_RECEIVERS):
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str):
+                    out.append((path, node.lineno,
+                                _METRIC_KINDS[f_.attr], arg.value))
     return out
 
 
@@ -159,6 +216,19 @@ def check():
                 errors.append(
                     f"declared span {a!r} is an ancestor of {b!r}: "
                     f"the accounted amg.* sum would double-count")
+
+    # 3. metric-name coverage: literal names recorded through the
+    # registry must be declared in the matching catalog
+    from amgx_tpu.telemetry import metrics as M
+    catalogs = {"counter": M.COUNTERS, "gauge": M.GAUGES,
+                "histogram": M.HISTOGRAMS}
+    for path, line, kind, name in extract_metric_literals():
+        rel = os.path.relpath(path, _ROOT)
+        if name not in catalogs[kind]:
+            errors.append(
+                f"{rel}:{line}: {kind} {name!r} is not declared in "
+                f"telemetry/metrics.py "
+                f"({'COUNTERS' if kind == 'counter' else 'GAUGES' if kind == 'gauge' else 'HISTOGRAMS'})")
     return errors
 
 
@@ -169,8 +239,8 @@ def main() -> int:
             print(e)
         print(f"check_spans: {len(errors)} violation(s)")
         return 1
-    print("check_spans: OK (registry coverage + accounted-leaf "
-          "disjointness)")
+    print("check_spans: OK (span-registry coverage + accounted-leaf "
+          "disjointness + metric-name coverage)")
     return 0
 
 
