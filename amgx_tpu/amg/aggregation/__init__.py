@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 
 import jax.numpy as jnp
+import numpy as np
 
 from ... import registry
 from ...config import Config
@@ -111,6 +112,35 @@ class AggregationAMGLevel(AMGLevel):
         self.geo_axes = old.geo_axes
         self.geo_fine_shape = old.geo_fine_shape
         self.geo_coarse_shape = old.geo_coarse_shape
+
+    def structure_snapshot(self):
+        if self.coarse_size is None:
+            return None
+        meta = {"num_rows": int(self.A.num_rows),
+                "coarse_size": int(self.coarse_size),
+                "geo_axes": None if self.geo_axes is None
+                else list(self.geo_axes),
+                "geo_fine_shape": None if self.geo_fine_shape is None
+                else list(self.geo_fine_shape),
+                "geo_coarse_shape": None if self.geo_coarse_shape is None
+                else list(self.geo_coarse_shape)}
+        arrays = {}
+        if self.aggregates is not None:
+            arrays["aggregates"] = np.asarray(self.aggregates)
+        return meta, arrays
+
+    @classmethod
+    def structure_restore(cls, meta, arrays):
+        g = cls._ghost(meta["num_rows"])
+        g.coarse_size = int(meta["coarse_size"])
+        g.aggregates = arrays.get("aggregates")
+        g.geo_axes = None if meta["geo_axes"] is None \
+            else tuple(meta["geo_axes"])
+        g.geo_fine_shape = None if meta["geo_fine_shape"] is None \
+            else tuple(meta["geo_fine_shape"])
+        g.geo_coarse_shape = None if meta["geo_coarse_shape"] is None \
+            else tuple(meta["geo_coarse_shape"])
+        return g
 
     def level_data(self):
         d = super().level_data()

@@ -114,6 +114,42 @@ class ClassicalAMGLevel(AMGLevel):
         self.R = old.R
         self._reused = True
 
+    def structure_snapshot(self):
+        P = getattr(self, "P", None)
+        if P is None or self.coarse_size is None or P.is_block:
+            return None
+        meta = {"num_rows": int(self.A.num_rows),
+                "coarse_size": int(self.coarse_size),
+                "aggressive": bool(self._aggressive),
+                "p_rows": int(P.num_rows), "p_cols": int(P.num_cols)}
+        # R = P^T is recomputed on restore (bit-exact, and exactly how
+        # create_coarse_matrix built it); `strong` is only consulted by
+        # a FRESH interpolation, which the reuse path never runs
+        arrays = {"cf_map": np.asarray(self.cf_map),
+                  "p_row_offsets": np.asarray(P.row_offsets),
+                  "p_col_indices": np.asarray(P.col_indices),
+                  "p_values": np.asarray(P.values)}
+        return meta, arrays
+
+    @classmethod
+    def structure_restore(cls, meta, arrays):
+        from ...matrix import device_setup_forced, host_resident
+        g = cls._ghost(meta["num_rows"])
+        g.coarse_size = int(meta["coarse_size"])
+        g._aggressive = bool(meta["aggressive"])
+        g.cf_map = arrays["cf_map"]
+        g.strong = None
+        P = CsrMatrix(row_offsets=arrays["p_row_offsets"],
+                      col_indices=arrays["p_col_indices"],
+                      values=arrays["p_values"],
+                      num_rows=int(meta["p_rows"]),
+                      num_cols=int(meta["p_cols"]))
+        ell = "auto" if device_setup_forced() or host_resident(
+            P.row_offsets, P.col_indices, P.values) else "never"
+        g.P = P.init(ell=ell)
+        g.R = transpose(g.P).init(ell=ell)
+        return g
+
     def level_data(self):
         d = super().level_data()
         # the cycle only SpMVs against the transfer operators — layout

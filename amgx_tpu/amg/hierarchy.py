@@ -58,6 +58,33 @@ class AMGLevel:
         raise NotImplementedError(
             f"{type(self).__name__} does not support structure reuse")
 
+    # -- persistent structure (serving/hstore.py) ------------------------
+    def structure_snapshot(self):
+        """(meta, arrays) capturing exactly what `reuse_structure`
+        reads — the host-persistable form of this level's coarsening
+        structure (deterministic from the sparsity pattern, ROADMAP
+        3d). `meta` is JSON-able scalars, `arrays` numpy arrays. None
+        when this level class does not support persistence (the store
+        then skips the whole hierarchy)."""
+        return None
+
+    @classmethod
+    def structure_restore(cls, meta, arrays):
+        """Rebuild a 'ghost' level from a persisted snapshot: an
+        instance carrying ONLY the attributes `reuse_structure` reads
+        (plus A.num_rows for the reuse-loop compatibility check) — it
+        is never solved with, only adopted from."""
+        raise NotImplementedError(
+            f"{cls.__name__} does not support structure restore")
+
+    @classmethod
+    def _ghost(cls, num_rows: int):
+        import types
+        g = cls.__new__(cls)
+        g.A = types.SimpleNamespace(num_rows=int(num_rows))
+        g.smoother = None
+        return g
+
     # -- solve-phase (pure) ----------------------------------------------
     def level_data(self) -> Dict[str, Any]:
         # slim matrices: the cycle only SpMVs against level operators,
@@ -198,9 +225,23 @@ class AMG:
             return cpu
         return None
 
+    def adopt_structure(self, ghost_levels):
+        """Install a persisted structure snapshot (serving/hstore.py):
+        the NEXT setup() call routes through the structure-reuse
+        rebuild (`_resetup_impl` — Galerkin values + smoothers only,
+        the cheap path) instead of a full coarsening, counted as
+        amg.setup.restored. One-shot: consumed (or discarded on a
+        shape mismatch) by that setup."""
+        self._ghost_levels = list(ghost_levels)
+
     def setup(self, A: CsrMatrix):
         import jax
         from ..telemetry import metrics as _tm
+        ghosts = getattr(self, "_ghost_levels", None)
+        if ghosts is not None:
+            self._ghost_levels = None
+            if ghosts and ghosts[0].A.num_rows == A.num_rows:
+                return self._setup_restored(A, ghosts)
         _tm.inc("amg.setup.full")
         t0 = time.perf_counter()
         self.levels = []
@@ -244,6 +285,40 @@ class AMG:
         self._build_levels_checked(Af, 0)
         self._finalize_setup(t0)
         return self
+
+    def _setup_restored(self, A: CsrMatrix, ghosts):
+        """setup() against a persisted structure snapshot: install the
+        ghost levels as the reuse source and run the structure-reuse
+        rebuild — values-only Galerkin + fresh smoothers, no coarsening
+        selection. The restart path's answer to the 17 s cold setup."""
+        import jax
+        from ..profiling import trace_region
+        from ..telemetry import metrics as _tm
+        _tm.inc("amg.setup.restored")
+        self.levels = list(ghosts)
+        self._data_cache = None
+        self._put_cache = {}
+        self._l0_seed = None
+        self._resetup_precast = None
+        self._vr_plan = None
+        self._last_resetup_value_only = False
+        self._tail_entry_level = None
+        self._telemetry_level_cache = None
+        host = self._host_setup_device(A)
+        if host is not None:
+            self._setup_backend_used = "host"
+            self._ship_device = (jax.config.jax_default_device
+                                 or jax.devices()[0])
+            l0_dev = self._l0_device_cast(A)
+            with jax.default_device(host):
+                with trace_region("amg.host_pull"):
+                    Af = self._pull_host_l0(A)
+                self._register_device_l0(A, Af, l0_dev)
+                return self._resetup_impl(Af, -1)
+        self._ship_device = None
+        self._setup_backend_used = self.setup_backend
+        Af = A if A.initialized else A.init()
+        return self._resetup_impl(Af, -1)
 
     def _level_device_forced(self, n: int) -> bool:
         """setup_backend=device forces the jnp/device implementations
@@ -536,10 +611,45 @@ class AMG:
             return self.cfg.get_solver("fine_smoother", self.scope)
         return self.cfg.get_solver("coarse_smoother", self.scope)
 
+    # known TPU-runtime fault (README "Known limitations"): the
+    # combined PCG+V-cycle program with MULTICOLOR_DILU smoothing
+    # faults on single-chip TPU at 128^3 scale — every level's DILU
+    # passes in isolation and the config validates through 96^3, so
+    # the guard trips strictly above the validated size. The benched
+    # workaround is JACOBI_L1; routing it HERE (config-validation /
+    # setup time, before any trace) replaces a solve-time runtime
+    # fault with a warned, counted fallback.
+    DILU_TPU_FAULT_MIN_ROWS = 96 ** 3 + 1
+
+    def _guard_known_faults(self, name: str) -> str:
+        if name != "MULTICOLOR_DILU" or not self.levels:
+            return name
+        n_fine = self.levels[0].A.num_rows
+        if n_fine < self.DILU_TPU_FAULT_MIN_ROWS:
+            return name
+        import jax
+        if jax.default_backend() != "tpu" or jax.device_count() > 1:
+            return name          # sharded/CPU DILU paths are unaffected
+        if not getattr(self, "_fault_fallback_warned", False):
+            # once per hierarchy: the guard fires for every level, but
+            # one rerouted CONFIGURATION is one counted event — a
+            # per-level count would inflate the series by the depth
+            self._fault_fallback_warned = True
+            from ..output import amgx_output
+            from ..telemetry import metrics as _tm
+            _tm.inc("resilience.config_fallback")
+            amgx_output(
+                f"amgx_tpu warning: MULTICOLOR_DILU at {n_fine} rows "
+                f"on a single TPU chip hits a known runtime fault "
+                f"(validated clean through 96^3); smoothing falls "
+                f"back to JACOBI_L1 (resilience.config_fallback)\n")
+        return "JACOBI_L1"
+
     def _attach_level_smoother(self, level: AMGLevel):
         from ..solvers.base import make_solver
         from ..profiling import trace_region
         name, scope = self._smoother_spec(level.level_index)
+        name = self._guard_known_faults(name)
         level.smoother = make_solver(name, self.cfg, scope)
         level.smoother._owns_scaling = False
         if getattr(level.smoother, "needs_cf_map", False) and \

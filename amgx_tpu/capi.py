@@ -861,19 +861,22 @@ def AMGX_service_destroy(svc_h):
 @_api
 @_outputs(1)
 def AMGX_service_submit(svc_h, mtx_h, rhs_h, tenant: str = "default",
-                        deadline_s=None):
+                        deadline_s=None, request_key=None):
     """rc, ticket handle. Enqueues one system; issues no device work
-    of its own and never waits on a hierarchy build (it can contend
-    with the scheduler's cycle lock for up to one chunk of stepping).
-    `deadline_s` is a relative latency budget — expiry completes the
-    ticket with DEADLINE_EXCEEDED instead of stalling its bucket."""
+    of its own and never waits on one (device cycles run outside the
+    service's bookkeeping lock). `deadline_s` is a relative latency
+    budget — expiry completes the ticket with DEADLINE_EXCEEDED
+    instead of stalling its bucket. `request_key` makes the submit
+    idempotent: a retry after a dropped response dedupes against the
+    live ticket or the service journal instead of enqueueing twice."""
     svc = _get(svc_h, _CService)
     m = _get(mtx_h, _CMatrix)
     b = _get(rhs_h, _CVector)
     if m.A is None or b.v is None:
         raise AMGXError("matrix/rhs not uploaded", RC.BAD_PARAMETERS)
     ticket = svc.service.submit(m.A, b.v, tenant=tenant,
-                                deadline_s=deadline_s)
+                                deadline_s=deadline_s,
+                                request_key=request_key)
     return RC.OK, _new_handle(ticket)
 
 

@@ -405,8 +405,56 @@ def _register_default_parameters():
       ("partial", "reject"))
     R("serving_max_queue", int, "admission control: submits beyond "
       "this many queued requests complete immediately with "
-      "DEADLINE_EXCEEDED instead of growing the queue without bound "
+      "OVERLOADED instead of growing the queue without bound "
       "(0 = unbounded)", 0, None, 0)
+    # serving fault tolerance (serving/{journal,hstore}.py + the
+    # recovery/shed/supervision machinery in serving/service.py)
+    R("serving_journal_dir", str, "directory for the durable request "
+      "journal + solve checkpoints (serving/journal.py): submits are "
+      "journaled write-ahead, in-flight states checkpoint every "
+      "serving_checkpoint_cycles cycles, and a restarted service "
+      "replays pending records — resuming checkpointed solves from "
+      "their saved iterate. '' = journaling off", "")
+    R("serving_checkpoint_cycles", int, "scheduler cycles between "
+      "solve-state checkpoints of journaled in-flight requests (each "
+      "checkpoint is one device->host state pull + one file write per "
+      "slot). 0 = journal requests but never checkpoint mid-flight",
+      4, None, 0)
+    R("serving_recover", int, "replay the journal at service "
+      "construction (crash recovery); 0 defers to an explicit "
+      "recover() call", 1, BOOL01)
+    R("serving_hierarchy_dir", str, "directory persisting hierarchy "
+      "STRUCTURE snapshots next to the AOT store "
+      "(serving/hstore.py): a restarted service rebuilds each "
+      "bucket's hierarchy via load + structure-reuse (values only, "
+      "amg.setup.restored) instead of a full multi-second coarsening. "
+      "'' = off", "")
+    R("serving_shed_policy", str, "load shedding beyond the hard "
+      "queue bound: 'deadline' rejects requests (OVERLOADED) whose "
+      "deadline the live execution-time estimate (median of recent "
+      "in-bucket execs, scaled by queue-depth waves + 25% margin) "
+      "says is unmeetable; '' = hard bound only",
+      "", ("", "deadline"))
+    R("serving_tenant_quota", int, "per-tenant fairness quota: a "
+      "tenant with this many live (queued + in-flight) requests has "
+      "further submits shed OVERLOADED (0 = unbounded)", 0, None, 0)
+    R("serving_supervisor_cycles", int, "wedged-bucket detector: a "
+      "busy bucket whose progress heartbeat (per-cycle iteration "
+      "counters) flatlines for this many consecutive cycles is "
+      "quarantined — salvageable slots finalize, the rest requeue. "
+      "0 = supervision off", 8, None, 0)
+    R("serving_fault_policy", str, "service-level failure chains "
+      "'EVENT>action|...' (events: BUILD_FAILED, STEP_FAILED, WEDGED; "
+      "actions: retry_backoff, requeue, reject — "
+      "resilience/policy.py parse_service_policy). Multiple steps per "
+      "event are tried in order across consecutive failures",
+      "BUILD_FAILED>reject|STEP_FAILED>requeue|WEDGED>requeue")
+    R("serving_retry_backoff_s", float, "base delay of the "
+      "retry_backoff action: rebuild attempt n waits base * 2^n",
+      0.05)
+    R("serving_retry_max_attempts", int, "bound on per-fingerprint "
+      "build/step recovery attempts; beyond it the affected tickets "
+      "reject with BREAKDOWN", 3, None, 0)
     R("fallback_policy", str, "resilience chains "
       "'STATUS>action[=arg]|...' (actions: retry, rescale_retry, "
       "switch_solver=<NAME>, escalate_sweeps), applied host-side by "

@@ -10,9 +10,12 @@ Production serving needs solves that fail *diagnosably* and degrade
 - `policy`: the declarative, bounded fallback/retry engine
   (`ResilientSolver`), configured via the `fallback_policy` config
   parameter;
-- `faultinject`: the deterministic fault harness (SpMV NaNs, Galerkin
-  perturbation, halo corruption) that proves every status code and
-  every fallback edge is reachable.
+- `faultinject`: the deterministic fault harness — solve-level (SpMV
+  NaNs, Galerkin perturbation, halo corruption) and service-level
+  (builder crashes, device-step exceptions, wedged cycles,
+  journal/AOT-store corruption, clock skew) — that proves every status
+  code, every fallback edge, and every serving recovery path is
+  reachable.
 
 `policy` is imported lazily: it pulls in the solver tree, while
 `status`/`faultinject` are dependency-free and are imported by low
@@ -27,7 +30,8 @@ from .status import (  # noqa: F401
 
 
 def __getattr__(name):
-    if name in ("policy", "ResilientSolver", "parse_fallback_policy"):
+    if name in ("policy", "ResilientSolver", "parse_fallback_policy",
+                "parse_service_policy"):
         from . import policy
         if name == "policy":
             return policy

@@ -35,6 +35,24 @@ Arm programmatically::
 or via the environment (AMGX_TPU_DEBUG_RESETUP-style toggle)::
 
     AMGX_TPU_FAULT_INJECT="spmv_nan:iteration=3:fires=1"
+
+**Service-level chaos** (the serving fault-tolerance harness,
+serving/service.py + tests/test_serving.py) extends the same arming
+machinery with HOST-side faults — no tracing involved, so `fires`
+counts straight occurrences:
+
+- ``build_crash``    — raise ChaosInjected inside the next bucket
+  build(s) (the builder-thread/inline-build failure drill);
+- ``step_crash``     — raise ChaosInjected inside the next engine
+  device-step cycle(s) (the quarantine drill);
+- ``step_wedge``     — the next engine cycle(s) silently make NO
+  progress (iteration counters frozen): the wedged-bucket heartbeat
+  detector's food;
+- ``journal_corrupt`` / ``aot_corrupt`` — corrupt the next blob
+  written to the solve journal / AOT store (torn-write model: the
+  damage is discovered at read time, which must degrade, never hang);
+- ``clock_skew``     — `service_now()` returns monotonic time shifted
+  by `value` seconds (deadline bookkeeping under a skewed clock).
 """
 from __future__ import annotations
 
@@ -42,9 +60,19 @@ import contextlib
 import dataclasses
 import math
 import os
+import time
 from typing import Optional
 
-KINDS = ("spmv_nan", "halo_corrupt", "galerkin_perturb")
+KINDS = ("spmv_nan", "halo_corrupt", "galerkin_perturb",
+         # service-level (host-side) chaos kinds — serving/
+         "build_crash", "step_crash", "step_wedge",
+         "journal_corrupt", "aot_corrupt", "clock_skew")
+
+
+class ChaosInjected(RuntimeError):
+    """Raised by service_crash hooks: a scripted service-level fault
+    (never produced by real code paths — tests and the chaos bench
+    assert the service survives it, not that it happened)."""
 
 _ENV_VAR = "AMGX_TPU_FAULT_INJECT"
 
@@ -234,6 +262,55 @@ def corrupt_halo(halo):
     hit = _ITER == spec.iteration
     return halo.at[idx].set(
         jnp.where(hit, jnp.asarray(spec.value, halo.dtype), halo[idx]))
+
+
+# -- service-level hooks (host-side; serving/) --------------------------
+
+
+def service_crash(point: str):
+    """Raise ChaosInjected when the `point` kind ('build_crash' /
+    'step_crash') is armed — one consumed firing per raise. Inert (and
+    free) when nothing is armed."""
+    spec = active(point)
+    if spec is None:
+        return
+    consume(point)
+    raise ChaosInjected(f"chaos: injected {point}")
+
+
+def step_wedged() -> bool:
+    """True while a 'step_wedge' fault is armed: the engine cycle makes
+    no progress this cycle (consumes one firing per wedged cycle)."""
+    spec = active("step_wedge")
+    if spec is None:
+        return False
+    consume("step_wedge")
+    return True
+
+
+def corrupt_blob(kind: str, blob: bytes) -> bytes:
+    """Torn-write model for 'journal_corrupt' / 'aot_corrupt': when
+    armed, the blob about to be persisted is truncated and bit-flipped
+    (one firing per corrupted write). The read path must detect the
+    damage and degrade — skip the record / retrace — never hang."""
+    spec = active(kind)
+    if spec is None:
+        return blob
+    consume(kind)
+    half = bytes(b ^ 0xFF for b in blob[:max(1, len(blob) // 2)])
+    return half
+
+
+def service_now() -> float:
+    """time.monotonic(), shifted by `value` seconds while a
+    'clock_skew' fault is armed (arm with fires=None for a persistent
+    skew). Every serving-layer deadline computation reads the clock
+    through this hook so skew drills are deterministic."""
+    spec = active("clock_skew")
+    now = time.monotonic()
+    if spec is None:
+        return now
+    return now + float(spec.value)
 
 
 def perturb_galerkin(Ac, level: int):

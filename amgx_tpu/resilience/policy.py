@@ -49,6 +49,14 @@ from .status import SolveStatus
 
 ACTIONS = ("retry", "rescale_retry", "switch_solver", "escalate_sweeps")
 
+# service-level grammar (serving_fault_policy, serving/service.py): the
+# same 'EVENT>action|...' spec shape, keyed on service events instead
+# of solve statuses. Multiple steps for one event form a chain tried in
+# order across that fingerprint's consecutive failures (bounded by
+# serving_retry_max_attempts, after which the tickets reject).
+SERVICE_EVENTS = ("BUILD_FAILED", "STEP_FAILED", "WEDGED")
+SERVICE_ACTIONS = ("retry_backoff", "requeue", "reject")
+
 ANY = "ANY"
 
 _STATUS_ALIASES = {"NAN": "NAN_DETECTED", "DEADLINE": "DEADLINE_EXCEEDED"}
@@ -93,6 +101,45 @@ def parse_fallback_policy(spec: str) -> Dict[object, Chain]:
             raise BadConfigurationError(
                 "fallback_policy: switch_solver needs '=SOLVER_NAME'")
         policy.setdefault(key, []).append((act, arg))
+    return policy
+
+
+def parse_service_policy(spec: str) -> Dict[str, List[str]]:
+    """Parse the service-level grammar into {event: [action, ...]}.
+    Events: BUILD_FAILED (a bucket's hierarchy build / engine trace
+    raised), STEP_FAILED (a device-step cycle raised mid-flight),
+    WEDGED (the supervisor's progress heartbeat flatlined). Actions:
+
+    * ``retry_backoff`` — keep the tickets queued and retry the build
+      after a bounded exponential backoff (serving_retry_backoff_s *
+      2^attempt, capped at serving_retry_max_attempts total);
+    * ``requeue``       — retry immediately (same attempt bound);
+    * ``reject``        — complete the affected tickets with BREAKDOWN
+      + the error on ticket.error.
+
+    Raises BadConfigurationError (with a did-you-mean) on unknown
+    events or actions, mirroring parse_fallback_policy."""
+    policy: Dict[str, List[str]] = {}
+    for step in str(spec or "").split("|"):
+        step = step.strip()
+        if not step:
+            continue
+        if ">" not in step:
+            raise BadConfigurationError(
+                f"serving_fault_policy step {step!r}: expected "
+                f"'EVENT>action'")
+        ev, action = (p.strip() for p in step.split(">", 1))
+        ev = ev.upper()
+        if ev not in SERVICE_EVENTS:
+            raise BadConfigurationError(
+                f"serving_fault_policy: unknown event {ev!r}"
+                f"{did_you_mean(ev, SERVICE_EVENTS)}")
+        action = action.strip().lower()
+        if action not in SERVICE_ACTIONS:
+            raise BadConfigurationError(
+                f"serving_fault_policy: unknown action {action!r}"
+                f"{did_you_mean(action, SERVICE_ACTIONS)}")
+        policy.setdefault(ev, []).append(action)
     return policy
 
 

@@ -38,6 +38,13 @@ class SolveStatus(enum.IntEnum):
     #                    solve reached a terminal status (the request
     #                    completes with its current iterate or a
     #                    rejection, never a hung bucket; serving/)
+    OVERLOADED = 7     # serving-layer load shed: admission control
+    #                    judged the request unserviceable (queue bound,
+    #                    tenant quota, or a deadline the live latency
+    #                    estimate says is unmeetable) and completed it
+    #                    immediately with the initial iterate — the
+    #                    honest early rejection, distinct from a
+    #                    DEADLINE_EXCEEDED surprise after queueing
 
 
 # AMGX_SOLVE_STATUS codes (include/amgx_c.h) for the C-API surface.
@@ -54,6 +61,7 @@ _TO_AMGX = {
     SolveStatus.BREAKDOWN: AMGX_SOLVE_FAILED,
     SolveStatus.NAN_DETECTED: AMGX_SOLVE_FAILED,
     SolveStatus.DEADLINE_EXCEEDED: AMGX_SOLVE_NOT_CONVERGED,
+    SolveStatus.OVERLOADED: AMGX_SOLVE_NOT_CONVERGED,
 }
 
 _STRINGS = {
@@ -64,6 +72,7 @@ _STRINGS = {
     SolveStatus.BREAKDOWN: "breakdown",
     SolveStatus.NAN_DETECTED: "nan_detected",
     SolveStatus.DEADLINE_EXCEEDED: "deadline_exceeded",
+    SolveStatus.OVERLOADED: "overloaded",
 }
 
 

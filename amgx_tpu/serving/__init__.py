@@ -17,6 +17,20 @@ telemetry machinery (ROADMAP item 3). Four pieces:
   expiry completes tickets with `SolveStatus.DEADLINE_EXCEEDED` —
   never a hung bucket — and `serving_max_queue` bounds the queue.
 
+Plus the fault-tolerance layer (PR 11):
+
+- **solve journal + checkpoints** (`journal.SolveJournal`): requests
+  are journaled write-ahead and in-flight solve states checkpoint at
+  cycle boundaries; a restarted service replays the journal and
+  RESUMES checkpointed solves bit-identically;
+- **persistent hierarchies** (`hstore.HierarchyStore`): structure
+  snapshots next to the AOT store turn the restart's full setup into
+  a load + structure-reuse rebuild;
+- **backpressure/shedding + supervision** (`service.SolveService`):
+  OVERLOADED load shedding driven by live latency estimates and
+  per-tenant quotas, plus a wedged-bucket supervisor with bounded
+  retry/backoff under the `serving_fault_policy` grammar.
+
 Quick start::
 
     from amgx_tpu.serving import SolveService
@@ -30,4 +44,6 @@ from __future__ import annotations
 from .aot import AotStore  # noqa: F401
 from .cache import HierarchyCache, solve_data_bytes  # noqa: F401
 from .engine import BucketEngine  # noqa: F401
+from .hstore import HierarchyStore  # noqa: F401
+from .journal import SolveJournal  # noqa: F401
 from .service import ServiceTicket, SolveService  # noqa: F401

@@ -62,6 +62,7 @@ class AotStore:
         plus the sidecar metadata. All-or-nothing: a failed export
         removes the partial bundle and reports False (the engine keeps
         its traced functions; `serving.aot.error` counts it)."""
+        from ..resilience import faultinject as _fi
         from ..telemetry import metrics as _tm
         try:
             from jax import export as jexport
@@ -71,6 +72,10 @@ class AotStore:
                     exp = jexport.export(fn)(*args)
                     blobs[name] = exp.serialize()
                 for name, blob in blobs.items():
+                    # chaos torn-write drill: damage lands on disk,
+                    # the load path must detect it and degrade to
+                    # tracing — never serve a half-written module
+                    blob = _fi.corrupt_blob("aot_corrupt", blob)
                     with open(self._path(key, name) + ".bin", "wb") as f:
                         f.write(blob)
                 with open(self._path(key, "meta") + ".json", "w") as f:

@@ -36,8 +36,10 @@ from ..config import Config
 from ..errors import BadParametersError
 from ..matrix import CsrMatrix
 from ..profiling import trace_region
+from ..resilience import faultinject as _fi
 from ..solvers.base import Solver, SolveResult
 from .aot import AotStore
+from .hstore import HierarchyStore
 
 _ENGINE_FNS = ("init1", "step", "finish")
 
@@ -57,17 +59,29 @@ class BucketEngine:
 
     def __init__(self, cfg: Config, scope: str, template: CsrMatrix,
                  *, slots: int, chunk: int, dtype,
-                 fingerprint: str = "", aot: Optional[AotStore] = None):
+                 fingerprint: str = "", aot: Optional[AotStore] = None,
+                 hstore: Optional[HierarchyStore] = None):
         self.fingerprint = fingerprint
         self.slots = int(slots)
         self.chunk = int(chunk)
         self.dtype = jnp.dtype(dtype)
         self.trace_count = 0     # python traces of the engine functions
         self.aot_warm = False    # True when the fns came from the store
+        self.hier_restored = False  # hierarchy came from the hstore
+        # chaos drill: a scripted builder crash fires here, BEFORE any
+        # state exists — exactly like an OOM/trace failure would
+        _fi.service_crash("build_crash")
         with trace_region("serving.bucket_build"):
             t0 = time.perf_counter()
             self.bs = BatchedSolver(cfg, scope)
+            hkey = None
+            if hstore is not None:
+                hkey = hstore.key(fingerprint, self.bs.solver.cfg)
+                self.hier_restored = hstore.restore_into(
+                    hkey, self.bs.solver)
             self.bs.setup(template)
+            if hkey is not None and not self.hier_restored:
+                hstore.save(hkey, self.bs.solver)
             slv = self.bs.solver
             if slv.scaler is not None:
                 raise BadParametersError(
@@ -85,6 +99,8 @@ class BucketEngine:
         # slot bookkeeping is the scheduler's: the engine stores the
         # occupant object opaquely (a ticket, a request, anything)
         self.occupant: List[Optional[Any]] = [None] * self.slots
+        # last pulled per-slot iteration counters (progress heartbeat)
+        self.iters_snapshot: Optional[np.ndarray] = None
 
     # -- structure/value split --------------------------------------------
     def _split_data(self, template: CsrMatrix):
@@ -287,27 +303,44 @@ class BucketEngine:
         solve_data_bytes): the stacked data plus the carried state."""
         return (self._data_flat, list(self._state.values()), self._B)
 
+    def _splice_slot(self, slot: int, A: CsrMatrix, b):
+        """Shared admit prologue: value-resetup snapshot spliced into
+        the per-slot data rows + the rhs scatter."""
+        snap = self._snapshot_for(A)
+        for i, ax in enumerate(self._axes_flat):
+            if ax == 0:
+                self._data_flat[i] = \
+                    self._data_flat[i].at[slot].set(snap[i])
+        b = jnp.asarray(b, self.dtype)
+        if b.shape != (self.n,):
+            raise BadParametersError(
+                f"serving: rhs shape {b.shape} does not fit the "
+                f"bucket's ({self.n},) systems")
+        self._B = self._B.at[slot].set(b)
+        return snap, b
+
+    def _check_reserved(self, slot: int, occupant: Any):
+        # the lock-split scheduler reserves the slot (sets a UNIQUE
+        # occupant object) under its lock, then runs the device splice
+        # outside it — admitting into your own reservation is fine,
+        # anything else is a caller bug. The default occupant=True is
+        # not a reservation (True is True across calls), so direct
+        # engine users keep the strict occupied-slot guard.
+        if self.occupant[slot] is None:
+            return
+        if occupant is True or self.occupant[slot] is not occupant:
+            raise BadParametersError(f"serving: slot {slot} is occupied")
+
     def admit(self, slot: int, A: CsrMatrix, b, x0=None,
               occupant: Any = True):
         """Fill `slot` with a new system at a cycle boundary: splice
         its values into the per-slot data rows (value-resetup path),
         scatter its freshly initialized solve state, mark occupied."""
-        if self.occupant[slot] is not None:
-            raise BadParametersError(f"serving: slot {slot} is occupied")
+        self._check_reserved(slot, occupant)
         with trace_region("serving.admit"):
-            snap = self._snapshot_for(A)
-            for i, ax in enumerate(self._axes_flat):
-                if ax == 0:
-                    self._data_flat[i] = \
-                        self._data_flat[i].at[slot].set(snap[i])
-            b = jnp.asarray(b, self.dtype)
-            if b.shape != (self.n,):
-                raise BadParametersError(
-                    f"serving: rhs shape {b.shape} does not fit the "
-                    f"bucket's ({self.n},) systems")
+            snap, b = self._splice_slot(slot, A, b)
             x0 = self._zeros_single() if x0 is None \
                 else jnp.asarray(x0, self.dtype)
-            self._B = self._B.at[slot].set(b)
             row = self._init1(
                 jax.tree.unflatten(self._data_treedef, snap), b, x0)
             self._state = {
@@ -315,22 +348,74 @@ class BucketEngine:
                 for k in self._state}
         self.occupant[slot] = occupant
 
+    def admit_resume(self, slot: int, A: CsrMatrix, b, state_row,
+                     occupant: Any = True):
+        """Refill `slot` from a checkpointed solve-state row (crash
+        recovery / quarantine requeue): the same data splice as
+        `admit`, but the state is RESTORED, not re-initialized — the
+        resumed system then visits bit-identical iterates to the
+        uninterrupted solve (the chunk window is entry-relative).
+        Raises BadParametersError on a state-layout mismatch (config
+        drift across the restart); callers fall back to a fresh
+        admit."""
+        self._check_reserved(slot, occupant)
+        if set(state_row) != set(self._state):
+            raise BadParametersError(
+                "serving: checkpointed state keys do not match this "
+                "bucket's solve state (solver config drifted across "
+                "the restart?)")
+        with trace_region("serving.admit"):
+            _snap, b = self._splice_slot(slot, A, b)
+            for k, v in state_row.items():
+                ref = self._state[k]
+                v = jnp.asarray(v)
+                if v.shape != ref.shape[1:] or v.dtype != ref.dtype:
+                    raise BadParametersError(
+                        f"serving: checkpointed state leaf {k!r} has "
+                        f"{v.shape}/{v.dtype}, bucket expects "
+                        f"{ref.shape[1:]}/{ref.dtype}")
+            self._state = {
+                k: self._state[k].at[slot].set(jnp.asarray(v))
+                for k, v in state_row.items()}
+        self.occupant[slot] = occupant
+
+    def state_rows(self, slots: List[int]) -> Dict[int, Dict[str, Any]]:
+        """Host-pulled solve-state rows for `slots` — the checkpoint
+        source (and the quarantine salvage source). One device->host
+        pull per state leaf, sliced per slot."""
+        host = {k: np.asarray(v) for k, v in self._state.items()}
+        return {j: {k: host[k][j] for k in host} for j in slots}
+
     def step(self) -> List[int]:
         """One engine cycle: every occupied, unfinished slot advances
         up to `chunk` iterations (finished/empty slots are frozen by
         the loop predicate). Returns the occupied slots that are now
         terminal (converged, failed, or out of iterations) — ONE small
-        device->host sync per cycle, the scheduling cadence cost."""
+        device->host sync per cycle, the scheduling cadence cost.
+        `iters_snapshot` (same pulled buffer) is the supervisor's
+        progress heartbeat."""
         if self.idle:
+            return []
+        # chaos drills: a scripted device-step exception (the
+        # quarantine path's food) or a silently wedged cycle (the
+        # heartbeat detector's)
+        _fi.service_crash("step_crash")
+        if _fi.step_wedged():
             return []
         with trace_region("serving.step"):
             self._state = self._bstep(self._data_tree(), self._B,
                                       self._state)
             # one eager reduction, ONE awaited buffer: remote rigs pay
-            # a full round trip per awaited output (solvers/base.py)
-            term = np.asarray(
-                self._state["done"]
-                | (self._state["iters"] >= self.max_iters))
+            # a full round trip per awaited output (solvers/base.py);
+            # the iters row rides the same buffer for the supervisor's
+            # progress heartbeat
+            buf = np.asarray(jnp.stack([
+                (self._state["done"]
+                 | (self._state["iters"] >= self.max_iters)
+                 ).astype(jnp.int32),
+                self._state["iters"].astype(jnp.int32)]))
+            term = buf[0].astype(bool)
+            self.iters_snapshot = buf[1]
         return [j for j in range(self.slots)
                 if self.occupant[j] is not None and bool(term[j])]
 

@@ -13,12 +13,41 @@ package provides:
   value-resetup path (0.43 s at 256^3) instead of a full AMG setup
   (17 s); idle LRU buckets are evicted past the byte budget;
 - with `serving_aot_dir` set, engine executables round-trip through
-  the `AotStore`, so a restarted service skips first-request tracing;
+  the `AotStore`, and with `serving_hierarchy_dir` set the hierarchy
+  STRUCTURES persist too (`HierarchyStore`): a restarted service
+  rebuilds each bucket via load + structure-reuse + AOT — zero full
+  setups, zero retraces;
+- with `serving_journal_dir` set every request is journaled
+  (`SolveJournal`) and in-flight solve states are checkpointed every
+  `serving_checkpoint_cycles` cycles: a crashed process's successor
+  replays the journal and RESUMES mid-flight solves from their
+  checkpoints (bit-identical iterates — the chunked solve entry is
+  resumable by construction);
 - every request may carry a deadline: expiry completes the ticket
   with `DEADLINE_EXCEEDED` (its current iterate under the default
   'partial' action, the initial iterate under 'reject') at the next
-  cycle boundary — a late request can never stall its bucket — and
-  `serving_max_queue` bounds admission up front.
+  cycle boundary — a late request can never stall its bucket;
+- admission is a SHED policy, not just a bound: beyond the hard
+  `serving_max_queue` cap, `serving_shed_policy=deadline` rejects
+  requests whose deadline the live execution-time estimate (median
+  of recent in-bucket execs scaled by queue-depth waves, 25% margin)
+  says is unmeetable, and `serving_tenant_quota` bounds any one
+  tenant's live footprint — all shed completions carry status
+  `OVERLOADED` (the honest early rejection, never a
+  queued-then-missed surprise);
+- failures are supervised: bucket builds and device-step cycles that
+  raise (or wedge — the per-cycle progress heartbeat flatlines) are
+  routed through the `serving_fault_policy` grammar (BUILD_FAILED /
+  STEP_FAILED / WEDGED > retry_backoff / requeue / reject): the
+  bucket is quarantined, salvageable slots finalize with their
+  current iterate, the rest requeue (resuming from live state), and
+  rebuilds back off exponentially up to `serving_retry_max_attempts`.
+
+The scheduler lock is SPLIT from the device work (ROADMAP 3e): all
+hierarchy builds, admission resetups, engine chunk-stepping and
+finalize pulls run OUTSIDE the service lock, so a concurrent
+`submit()` contends only with microseconds of bookkeeping — never
+with a cycle of device work.
 
 Drive it synchronously (`step()` / `drain()`: deterministic, what the
 tests use) or start the background scheduler thread (`start()`), in
@@ -30,7 +59,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -38,17 +67,29 @@ from ..batch.queue import pattern_fingerprint
 from ..config import Config
 from ..errors import BadParametersError
 from ..matrix import CsrMatrix
+from ..resilience import faultinject as _fi
 from ..resilience.status import SolveStatus
 from ..solvers.base import SolveResult
 from ..telemetry import metrics as _tm
 from .aot import AotStore
 from .cache import HierarchyCache, solve_data_bytes
 from .engine import BucketEngine
+from .hstore import HierarchyStore
+from .journal import SolveJournal
 
 
-@dataclasses.dataclass
+def _now() -> float:
+    # every deadline computation reads the clock through the chaos
+    # hook so clock-skew drills are deterministic (faultinject)
+    return _fi.service_now()
+
+
+@dataclasses.dataclass(eq=False)
 class ServiceTicket:
-    """One submitted request; completes with a SolveResult."""
+    """One submitted request; completes with a SolveResult. Identity
+    semantics (eq=False): tickets are unique live objects — a
+    field-wise __eq__ over numpy members would be both meaningless
+    and ambiguous."""
 
     A: CsrMatrix
     b: np.ndarray
@@ -56,7 +97,7 @@ class ServiceTicket:
     tenant: str
     fingerprint: str
     submit_t: float
-    deadline_t: Optional[float]          # absolute time.monotonic()
+    deadline_t: Optional[float]          # absolute service_now() time
     result: Optional[SolveResult] = None
     complete_t: Optional[float] = None
     # has this request's cache routing (hit/miss) been counted yet?
@@ -65,6 +106,15 @@ class ServiceTicket:
     # the bucket-build exception when this request was rejected
     # because its bucket could not be built (status BREAKDOWN)
     error: Optional[Exception] = None
+    # client idempotency key (submit(request_key=...)): a retried
+    # submit with the same key dedupes against the live ticket or the
+    # journal instead of double-enqueueing
+    request_key: Optional[str] = None
+    # journal linkage + crash/quarantine resume state (a checkpointed
+    # solve-state row; admission then resumes instead of initializing)
+    journal_id: Optional[str] = None
+    resume_state: Optional[Dict[str, np.ndarray]] = None
+    admit_t: Optional[float] = None
     _event: threading.Event = dataclasses.field(
         default_factory=threading.Event)
 
@@ -83,7 +133,7 @@ class ServiceTicket:
 
     def _complete(self, result: SolveResult):
         self.result = result
-        self.complete_t = time.monotonic()
+        self.complete_t = _now()
         self._event.set()
 
 
@@ -99,9 +149,28 @@ class SolveService:
         self.max_queue = int(cfg.get("serving_max_queue", scope))
         self.deadline_action = str(
             cfg.get("serving_deadline_action", scope))
+        self.shed_policy = str(cfg.get("serving_shed_policy", scope))
+        self.tenant_quota = int(cfg.get("serving_tenant_quota", scope))
+        self.ckpt_cycles = int(
+            cfg.get("serving_checkpoint_cycles", scope))
+        self.supervisor_cycles = int(
+            cfg.get("serving_supervisor_cycles", scope))
+        self.retry_backoff_s = float(
+            cfg.get("serving_retry_backoff_s", scope))
+        self.retry_max = int(cfg.get("serving_retry_max_attempts",
+                                     scope))
+        from ..resilience.policy import parse_service_policy
+        self._svc_policy = parse_service_policy(
+            cfg.get("serving_fault_policy", scope))
         aot_dir = str(cfg.get("serving_aot_dir", scope)).strip()
         self.aot: Optional[AotStore] = \
             AotStore(aot_dir) if aot_dir else None
+        hier_dir = str(cfg.get("serving_hierarchy_dir", scope)).strip()
+        self.hstore: Optional[HierarchyStore] = \
+            HierarchyStore(hier_dir) if hier_dir else None
+        jdir = str(cfg.get("serving_journal_dir", scope)).strip()
+        self.journal: Optional[SolveJournal] = \
+            SolveJournal(jdir) if jdir else None
         # hit/miss is counted PER REQUEST at its build/admission (in
         # step()), not via the cache's own lookup counters — a queued
         # ticket polling a full bucket every cycle must not inflate
@@ -115,6 +184,9 @@ class SolveService:
             can_evict=lambda eng: eng.idle)
         self._queue: List[ServiceTicket] = []
         self._lock = threading.RLock()
+        # serializes whole scheduler cycles (one step() at a time);
+        # NEVER held while the bookkeeping lock is wanted by submit()
+        self._sched_lock = threading.RLock()
         self._thread: Optional[threading.Thread] = None
         self._stopping = False
         # async bucket builds (background-scheduler mode): fingerprint
@@ -122,23 +194,44 @@ class SolveService:
         self._builds: Dict[str, threading.Thread] = {}
         self._built: Dict[str, BucketEngine] = {}
         self._build_failed: Dict[str, Exception] = {}
+        # service-level fault bookkeeping (serving_fault_policy):
+        # fingerprint -> {"attempts", "not_before"} retry/backoff state
+        self._faulted: Dict[str, Dict[str, float]] = {}
+        # fingerprint -> (iters_heartbeat, stale_cycles) wedge detector
+        self._progress: Dict[str, Tuple[int, int]] = {}
         self._completed_total = 0
+        self._cycle = 0
+        # live request_key -> ticket (idempotent submit dedupe)
+        self._keyed: Dict[str, ServiceTicket] = {}
+        # recent in-bucket execution times (shed estimator window)
+        import collections
+        self._exec_recent = collections.deque(maxlen=64)
+        # completed journaled tickets awaiting their record_done write
+        # (flushed outside the lock each cycle)
+        self._journal_doneq: List[ServiceTicket] = []
         # per-tenant tallies for stats()
         self._tenants: Dict[str, Dict[str, int]] = {}
+        if self.journal is not None and \
+                int(cfg.get("serving_recover", scope)):
+            self.recover()
 
     # -- submission --------------------------------------------------------
     def _tenant(self, name: str) -> Dict[str, int]:
         return self._tenants.setdefault(
             name, {"submitted": 0, "completed": 0, "deadline_miss": 0,
-                   "rejected": 0})
+                   "rejected": 0, "shed": 0})
 
     def submit(self, A: CsrMatrix, b, x0=None, tenant: str = "default",
-               deadline_s: Optional[float] = None) -> ServiceTicket:
+               deadline_s: Optional[float] = None,
+               request_key: Optional[str] = None) -> ServiceTicket:
         """Enqueue one system. `deadline_s` is a relative budget from
         now; expiry completes the ticket with DEADLINE_EXCEEDED rather
-        than ever blocking the bucket. Thread-safe; issues no device
-        work of its own (it may briefly contend with the scheduler's
-        bookkeeping lock, but never with a hierarchy build)."""
+        than ever blocking the bucket. `request_key` makes the submit
+        idempotent: a retry with the same key returns the live ticket
+        (or a fresh ticket completed from the journaled result) instead
+        of enqueueing twice. Thread-safe; issues no device work of its
+        own and never waits on one — the scheduler's device cycles run
+        outside the bookkeeping lock (ROADMAP 3e)."""
         b = np.asarray(b)
         if b.ndim != 1:
             raise BadParametersError(
@@ -150,37 +243,175 @@ class SolveService:
             raise BadParametersError(
                 f"service.submit: rhs length {b.size} does not match "
                 f"the matrix ({A.num_rows * A.block_dimx} unknowns)")
-        now = time.monotonic()
+        if request_key:
+            dedup = self._dedupe(request_key)
+            if dedup is not None:
+                return dedup
+        now = _now()
         ticket = ServiceTicket(
             A=A, b=b, x0=None if x0 is None else np.asarray(x0),
             tenant=str(tenant),
             fingerprint=f"{pattern_fingerprint(A)}/{b.dtype}",
             submit_t=now,
             deadline_t=None if deadline_s is None
-            else now + float(deadline_s))
+            else now + float(deadline_s),
+            request_key=request_key or None)
         _tm.inc("serving.requests")
+        # ONE lock section for dedupe-recheck + shed decision + key
+        # registration + enqueue: splitting these would let concurrent
+        # submits breach the queue bound / tenant quota (check-then-act)
+        # or double-enqueue one request_key
         with self._lock:
+            if request_key:
+                live = self._keyed.get(request_key)
+                if live is not None:      # lost the race to a twin
+                    _tm.inc("serving.dedupe")
+                    return live
             self._tenant(ticket.tenant)["submitted"] += 1
-            if self.max_queue and len(self._queue) >= self.max_queue:
-                self._reject(ticket, queue_full=True)
+            shed = self._shed_reason(ticket, deadline_s)
+            if shed is not None:
+                self._shed(ticket, shed)
                 return ticket
+            if request_key:
+                self._keyed[request_key] = ticket
             self._queue.append(ticket)
             _tm.set_gauge("serving.queue_depth", len(self._queue))
+        # journal outside the lock (file IO must not block other
+        # submitters or the scheduler). The request only counts as
+        # accepted-durable once submit() RETURNS — a crash inside this
+        # window is indistinguishable from one before the submit. The
+        # background scheduler may complete the ticket while we write;
+        # the done-check below closes that window so the journal never
+        # keeps a pending record for a finished request (which would
+        # re-solve it at replay).
+        if self.journal is not None:
+            try:
+                ticket.journal_id = self.journal.record_submit(
+                    fingerprint=ticket.fingerprint, tenant=ticket.tenant,
+                    A=A, b=b, x0=ticket.x0,
+                    deadline_remaining_s=None if deadline_s is None
+                    else float(deadline_s),
+                    request_key=request_key or None)
+                if ticket.done:
+                    self._journal_done(ticket, ticket.result)
+            except Exception:
+                # durability degraded, service continues: the request
+                # is live in memory, only crash replay is lost for it
+                _tm.inc("serving.recovery.journal_corrupt")
         return ticket
 
-    def _reject(self, t: ServiceTicket, queue_full: bool = False):
-        """Complete without solving: the initial iterate and a
-        DEADLINE_EXCEEDED status (admission control, queued expiry, or
-        the reject-on-deadline action)."""
+    def _dedupe(self, request_key: str) -> Optional[ServiceTicket]:
+        """Idempotent-submit lookup: the live ticket with this key, or
+        a fresh ticket completed from the journaled result of an
+        already-finished request. None = genuinely new."""
+        with self._lock:
+            live = self._keyed.get(request_key)
+        if live is not None:
+            _tm.inc("serving.dedupe")
+            return live
+        if self.journal is None:
+            return None
+        rec = self.journal.lookup_key(request_key)
+        if rec is None or rec.get("status") != "done":
+            return None
+        res = self.journal.load_result(rec["id"])
+        if res is None:
+            return None
+        x, status_code, iterations = res
+        _tm.inc("serving.dedupe")
+        now = _now()
+        t = ServiceTicket(
+            A=None, b=np.asarray(x), x0=None,
+            tenant=rec.get("tenant", "default"),
+            fingerprint=rec.get("fingerprint", ""), submit_t=now,
+            deadline_t=None, request_key=request_key)
+        t._complete(SolveResult(
+            x=np.asarray(x), iterations=int(iterations),
+            converged=status_code == int(SolveStatus.CONVERGED),
+            res_norm=np.asarray(np.nan), norm0=np.asarray(np.nan),
+            status_code=int(status_code)))
+        return t
+
+    # -- load shedding -----------------------------------------------------
+    def _shed_reason(self, t: ServiceTicket,
+                     deadline_s: Optional[float]) -> Optional[str]:
+        """Admission control (lock held): None = admit, else the shed
+        class ('overload' queue bound / 'quota' tenant fairness /
+        'deadline' unmeetable-by-estimate)."""
+        if self.max_queue and len(self._queue) >= self.max_queue:
+            return "overload"
+        if self.tenant_quota:
+            live = sum(1 for q in self._queue if q.tenant == t.tenant)
+            for key in self.buckets.keys():
+                eng = self.buckets.peek(key)
+                if eng is None:
+                    continue
+                live += sum(1 for o in eng.occupant
+                            if o is not None and getattr(o, "tenant", None)
+                            == t.tenant)
+            if live >= self.tenant_quota:
+                return "quota"
+        if self.shed_policy == "deadline" and deadline_s is not None:
+            est = self._estimate_latency_s()
+            if est is not None and float(deadline_s) < est:
+                return "deadline"
+        return None
+
+    def _estimate_latency_s(self) -> Optional[float]:
+        """Deadline-feasibility estimate: the MEDIAN of this service's
+        recent in-bucket execution times (a bounded window, so one
+        cold-bucket trace outlier washes out and a restarted service
+        retrains within a few requests; the process-wide
+        serving.exec_s histogram p50 is the fallback before the window
+        fills) scaled by how many queue 'waves' are ahead (queue
+        depth over slot capacity), plus a 25% safety margin so
+        admitted work keeps its deadline promise. None while fully
+        untrained — an untrained estimator must never shed."""
+        if len(self._exec_recent) >= 3:
+            window = sorted(self._exec_recent)
+            est = window[len(window) // 2]
+        else:
+            est = _tm.quantile("serving.exec_s", 0.50)
+        if est is None or est <= 0:
+            return None
+        cap = 0
+        for key in self.buckets.keys():
+            eng = self.buckets.peek(key)
+            if eng is not None:
+                cap += eng.slots
+        cap = max(cap, self.slots, 1)
+        return 1.25 * (1.0 + len(self._queue) / cap) * float(est)
+
+    _SHED_COUNTERS = {"overload": "serving.shed.overload",
+                      "quota": "serving.shed.quota",
+                      "deadline": "serving.shed.deadline"}
+
+    def _shed(self, t: ServiceTicket, reason: str):
+        """Complete without solving: OVERLOADED + the initial iterate
+        (the early honest rejection — admitted work keeps its deadline
+        promise, unserviceable work finds out immediately)."""
         x = t.x0 if t.x0 is not None else np.zeros_like(t.b)
         _tm.inc("serving.rejected")
-        if not queue_full:
-            _tm.inc("serving.deadline_miss")
-            _tm.inc("serving.deadline_action.reject")
+        _tm.inc(self._SHED_COUNTERS[reason])
         tt = self._tenant(t.tenant)
         tt["rejected"] += 1
-        if not queue_full:
-            tt["deadline_miss"] += 1
+        tt["shed"] += 1
+        self._finish(t, SolveResult(
+            x=x, iterations=0, converged=False,
+            res_norm=np.asarray(np.inf), norm0=np.asarray(np.inf),
+            status_code=int(SolveStatus.OVERLOADED)))
+
+    def _reject(self, t: ServiceTicket):
+        """Complete without solving: the initial iterate and a
+        DEADLINE_EXCEEDED status (queued expiry, or the
+        reject-on-deadline action)."""
+        x = t.x0 if t.x0 is not None else np.zeros_like(t.b)
+        _tm.inc("serving.rejected")
+        _tm.inc("serving.deadline_miss")
+        _tm.inc("serving.deadline_action.reject")
+        tt = self._tenant(t.tenant)
+        tt["rejected"] += 1
+        tt["deadline_miss"] += 1
         self._finish(t, SolveResult(
             x=x, iterations=0, converged=False,
             res_norm=np.asarray(np.inf), norm0=np.asarray(np.inf),
@@ -191,12 +422,25 @@ class SolveService:
         self._tenant(t.tenant)["completed"] += 1
         self._completed_total += 1
         t._complete(result)
+        if t.request_key:
+            self._keyed.pop(t.request_key, None)
         # per-tenant solve-latency distribution: recorded for EVERY
         # terminal status (a deadline miss is latency the caller saw
         # too) so the p50/p99 the scrape reports are honest
         _tm.observe("serving.solve_latency_s",
                     t.complete_t - t.submit_t,
                     labels={"tenant": t.tenant})
+        if t.admit_t is not None:
+            # the in-bucket half: what the shed estimator reads
+            _tm.observe("serving.exec_s", t.complete_t - t.admit_t,
+                        labels={"tenant": t.tenant})
+            self._exec_recent.append(t.complete_t - t.admit_t)
+        if t.journal_id is not None and self.journal is not None:
+            # queued, not written: _finish runs under the service lock
+            # and journal completion is file IO (the whole solution
+            # vector) — the scheduler flushes the queue outside the
+            # lock at the end of the cycle (lock-split contract)
+            self._journal_doneq.append(t)
 
     def _fail_ticket(self, t: ServiceTicket, err: Exception):
         """Complete a ticket whose bucket build or admission raised:
@@ -210,12 +454,199 @@ class SolveService:
             res_norm=np.asarray(np.inf), norm0=np.asarray(np.inf),
             status_code=int(SolveStatus.BREAKDOWN)))
 
+    # -- crash recovery ----------------------------------------------------
+    def recover(self) -> int:
+        """Replay the journal (called automatically at construction
+        when `serving_recover=1` and a journal is configured): every
+        pending record re-enters the queue — resuming from its last
+        checkpoint when one exists — with its remaining deadline
+        budget re-anchored to the current clock. Corrupt records are
+        dropped and counted; they can never wedge the replay."""
+        if self.journal is None:
+            return 0
+        n = 0
+        for meta in self.journal.pending():
+            loaded = self.journal.load_request(meta)
+            if loaded is None:
+                self.journal.forget(meta["id"])
+                continue
+            A, b, x0, state, remaining = loaded
+            now = _now()
+            t = ServiceTicket(
+                A=A, b=np.asarray(b),
+                x0=None if x0 is None else np.asarray(x0),
+                tenant=meta.get("tenant", "default"),
+                fingerprint=meta["fingerprint"], submit_t=now,
+                deadline_t=None if remaining is None
+                else now + float(remaining),
+                request_key=meta.get("key"))
+            t.journal_id = meta["id"]
+            t.resume_state = state
+            _tm.inc("serving.recovery.replayed")
+            with self._lock:
+                self._tenant(t.tenant)["submitted"] += 1
+                if t.request_key:
+                    self._keyed[t.request_key] = t
+                self._queue.append(t)
+            n += 1
+        with self._lock:
+            _tm.set_gauge("serving.queue_depth", len(self._queue))
+        self.journal.prune()       # bound the done-record history
+        return n
+
+    def _journal_done(self, t: ServiceTicket, result: SolveResult):
+        """Persist one completed ticket's journal result. File IO —
+        callers must NOT hold the service lock."""
+        try:
+            self.journal.record_done(
+                t.journal_id, np.asarray(result.x),
+                int(result.status_code), int(result.iterations))
+        except Exception:
+            _tm.inc("serving.recovery.journal_corrupt")
+
+    def _flush_journal_done(self):
+        if self.journal is None:
+            return
+        with self._lock:
+            flush, self._journal_doneq = self._journal_doneq, []
+        for t in flush:
+            self._journal_done(t, t.result)
+
+    def _checkpoint(self):
+        """Journal the solve state of every journaled in-flight slot
+        (serving_checkpoint_cycles cadence). Device pulls + file IO,
+        all outside the service lock."""
+        from ..profiling import trace_region
+        with self._lock:
+            busy = [self.buckets.peek(k) for k in self.buckets.keys()]
+        with trace_region("serving.checkpoint"):
+            for eng in busy:
+                if eng is None or eng.idle:
+                    continue
+                slots = [j for j in range(eng.slots)
+                         if eng.occupant[j] is not None
+                         and getattr(eng.occupant[j], "journal_id",
+                                     None) is not None]
+                if not slots:
+                    continue
+                try:
+                    rows = eng.state_rows(slots)
+                except Exception:
+                    continue          # device trouble: supervisor's job
+                now = _now()
+                for j in slots:
+                    t = eng.occupant[j]
+                    if t is None or t.done:
+                        continue      # settled while we pulled
+                    remaining = None if t.deadline_t is None \
+                        else max(0.0, t.deadline_t - now)
+                    try:
+                        self.journal.record_checkpoint(
+                            t.journal_id, rows[j], remaining)
+                    except Exception:
+                        _tm.inc("serving.recovery.journal_corrupt")
+
+    # -- service-level fault policy ---------------------------------------
+    def _fault_action(self, fp: str, event: str) -> str:
+        """Next action for this fingerprint's failure chain (lock
+        held): consults serving_fault_policy, bounded by
+        serving_retry_max_attempts (beyond which: reject)."""
+        fl = self._faulted.setdefault(
+            fp, {"attempts": 0, "not_before": 0.0})
+        n = int(fl["attempts"])
+        fl["attempts"] = n + 1
+        chain = self._svc_policy.get(event) or ["reject"]
+        if n >= self.retry_max:
+            return "reject"
+        action = chain[min(n, len(chain) - 1)]
+        if action == "retry_backoff":
+            fl["not_before"] = _now() + \
+                self.retry_backoff_s * (2.0 ** n)
+        return action
+
+    def _handle_build_failure(self, fp: str, err: Exception,
+                              completed: List[ServiceTicket]):
+        """Build failed (lock held): reject the fingerprint's queued
+        tickets, or leave them queued behind a bounded backoff."""
+        action = self._fault_action(fp, "BUILD_FAILED")
+        if action == "reject":
+            self._faulted.pop(fp, None)
+            still = []
+            for t in self._queue:
+                if t.fingerprint == fp:
+                    self._fail_ticket(t, err)
+                    completed.append(t)
+                else:
+                    still.append(t)
+            self._queue = still
+        else:
+            _tm.inc("serving.recovery.build_retries")
+
+    def _quarantine(self, key: str, eng: BucketEngine, err, event: str,
+                    completed: List[ServiceTicket]):
+        """Remove a failed/wedged bucket from service (lock held):
+        finalize the slots whose state already carries a terminal
+        done-flag (salvageable — their iterate is complete), requeue
+        the rest with their live solve state as the resume point, and
+        route the rebuild through the fault policy.
+
+        The salvage pulls here are device work under the lock — a
+        deliberate exception to the lock split: quarantine is the rare
+        failure path, and dismantling a bucket must be atomic with the
+        admission bookkeeping (a concurrent submit must never observe
+        a half-quarantined engine as admittable)."""
+        from ..profiling import trace_region
+        _tm.inc("serving.recovery.quarantined")
+        with trace_region("serving.quarantine"):
+            occupied = [j for j in range(eng.slots)
+                        if eng.occupant[j] is not None]
+            try:
+                rows = eng.state_rows(occupied)
+            except Exception:
+                rows = None
+            salvage = [] if rows is None else \
+                [j for j in occupied if bool(rows[j].get("done", False))]
+            results = {}
+            if salvage:
+                try:
+                    results = eng.finalize(salvage)
+                except Exception:
+                    results = {}
+            requeue_tickets = []
+            for j in occupied:
+                t = eng.occupant[j]
+                eng.occupant[j] = None
+                if j in results:
+                    _tm.inc("serving.recovery.salvaged")
+                    self._finish(t, results[j])
+                    completed.append(t)
+                    continue
+                if rows is not None:
+                    t.resume_state = rows[j]
+                t.admit_t = None
+                _tm.inc("serving.recovery.requeued")
+                requeue_tickets.append(t)
+            self.buckets.pop(key)
+            self._progress.pop(key, None)
+            error = err if err is not None else \
+                RuntimeError(f"serving: bucket {event.lower()}")
+            action = self._fault_action(key, event)
+            if action == "reject":
+                self._faulted.pop(key, None)
+                for t in requeue_tickets:
+                    self._fail_ticket(t, error)
+                    completed.append(t)
+            else:
+                # front of the queue: they were in flight already
+                self._queue = requeue_tickets + self._queue
+
     # -- scheduling --------------------------------------------------------
     def _build_engine(self, t: ServiceTicket) -> BucketEngine:
         return BucketEngine(
             self.cfg, self.scope, t.A, slots=self.slots,
             chunk=self.chunk, dtype=t.b.dtype,
-            fingerprint=t.fingerprint, aot=self.aot)
+            fingerprint=t.fingerprint, aot=self.aot,
+            hstore=self.hstore)
 
     def _builder(self, t: ServiceTicket):
         """Builder-thread body: one bucket build off the scheduler
@@ -234,18 +665,24 @@ class SolveService:
 
     def step(self) -> List[ServiceTicket]:
         """One scheduler cycle: expire, build/install missing buckets,
-        admit, advance, finalize. Returns the tickets completed this
-        cycle. Bucket builds (a full AMG setup + engine traces —
-        seconds) never run under the service lock, so a concurrent
-        submit() never waits on one; with the background scheduler
-        running they happen on builder THREADS, so in-flight buckets
-        keep stepping while a cold fingerprint builds. Driven
-        synchronously (no start()), the build runs inline — one per
-        cycle, for the oldest unserved ticket — which keeps step()
+        admit, advance, finalize, checkpoint. Returns the tickets
+        completed this cycle. ALL device work — bucket builds,
+        admission resetups, chunk stepping, finalize pulls — runs
+        outside the service lock (ROADMAP 3e), so a concurrent
+        submit() only ever contends with bookkeeping. Cycles
+        themselves are serialized (one step() at a time). Driven
+        synchronously (no start()), builds run inline — one per cycle,
+        for the oldest unserved ticket — which keeps step()
         deterministic for tests."""
+        with self._sched_lock:
+            return self._step_impl()
+
+    def _step_impl(self) -> List[ServiceTicket]:
         completed: List[ServiceTicket] = []
+        self._cycle += 1
+        cand = None
         with self._lock:
-            now = time.monotonic()
+            now = _now()
             # 1. queued expiry: a request that died waiting never
             # touches a slot
             still = []
@@ -256,38 +693,45 @@ class SolveService:
                 else:
                     still.append(t)
             self._queue = still
-            # 2a. install builder-thread results; reject the queued
-            # tickets of a failed build (BREAKDOWN + .error) instead
-            # of retrying it forever
+            # 2a. install builder-thread results; route failed builds
+            # through the fault policy (reject / bounded retry)
             for fp in list(self._built):
                 eng = self._built.pop(fp)
                 if self.buckets.peek(fp) is None:
                     self.buckets.put(fp, eng,
                                      nbytes=solve_data_bytes(eng))
+                # NOTE: the fault-attempt counter is NOT reset here —
+                # a successful build proves nothing about stepping (a
+                # deterministically crashing bucket rebuilds fine
+                # every time); only a terminal completion (settle
+                # phase) clears it, so serving_retry_max_attempts
+                # actually bounds STEP_FAILED/WEDGED loops too
+                fl = self._faulted.get(fp)
+                if fl is not None:
+                    fl["not_before"] = 0.0
             if self._build_failed:
                 failed = dict(self._build_failed)
                 self._build_failed.clear()
-                still = []
-                for t in self._queue:
-                    err = failed.get(t.fingerprint)
-                    if err is None:
-                        still.append(t)
-                        continue
-                    self._fail_ticket(t, err)
-                    completed.append(t)
-                self._queue = still
+                for fp, err in failed.items():
+                    self._handle_build_failure(fp, err, completed)
             # 2b. pick at most ONE new build per cycle, for the OLDEST
             # unserved ticket (building every missing bucket up front
-            # would serialize all setups ahead of all progress)
-            cand = None
+            # would serialize all setups ahead of all progress);
+            # fingerprints inside a retry backoff window are skipped
             for t in self._queue:
-                if self.buckets.peek(t.fingerprint) is None \
-                        and t.fingerprint not in self._builds:
-                    cand = t
-                    break
+                fp = t.fingerprint
+                if self.buckets.peek(fp) is not None \
+                        or fp in self._builds:
+                    continue
+                fl = self._faulted.get(fp)
+                if fl is not None and fl["not_before"] > now:
+                    continue
+                cand = t
+                break
             if cand is not None:
-                _tm.inc("serving.cache.miss")
-                cand.cache_counted = True
+                if not cand.cache_counted:
+                    _tm.inc("serving.cache.miss")
+                    cand.cache_counted = True
                 if self._thread is not None:
                     th = threading.Thread(
                         target=self._builder, args=(cand,),
@@ -296,33 +740,30 @@ class SolveService:
                     th.start()
                     cand = None           # admission catches up later
         # 3. synchronous-mode build: inline, outside the lock; a build
-        # failure rejects the fingerprint's queued tickets exactly
-        # like the threaded path (never a raise out of step(), never
-        # an infinitely retried build)
+        # failure routes through the fault policy exactly like the
+        # threaded path (never a raise out of step(), never an
+        # unbounded retry)
         if cand is not None:
             try:
                 eng = self._build_engine(cand)
             except Exception as e:
                 with self._lock:
-                    still = []
-                    for t in self._queue:
-                        if t.fingerprint == cand.fingerprint:
-                            self._fail_ticket(t, e)
-                            completed.append(t)
-                        else:
-                            still.append(t)
-                    self._queue = still
+                    self._handle_build_failure(cand.fingerprint, e,
+                                               completed)
                 eng = None
             if eng is not None:
                 with self._lock:
                     if self.buckets.peek(cand.fingerprint) is None:
                         self.buckets.put(cand.fingerprint, eng,
                                          nbytes=solve_data_bytes(eng))
+                    fl = self._faulted.get(cand.fingerprint)
+                    if fl is not None:      # see step 2a note
+                        fl["not_before"] = 0.0
+        # 4. admission DECISIONS under the lock (slot reservations —
+        # strictly oldest-first across ALL buckets, the fairness
+        # contract), device splices outside it
+        admissions: List[Tuple[BucketEngine, int, ServiceTicket]] = []
         with self._lock:
-            # 4. admission, strictly oldest-first across ALL buckets
-            # (the fairness contract: a hot fingerprint's backlog
-            # cannot starve a cold tenant's single request); a ticket
-            # whose bucket is full blocks only ITS bucket
             blocked = set()
             remaining = []
             for t in self._queue:
@@ -331,8 +772,8 @@ class SolveService:
                     continue
                 eng = self.buckets.get(t.fingerprint)   # LRU touch
                 if eng is None:
-                    # built this cycle but immediately evicted (tiny
-                    # byte budget) or raced an eviction: retry next
+                    # not built yet / evicted under a tiny byte budget
+                    # or raced an eviction: retry next cycle
                     blocked.add(t.fingerprint)
                     remaining.append(t)
                     continue
@@ -344,36 +785,100 @@ class SolveService:
                 if not t.cache_counted:
                     _tm.inc("serving.cache.hit")
                     t.cache_counted = True
+                t.admit_t = _now()
                 _tm.observe("serving.queue_wait_s",
-                            time.monotonic() - t.submit_t,
+                            t.admit_t - t.submit_t,
                             labels={"tenant": t.tenant})
-                try:
-                    eng.admit(slot, t.A, t.b, x0=t.x0, occupant=t)
-                except Exception as e:
-                    # bad request (rhs length, structure drift):
-                    # complete THIS ticket with the error — an
-                    # admission raise must never wedge the queue or
-                    # kill the scheduler for the other tenants
-                    self._fail_ticket(t, e)
-                    completed.append(t)
-                    continue
-                _tm.set_gauge("serving.inflight", self._inflight())
+                eng.occupant[slot] = t      # reservation
+                admissions.append((eng, slot, t))
             self._queue = remaining
-            # 5. advance every busy bucket one cycle, then settle the
-            # terminal and deadline-expired slots
-            now = time.monotonic()
-            for key in self.buckets.keys():
-                eng = self.buckets.peek(key)
-                if eng is None or eng.idle:
-                    continue
+        # 5. the admission device work (value-resetup splice + state
+        # init/restore) — outside the lock
+        admit_failed: List[Tuple[ServiceTicket, Exception]] = []
+        for eng, slot, t in admissions:
+            try:
+                if t.resume_state is not None:
+                    try:
+                        eng.admit_resume(slot, t.A, t.b,
+                                         t.resume_state, occupant=t)
+                        _tm.inc("serving.recovery.resumed")
+                    except BadParametersError:
+                        # layout drifted (config change across the
+                        # restart): restart the solve clean
+                        _tm.inc("serving.recovery.restart_fresh")
+                        t.resume_state = None
+                        eng.admit(slot, t.A, t.b, x0=t.x0, occupant=t)
+                else:
+                    eng.admit(slot, t.A, t.b, x0=t.x0, occupant=t)
+            except Exception as e:
+                # bad request (rhs length, structure drift): complete
+                # THIS ticket with the error — an admission raise must
+                # never wedge the queue or kill the scheduler
+                eng.release(slot)
+                admit_failed.append((t, e))
+        # 6. advance every busy bucket one cycle — the device work the
+        # lock split exists for — then the finalize pulls, all outside
+        # the lock (engines are only ever touched by the scheduler)
+        with self._lock:
+            busy = [(k, self.buckets.peek(k))
+                    for k in self.buckets.keys()]
+        outcomes = []   # (key, eng, terminal, expired, results, err)
+        for key, eng in busy:
+            if eng is None or eng.idle:
+                continue
+            try:
                 terminal = set(eng.step())
-                expired = [
-                    j for j in range(eng.slots)
-                    if eng.occupant[j] is not None
-                    and j not in terminal
-                    and eng.occupant[j].deadline_t is not None
-                    and now >= eng.occupant[j].deadline_t]
+            except Exception as e:
+                outcomes.append((key, eng, set(), [], {}, e))
+                continue
+            now = _now()
+            expired = [
+                j for j in range(eng.slots)
+                if eng.occupant[j] is not None
+                and j not in terminal
+                and getattr(eng.occupant[j], "deadline_t", None)
+                is not None
+                and now >= eng.occupant[j].deadline_t]
+            try:
                 results = eng.finalize(sorted(terminal) + expired)
+            except Exception as e:
+                outcomes.append((key, eng, set(), [], {}, e))
+                continue
+            outcomes.append((key, eng, terminal, expired, results,
+                             None))
+        # 7. settle under the lock: complete tickets, wedge heartbeat,
+        # quarantine, eviction, gauges
+        with self._lock:
+            for t, e in admit_failed:
+                self._fail_ticket(t, e)
+                completed.append(t)
+            for key, eng, terminal, expired, results, err in outcomes:
+                if err is not None:
+                    self._quarantine(key, eng, err, "STEP_FAILED",
+                                     completed)
+                    continue
+                # progress heartbeat: a busy bucket that neither
+                # finished a slot nor advanced an iteration counter is
+                # wedging; `supervisor_cycles` consecutive flatlines
+                # quarantine it
+                if self.supervisor_cycles and not terminal \
+                        and not expired and not eng.idle:
+                    beat = -1 if eng.iters_snapshot is None \
+                        else int(np.sum(eng.iters_snapshot))
+                    last, stale = self._progress.get(key, (None, 0))
+                    stale = stale + 1 if beat == last else 0
+                    self._progress[key] = (beat, stale)
+                    if stale >= self.supervisor_cycles:
+                        self._quarantine(key, eng, None, "WEDGED",
+                                         completed)
+                        continue
+                else:
+                    self._progress.pop(key, None)
+                if terminal:
+                    # proven healthy: the bucket ran a solve to a
+                    # terminal status — THIS clears the fault-attempt
+                    # counter (not a mere successful rebuild)
+                    self._faulted.pop(key, None)
                 for j in sorted(terminal):
                     t = eng.occupant[j]
                     eng.release(j)
@@ -400,6 +905,14 @@ class SolveService:
             self.buckets.evict_to_budget()
             _tm.set_gauge("serving.queue_depth", len(self._queue))
             _tm.set_gauge("serving.inflight", self._inflight())
+        # 8. journal completions + checkpoint cadence + periodic prune
+        # (device pulls + file IO, all outside the lock)
+        self._flush_journal_done()
+        if self.journal is not None and self.ckpt_cycles > 0 \
+                and self._cycle % self.ckpt_cycles == 0:
+            self._checkpoint()
+        if self.journal is not None and self._cycle % 512 == 0:
+            self.journal.prune()
         return completed
 
     def _inflight(self) -> int:
@@ -457,7 +970,8 @@ class SolveService:
                 done = self.step()
                 if not done and self._inflight() == 0:
                     # nothing advanced: only waiting on builder
-                    # threads — don't spin the scheduler hot
+                    # threads or a retry backoff window — don't spin
+                    # the scheduler hot
                     time.sleep(poll_s)
 
         self._thread = threading.Thread(target=loop, daemon=True,
@@ -491,6 +1005,11 @@ class SolveService:
                     _tm.quantile("serving.queue_wait_s", 0.50),
                 "queue_wait_p99_s":
                     _tm.quantile("serving.queue_wait_s", 0.99),
+                "exec_p99_s": _tm.quantile("serving.exec_s", 0.99),
+                "journal_pending":
+                    0 if self.journal is None
+                    else len(self.journal.pending()),
+                "quarantined_fingerprints": len(self._faulted),
                 "tenants": {k: dict(v)
                             for k, v in self._tenants.items()},
             }

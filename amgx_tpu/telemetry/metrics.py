@@ -360,6 +360,10 @@ declare_counter("amg.resetup.value",
 declare_counter("amg.resetup.structure",
                 "structure-reuse resetups (kept levels re-valued, "
                 "deeper levels rebuilt)")
+declare_counter("amg.setup.restored",
+                "setups served from a persisted structure snapshot "
+                "(serving/hstore.py: load + structure-reuse rebuild — "
+                "the crash-recovery path that replaces a full setup)")
 
 # GEO Galerkin CSR-structure device cache (amg/aggregation/galerkin.py):
 # a miss at 256^3 re-uploads ~1 GB of structure arrays per warm setup
@@ -394,6 +398,11 @@ declare_counter("resilience.fallback.switch_solver",
                 "switch_solver actions run")
 declare_counter("resilience.fallback.escalate_sweeps",
                 "escalate_sweeps actions run")
+declare_counter("resilience.config_fallback",
+                "known-fault configurations rerouted at validation "
+                "time (e.g. MULTICOLOR_DILU at >96^3 rows on a TPU "
+                "-> the documented JACOBI_L1 fallback) instead of "
+                "failing at solve time")
 
 # jit retraces per solver entry point: a retrace in steady-state serving
 # is a latency cliff (first-request trace cost paid again)
@@ -472,6 +481,63 @@ declare_gauge("serving.live_buckets",
               "live serving buckets (each: hierarchy + engine traces)")
 declare_gauge("serving.cache.bytes",
               "estimated device bytes held by live serving buckets")
+
+# serving fault tolerance (serving/{journal,hstore}.py + the
+# service-level recovery/shed machinery in serving/service.py)
+declare_counter("serving.recovery.checkpoints",
+                "in-flight solve states journaled at cycle boundaries "
+                "(serving_checkpoint_cycles cadence)")
+declare_counter("serving.recovery.replayed",
+                "journaled requests re-admitted by a restarted service")
+declare_counter("serving.recovery.resumed",
+                "replayed requests that resumed from a checkpointed "
+                "iterate instead of iteration 0")
+declare_counter("serving.recovery.restart_fresh",
+                "replayed requests whose checkpoint was unusable "
+                "(missing/corrupt/layout drift) and restarted clean")
+declare_counter("serving.recovery.journal_corrupt",
+                "journal records dropped as corrupt during recovery "
+                "(torn writes; the rest of the journal still replays)")
+declare_counter("serving.recovery.quarantined",
+                "buckets quarantined by the supervisor (device-step "
+                "exception or flatlined progress heartbeat)")
+declare_counter("serving.recovery.salvaged",
+                "slots of a quarantined bucket finalized with their "
+                "current terminal iterate")
+declare_counter("serving.recovery.requeued",
+                "slots of a quarantined bucket requeued for a rebuilt "
+                "bucket (resuming from their live/checkpointed state)")
+declare_counter("serving.recovery.build_retries",
+                "bucket builds retried under the serving_fault_policy "
+                "backoff chain")
+declare_counter("serving.recovery.hstore_save",
+                "hierarchy structure snapshots persisted")
+declare_counter("serving.recovery.hstore_load",
+                "hierarchy structure snapshots restored (the restart "
+                "setup became a structure-reuse rebuild)")
+declare_counter("serving.recovery.hstore_skip",
+                "hierarchy snapshots skipped (a level class without "
+                "persistence support)")
+declare_counter("serving.recovery.hstore_error",
+                "hierarchy store save/load failures degraded to a "
+                "full setup")
+declare_counter("serving.dedupe",
+                "submits deduplicated against a live ticket or the "
+                "journal via the client request key")
+declare_counter("serving.shed.overload",
+                "requests shed OVERLOADED at the admission queue bound")
+declare_counter("serving.shed.deadline",
+                "requests shed OVERLOADED because the live latency "
+                "estimate said the deadline was unmeetable")
+declare_counter("serving.shed.quota",
+                "requests shed OVERLOADED by the per-tenant fairness "
+                "quota")
+declare_histogram("serving.exec_s",
+                  "slot-admission-to-complete execution time per "
+                  "request (seconds), labeled tenant=<id>; the "
+                  "in-bucket half of solve latency — what the shed "
+                  "policy's deadline-feasibility estimate reads",
+                  _LATENCY_EDGES_S)
 
 # device-memory watermarks per phase (memory_info allocator statistics
 # sampled at phase boundaries; the backend's own peak_bytes_in_use is

@@ -73,6 +73,13 @@ DECLARED_SPANS: Tuple[str, ...] = (
     "serving.bucket_build",
     "serving.aot_export",
     "serving.aot_load",
+    # serving fault tolerance: checkpoint/journal writes, restart
+    # replay, hierarchy-structure persistence, bucket quarantine
+    "serving.checkpoint",
+    "serving.recover",
+    "serving.quarantine",
+    "serving.hstore_save",
+    "serving.hstore_load",
     # solver-tree entry points (dynamic solver names: CG.solve, ...).
     # NO catch-all patterns belong here: a `<anything>.*` entry would
     # let any typo'd two-segment name pass the static registry check

@@ -805,6 +805,216 @@ def bench_serving(n: int = 32, smoke: bool = False,
     return out
 
 
+def bench_chaos(n: int = 16, smoke: bool = False):
+    """Chaos phase (serving fault tolerance, amgx_tpu/serving/ +
+    resilience/faultinject.py service kinds). Three measurements:
+
+    1. KILL-AND-RECOVER — a journaled + hierarchy-persisted + AOT'd
+       service is killed mid-flight; its successor replays the journal
+       and must (a) resume the checkpointed solves to final iterates
+       BIT-IDENTICAL to an uninterrupted run, (b) pay ZERO full AMG
+       setups (persisted structures) and ZERO engine retraces (AOT) —
+       `chaos_recover_wall_s` is the successor's construct-to-drained
+       wall, the restart-story headline.
+    2. SCRIPTED FAULT SCENARIOS — builder crash (with retry_backoff
+       recovery), device-step exception (quarantine + requeue), wedged
+       bucket (heartbeat supervisor), journal corruption (torn write
+       dropped at replay), AOT-store corruption (degrades to
+       retracing), clock-skewed deadlines. Gate: every scenario ends
+       with 100% of tickets terminal — no hangs, no lost requests.
+    3. SHED ACCURACY AT 2x SATURATION — open-loop arrivals at twice
+       the measured closed-loop service rate with per-request
+       deadlines and `serving_shed_policy=deadline`. Gates: sheds are
+       classified OVERLOADED, no ADMITTED request ends
+       DEADLINE_EXCEEDED, and the accepted p99 stays within the
+       deadline budget (`chaos_accepted_p99_ms`)."""
+    import shutil
+    import tempfile
+    from amgx_tpu.presets import SERVING_CG
+    from amgx_tpu.resilience import faultinject as fi
+    from amgx_tpu.resilience.status import SolveStatus
+    from amgx_tpu.serving import SolveService
+    from amgx_tpu.telemetry import metrics as _tm
+
+    if smoke:
+        n = 10
+    root = tempfile.mkdtemp(prefix="amgx_chaos_")
+    dirs = (f"serving_aot_dir={root}/aot,"
+            f" serving_hierarchy_dir={root}/hier,"
+            f" serving_journal_dir={root}/journal")
+    base_cfg = (SERVING_CG + ", serving_bucket_slots=4,"
+                " serving_chunk_iters=2")
+    A = amgx.gallery.poisson("7pt", n, n, n).init()
+    rng = np.random.default_rng(7)
+    bs = [rng.standard_normal(A.num_rows) for _ in range(6)]
+    out = {"grid": f"{n}^3 poisson7pt", "smoke": bool(smoke)}
+
+    def svc_new(extra=""):
+        return SolveService(Config.from_string(
+            base_cfg + (", " + extra if extra else "")))
+
+    # -- 1. kill-and-recover ---------------------------------------------
+    # tight tolerance + 1-iteration chunks so the kill lands
+    # mid-flight (the tiny grid would otherwise finish before it)
+    kr = "s:tolerance=1e-12, serving_chunk_iters=1"
+    ref = svc_new(kr)
+    refs = [ref.submit(A, b) for b in bs[:3]]
+    ref.drain(timeout_s=600)
+    jcfg = dirs + ", serving_checkpoint_cycles=1, " + kr
+    victim = svc_new(jcfg)
+    vt = [victim.submit(A, b, request_key=f"kr-{i}")
+          for i, b in enumerate(bs[:3])]
+    for _ in range(3):          # build + a couple of cycles, then die
+        victim.step()
+    out["killed_inflight"] = sum(not t.done for t in vt)
+    del victim
+    base = _tm.snapshot()
+    t0 = time.perf_counter()
+    succ = svc_new(jcfg)        # journal replays at construction
+    done = succ.drain(timeout_s=600)
+    recover_wall = time.perf_counter() - t0
+    cur = _tm.snapshot()
+
+    def delta(name):
+        return int(cur.get(name, 0) - base.get(name, 0))
+
+    by_key = {t.request_key: t for t in done if t.request_key}
+    bitwise = bool(by_key) \
+        and delta("serving.recovery.resumed") > 0 and all(
+        t.done and np.array_equal(np.asarray(t.result.x),
+                                  np.asarray(refs[int(k.split("-")[1])]
+                                             .result.x))
+        for k, t in by_key.items())
+    out.update({
+        "chaos_recover_wall_s": round(recover_wall, 3),
+        "recover_replayed": delta("serving.recovery.replayed"),
+        "recover_resumed": delta("serving.recovery.resumed"),
+        "recover_bitwise_ok": bitwise,
+        "restart_full_setups": delta("amg.setup.full"),
+        "restart_hier_restored": delta("amg.setup.restored"),
+        "restart_retraces": delta("serving.retrace"),
+        "recover_all_terminal": bool(all(t.done for t in done)
+                                     and succ.idle),
+    })
+
+    # -- 2. scripted fault scenarios -------------------------------------
+    scen_ok = {}
+
+    def terminal(tickets, svc):
+        return bool(all(t.done for t in tickets) and svc.idle)
+
+    # builder crash -> bounded backoff retry -> converges
+    svc = svc_new("serving_fault_policy=BUILD_FAILED>retry_backoff,"
+                  " serving_retry_backoff_s=0.01")
+    with fi.inject("build_crash", fires=1):
+        ts = [svc.submit(A, bs[0])]
+        svc.drain(timeout_s=600)
+    scen_ok["builder_crash"] = terminal(ts, svc) and \
+        ts[0].result.converged
+    # device-step exception -> quarantine -> requeue -> rebuilt bucket
+    svc = svc_new()
+    ts = [svc.submit(A, b) for b in bs[:2]]
+    svc.step()
+    with fi.inject("step_crash", fires=1):
+        svc.step()
+    svc.drain(timeout_s=600)
+    scen_ok["step_crash"] = terminal(ts, svc) and \
+        all(t.result.converged for t in ts)
+    # wedged bucket -> heartbeat supervisor quarantine
+    svc = svc_new("serving_supervisor_cycles=2")
+    ts = [svc.submit(A, bs[0])]
+    svc.step()
+    with fi.inject("step_wedge", fires=6):
+        for _ in range(6):
+            svc.step()
+    svc.drain(timeout_s=600)
+    scen_ok["wedged_bucket"] = terminal(ts, svc)
+    # journal torn write -> dropped at replay, successor keeps serving
+    jd2 = tempfile.mkdtemp(prefix="amgx_chaos_j2_")
+    svc = svc_new(f"serving_journal_dir={jd2}")
+    with fi.inject("journal_corrupt", fires=1):
+        svc.submit(A, bs[0])
+    del svc
+    svc = svc_new(f"serving_journal_dir={jd2}")
+    ts = [svc.submit(A, bs[1])]
+    svc.drain(timeout_s=600)
+    scen_ok["journal_corrupt"] = terminal(ts, svc) and \
+        ts[0].result.converged
+    # AOT-store torn write -> load fails -> degrades to retracing
+    ad2 = tempfile.mkdtemp(prefix="amgx_chaos_a2_")
+    with fi.inject("aot_corrupt", fires=None):
+        svc = svc_new(f"serving_aot_dir={ad2}")
+        svc.submit(A, bs[0])
+        svc.drain(timeout_s=600)
+    svc = svc_new(f"serving_aot_dir={ad2}")
+    ts = [svc.submit(A, bs[1])]
+    svc.drain(timeout_s=600)
+    scen_ok["aot_corrupt"] = terminal(ts, svc) and \
+        ts[0].result.converged
+    # clock skew: deadline bookkeeping under a shifted clock
+    with fi.inject("clock_skew", value=300.0, fires=None):
+        svc = svc_new()
+        ts = [svc.submit(A, bs[0], deadline_s=1e9),
+              svc.submit(A, bs[1])]
+        svc.drain(timeout_s=600)
+    scen_ok["clock_skew"] = terminal(ts, svc)
+    out["chaos_scenarios"] = scen_ok
+    out["chaos_all_terminal"] = bool(all(scen_ok.values()))
+
+    # -- 3. shedding at 2x saturation ------------------------------------
+    svc = svc_new("serving_shed_policy=deadline")
+    warm = [svc.submit(A, b) for b in bs[:4]]
+    svc.drain(timeout_s=600)          # warm + train the exec histogram
+    k = 8 if smoke else 24
+    t0 = time.perf_counter()
+    closed = [svc.submit(A, bs[i % len(bs)]) for i in range(k)]
+    svc.drain(timeout_s=600)
+    assert all(t.done for t in closed)
+    per_req = (time.perf_counter() - t0) / k   # closed-loop service rate
+    # deadline budget: a few multiples of the measured closed-loop
+    # per-request service time (about 2 execution waves at this bucket
+    # width), floored for rig noise — tight enough that a 2x-overdriven
+    # queue makes tail requests genuinely unmeetable, so the shed
+    # policy has real work to do
+    deadline_s = max(8 * per_req, 0.05)
+    arrival_dt = per_req / 2.0                 # 2x saturation arrivals
+    n_req = 24 if smoke else 48
+    tickets = []
+    t0 = time.perf_counter()
+    next_i = 0
+    while next_i < n_req or not svc.idle:
+        now = time.perf_counter() - t0
+        while next_i < n_req and now >= next_i * arrival_dt:
+            tickets.append(svc.submit(A, bs[next_i % len(bs)],
+                                      deadline_s=deadline_s))
+            next_i += 1
+        svc.step()
+        if time.perf_counter() - t0 > 600:   # pragma: no cover
+            break
+    svc.drain(timeout_s=600)
+    shed = [t for t in tickets if t.done and t.result.status_code
+            == int(SolveStatus.OVERLOADED)]
+    shed_ids = {id(t) for t in shed}
+    admitted = [t for t in tickets if id(t) not in shed_ids]
+    adm_miss = [t for t in admitted if t.done and t.result.status_code
+                == int(SolveStatus.DEADLINE_EXCEEDED)]
+    lat = sorted(1e3 * t.latency_s for t in admitted if t.done)
+    p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))] if lat else -1.0
+    out.update({
+        "shed_deadline_ms": round(1e3 * deadline_s, 2),
+        "shed_rate": round(len(shed) / max(len(tickets), 1), 3),
+        "chaos_accepted_p99_ms": round(p99, 2),
+        "shed_admitted_deadline_misses": len(adm_miss),
+        "shed_all_overloaded": bool(all(
+            t.result.status == "overloaded" for t in shed)),
+        "shed_ok": bool(all(t.done for t in tickets)
+                        and not adm_miss
+                        and (p99 < 0 or p99 <= 1e3 * deadline_s)),
+    })
+    shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def bench_resilience(n: int = 32, iters: int = 300, reps: int = 9):
     """Resilience smoke phase: per-iteration cost of the guarded solve
     loop (health_guards=1, the default: NaN/breakdown/divergence
@@ -1219,6 +1429,33 @@ def main():
     _checkpoint()
     gc.collect()
 
+    # chaos phase: serving fault tolerance — kill-and-recover wall
+    # (journal replay + persisted hierarchies + AOT: zero full setups,
+    # zero retraces, bit-identical resume), scripted fault scenarios
+    # all-terminal, shed accuracy at 2x saturation
+    try:
+        old = signal.signal(signal.SIGALRM, _on_alarm)
+        signal.alarm(300)
+        try:
+            ch = bench_chaos()
+            extra["chaos"] = ch
+            extra["chaos_recover_wall_s"] = ch["chaos_recover_wall_s"]
+            extra["chaos_accepted_p99_ms"] = \
+                ch["chaos_accepted_p99_ms"]
+            extra["chaos_all_terminal"] = ch["chaos_all_terminal"]
+            extra["chaos_recover_bitwise_ok"] = \
+                ch["recover_bitwise_ok"]
+            extra["chaos_shed_ok"] = ch["shed_ok"]
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)
+    except _Budget:  # pragma: no cover - timing dependent
+        extra["chaos_error"] = "wall-clock budget exceeded"
+    except Exception as e:  # pragma: no cover - bench robustness
+        extra["chaos_error"] = str(e)[:200]
+    _checkpoint()
+    gc.collect()
+
     # resilience smoke phase: guarded vs unguarded iteration-loop cost
     # (BENCH_* tracks that the health guards stay within 2% of baseline)
     try:
@@ -1464,6 +1701,31 @@ if __name__ == "__main__":
             "unit": "solves/s",
             "vs_baseline": 0.0,
             "artifact": "BENCH_serving.json",
+            "extra": {k: v for k, v in res.items()
+                      if not isinstance(v, (dict, list))},
+        }), flush=True)
+    elif sys.argv[1:2] == ["chaos"]:
+        # standalone chaos phase: `python bench.py chaos` (full) or
+        # `python bench.py chaos --smoke` (tier-1 fast path)
+        amgx.initialize()
+        res = bench_chaos(smoke="--smoke" in sys.argv[2:])
+        try:
+            import os
+            art = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BENCH_chaos.json")
+            with open(art, "w") as f:
+                json.dump(res, f, indent=1)
+                f.write("\n")
+        except Exception as e:  # pragma: no cover - bench robustness
+            res["artifact_error"] = str(e)[:120]
+        print(json.dumps({
+            "metric": "serving kill-and-recover wall (journal replay "
+                      "+ persisted hierarchies + AOT warm start)",
+            "value": res["chaos_recover_wall_s"],
+            "unit": "s",
+            "vs_baseline": 0.0,
+            "artifact": "BENCH_chaos.json",
             "extra": {k: v for k, v in res.items()
                       if not isinstance(v, (dict, list))},
         }), flush=True)

@@ -420,3 +420,132 @@ class TestSurfacing:
         with pytest.raises(AMGXError) as ei:
             amgx.create_solver(Config.from_string("solver=GMRS"))
         assert "GMRES" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# service-level resilience (PR 11): policy grammar, chaos hooks, the
+# OVERLOADED status, and the known-fault config guard
+# ---------------------------------------------------------------------------
+
+
+class TestServicePolicy:
+    def test_parse_service_policy_grammar(self):
+        from amgx_tpu.resilience.policy import parse_service_policy
+        pol = parse_service_policy(
+            "BUILD_FAILED>retry_backoff|BUILD_FAILED>reject"
+            "|STEP_FAILED>requeue|WEDGED>requeue")
+        assert pol == {"BUILD_FAILED": ["retry_backoff", "reject"],
+                       "STEP_FAILED": ["requeue"],
+                       "WEDGED": ["requeue"]}
+        assert parse_service_policy("") == {}
+
+    def test_parse_service_policy_did_you_mean(self):
+        from amgx_tpu.resilience.policy import parse_service_policy
+        with pytest.raises(BadConfigurationError) as ei:
+            parse_service_policy("BUILD_FAILD>reject")
+        assert "BUILD_FAILED" in str(ei.value)
+        with pytest.raises(BadConfigurationError) as ei:
+            parse_service_policy("WEDGED>retry_bakoff")
+        assert "retry_backoff" in str(ei.value)
+        with pytest.raises(BadConfigurationError):
+            parse_service_policy("WEDGED-requeue")
+
+    def test_overloaded_status_surfaces(self):
+        from amgx_tpu.resilience.status import (status_string,
+                                                to_amgx_status)
+        assert int(SolveStatus.OVERLOADED) == 7
+        assert status_string(SolveStatus.OVERLOADED) == "overloaded"
+        # C API coarsens it to NOT_CONVERGED like the deadline class
+        assert to_amgx_status(SolveStatus.OVERLOADED) == 3
+        # and it plugs into the solve-level fallback grammar
+        assert parse_fallback_policy("OVERLOADED>retry") == {
+            int(SolveStatus.OVERLOADED): [("retry", "")]}
+
+
+class TestServiceChaosKinds:
+    def test_service_crash_consumes_fires(self):
+        with fi.inject("build_crash", fires=1):
+            with pytest.raises(fi.ChaosInjected):
+                fi.service_crash("build_crash")
+            fi.service_crash("build_crash")     # fires spent: inert
+        fi.service_crash("build_crash")         # disarmed: inert
+
+    def test_kinds_are_independent(self):
+        """An armed step fault never triggers the build hook (and vice
+        versa) — scripted scenarios target one seam at a time."""
+        with fi.inject("step_crash", fires=1):
+            fi.service_crash("build_crash")     # inert
+            assert not fi.step_wedged()
+            with pytest.raises(fi.ChaosInjected):
+                fi.service_crash("step_crash")
+
+    def test_corrupt_blob_torn_write(self):
+        blob = b"0123456789abcdef"
+        assert fi.corrupt_blob("journal_corrupt", blob) == blob
+        with fi.inject("journal_corrupt", fires=1):
+            out = fi.corrupt_blob("journal_corrupt", blob)
+            assert out != blob and len(out) < len(blob)
+            # one firing: the next write goes through clean
+            assert fi.corrupt_blob("journal_corrupt", blob) == blob
+
+    def test_service_now_skew(self):
+        import time as _time
+        base = _time.monotonic()
+        with fi.inject("clock_skew", value=500.0, fires=None):
+            assert fi.service_now() - base > 400.0
+        assert abs(fi.service_now() - _time.monotonic()) < 5.0
+
+    def test_step_wedge_consumes_per_cycle(self):
+        with fi.inject("step_wedge", fires=2):
+            assert fi.step_wedged()
+            assert fi.step_wedged()
+            assert not fi.step_wedged()
+
+
+class TestKnownFaultGuard:
+    def test_dilu_tpu_guard_reroutes_to_jacobi_l1(self, monkeypatch):
+        """The known MULTICOLOR_DILU >96^3 single-chip TPU runtime
+        fault is caught at setup/config-validation time: the smoother
+        reroutes to the documented JACOBI_L1 fallback with a counter
+        and a warning — instead of faulting at solve time."""
+        import jax
+        from amgx_tpu.amg.hierarchy import AMG
+        from amgx_tpu.telemetry import metrics
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(jax, "device_count", lambda backend=None: 1)
+        monkeypatch.setattr(AMG, "DILU_TPU_FAULT_MIN_ROWS", 100)
+        cf0 = metrics.get("resilience.config_fallback")
+        slv = amgx.create_solver(Config.from_string(
+            "solver(s)=PCG, s:max_iters=80, s:tolerance=1e-8,"
+            " s:convergence=RELATIVE_INI, s:monitor_residual=1,"
+            " s:preconditioner(amg)=AMG, amg:algorithm=CLASSICAL,"
+            " amg:selector=PMIS, amg:interpolator=D1,"
+            " amg:smoother=MULTICOLOR_DILU, amg:max_iters=1,"
+            " amg:coarse_solver=DENSE_LU_SOLVER"))
+        A = _poisson16()
+        slv.setup(A)
+        assert metrics.get("resilience.config_fallback") - cf0 >= 1
+        amg_node = slv.preconditioner.amg
+        assert all(lvl.smoother.name == "JACOBI_L1"
+                   for lvl in amg_node.levels)
+        res = slv.solve(np.ones(A.num_rows))
+        assert res.converged
+
+    def test_dilu_guard_inert_below_threshold_and_off_tpu(self):
+        """On non-TPU rigs (and below the validated size) the guard
+        never fires: the configured smoother is honored."""
+        from amgx_tpu.telemetry import metrics
+        cf0 = metrics.get("resilience.config_fallback")
+        slv = amgx.create_solver(Config.from_string(
+            "solver(s)=PCG, s:max_iters=80, s:tolerance=1e-8,"
+            " s:convergence=RELATIVE_INI, s:monitor_residual=1,"
+            " s:preconditioner(amg)=AMG, amg:algorithm=CLASSICAL,"
+            " amg:selector=PMIS, amg:interpolator=D1,"
+            " amg:smoother=MULTICOLOR_DILU, amg:max_iters=1,"
+            " amg:coarse_solver=DENSE_LU_SOLVER"))
+        A = _poisson16()
+        slv.setup(A)
+        assert metrics.get("resilience.config_fallback") - cf0 == 0
+        amg_node = slv.preconditioner.amg
+        assert any(lvl.smoother.name == "MULTICOLOR_DILU"
+                   for lvl in amg_node.levels)

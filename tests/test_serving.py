@@ -3,9 +3,17 @@ continuous-batching parity vs one-shot solve_many, slot refill without
 retrace, per-tenant deadlines (expiry -> DEADLINE_EXCEEDED, never a
 hung bucket), hierarchy-cache routing to value-resetup, bytes-budgeted
 eviction, AOT round-trip with zero retraces, batcher fairness/LRU
-satellites, and the capi + bench surfaces. No reference analog — AMGX
+satellites, the capi + bench surfaces — and the fault-tolerance layer:
+journaled crash recovery with bit-identical checkpoint resume,
+persisted hierarchy structures (restart without a full setup), the
+scheduler lock split (submit never waits on device work), OVERLOADED
+load shedding, and the service-level chaos scenarios (builder crash,
+device-step exception, wedged bucket, store corruption, clock skew —
+every one must end all-tickets-terminal). No reference analog — AMGX
 is consumed AS a service library; the service loop itself is new."""
 import os
+import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -18,11 +26,12 @@ from amgx_tpu.batch import BatchedSolver, RequestBatcher
 from amgx_tpu.batch.queue import pattern_fingerprint
 from amgx_tpu.config import Config
 from amgx_tpu.presets import BATCHED_CG, SERVING_CG
+from amgx_tpu.resilience import faultinject
 from amgx_tpu.resilience.policy import parse_fallback_policy
 from amgx_tpu.resilience.status import (SolveStatus, status_string,
                                         to_amgx_status)
-from amgx_tpu.serving import (HierarchyCache, SolveService,
-                              solve_data_bytes)
+from amgx_tpu.serving import (BucketEngine, HierarchyCache,
+                              SolveService, solve_data_bytes)
 from amgx_tpu.solvers.base import Solver
 from amgx_tpu.telemetry import metrics
 
@@ -251,15 +260,20 @@ def test_deadline_action_reject_returns_initial_iterate(poisson16):
 
 def test_admission_control_queue_bound(poisson16):
     """serving_max_queue: over-budget submits complete immediately
-    with DEADLINE_EXCEEDED instead of growing the queue."""
+    with OVERLOADED (the honest shed class — DEADLINE_EXCEEDED is
+    reserved for admitted work that ran out of time) instead of
+    growing the queue."""
     svc = SolveService(_svc_cfg(extra="serving_max_queue=1"))
     rej0 = metrics.get("serving.rejected")
+    ovl0 = metrics.get("serving.shed.overload")
     t1 = svc.submit(poisson16, _rhs(poisson16, 7))
     t2 = svc.submit(poisson16, _rhs(poisson16, 8))
     assert not t1.done
     assert t2.done and t2.result.status_code == \
-        int(SolveStatus.DEADLINE_EXCEEDED)
+        int(SolveStatus.OVERLOADED)
+    assert t2.result.status == "overloaded"
     assert metrics.get("serving.rejected") - rej0 == 1
+    assert metrics.get("serving.shed.overload") - ovl0 == 1
     svc.drain(timeout_s=300)
     assert t1.result.converged
 
@@ -465,6 +479,386 @@ def test_capi_service_roundtrip(poisson16):
 
 
 # ---------------------------------------------------------------------------
+# fault tolerance: journal, checkpoints, crash recovery
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_restart_resumes_bit_identical(poisson16, tmp_path):
+    """THE recovery acceptance: a service killed mid-flight is
+    replaced by a successor that replays the journal and resumes the
+    checkpointed solve — reaching a final iterate BIT-IDENTICAL to an
+    uninterrupted run, at the same iteration count."""
+    b = _rhs(poisson16, 30)
+    kr = (f"serving_journal_dir={tmp_path}, serving_checkpoint_cycles=1,"
+          " serving_chunk_iters=1, s:tolerance=1e-12")
+    ref = SolveService(_svc_cfg(
+        extra="serving_chunk_iters=1, s:tolerance=1e-12"))
+    rt = ref.submit(poisson16, b)
+    ref.drain(timeout_s=300)
+    victim = SolveService(_svc_cfg(extra=kr))
+    vt = victim.submit(poisson16, b, tenant="acme", deadline_s=1e6,
+                       request_key="kr-0")
+    for _ in range(4):               # build + a few cycles, then die
+        victim.step()
+    assert not vt.done               # genuinely mid-flight
+    del victim
+    rep0 = metrics.get("serving.recovery.replayed")
+    res0 = metrics.get("serving.recovery.resumed")
+    succ = SolveService(_svc_cfg(extra=kr))   # journal replays here
+    assert metrics.get("serving.recovery.replayed") - rep0 == 1
+    done = succ.drain(timeout_s=300)
+    assert len(done) == 1 and done[0].done
+    assert metrics.get("serving.recovery.resumed") - res0 == 1
+    assert done[0].result.iterations == rt.result.iterations
+    np.testing.assert_array_equal(np.asarray(done[0].result.x),
+                                  np.asarray(rt.result.x))
+    # deadline survived the restart (remaining budget re-anchored)
+    assert done[0].result.converged
+    assert succ.stats()["journal_pending"] == 0
+
+
+def test_submit_request_key_idempotent(poisson16, tmp_path):
+    """The idempotency satellite: a retried submit with the same
+    request_key returns the LIVE ticket while in flight, and after
+    completion (even across a restart) a fresh ticket completed from
+    the journaled result — never a second enqueue."""
+    b = _rhs(poisson16, 31)
+    cfg = _svc_cfg(extra=f"serving_journal_dir={tmp_path}")
+    svc = SolveService(cfg)
+    ded0 = metrics.get("serving.dedupe")
+    t1 = svc.submit(poisson16, b, request_key="abc")
+    t2 = svc.submit(poisson16, b, request_key="abc")
+    assert t2 is t1                  # live dedupe: the same ticket
+    assert metrics.get("serving.dedupe") - ded0 == 1
+    svc.drain(timeout_s=300)
+    assert t1.result.converged
+    # across a "restart": the journaled result answers the retry
+    svc2 = SolveService(cfg)
+    t3 = svc2.submit(poisson16, b, request_key="abc")
+    assert t3.done and t3 is not t1
+    assert metrics.get("serving.dedupe") - ded0 == 2
+    np.testing.assert_array_equal(np.asarray(t3.result.x),
+                                  np.asarray(t1.result.x))
+    assert svc2.idle                 # nothing was enqueued
+
+
+def test_journal_corrupt_record_dropped_not_wedged(poisson16, tmp_path):
+    """A torn-write-corrupted journal record is dropped (and counted)
+    at replay; the records around it still recover — corruption can
+    cost one request's durability, never the service."""
+    cfg = _svc_cfg(extra=f"serving_journal_dir={tmp_path},"
+                         " serving_chunk_iters=1, s:tolerance=1e-12")
+    svc = SolveService(cfg)
+    svc.submit(poisson16, _rhs(poisson16, 32))        # clean pattern
+    with faultinject.inject("journal_corrupt", fires=1):
+        svc.submit(poisson16, _rhs(poisson16, 33))    # corrupt record
+    svc.submit(poisson16, _rhs(poisson16, 34))        # clean record
+    del svc
+    jc0 = metrics.get("serving.recovery.journal_corrupt")
+    rep0 = metrics.get("serving.recovery.replayed")
+    succ = SolveService(cfg)
+    assert metrics.get("serving.recovery.journal_corrupt") - jc0 == 1
+    assert metrics.get("serving.recovery.replayed") - rep0 == 2
+    done = succ.drain(timeout_s=300)
+    assert len(done) == 2 and all(t.result.converged for t in done)
+    assert succ.idle
+
+
+def test_hierarchy_store_restart_zero_full_setups(geo10, tmp_path):
+    """The persistent-hierarchy acceptance: a restarted service with a
+    warm hierarchy store + AOT store services its first request via
+    snapshot load + structure-reuse rebuild + AOT executables — ZERO
+    full AMG setups, ZERO engine retraces, identical results."""
+    cfg = _svc_cfg(base=SERVING_CG,
+                   extra=f"serving_hierarchy_dir={tmp_path}/h,"
+                         f" serving_aot_dir={tmp_path}/a")
+    b = _rhs(geo10, 35)
+    hs0 = metrics.get("serving.recovery.hstore_save")
+    svc1 = SolveService(cfg)
+    t1 = svc1.submit(geo10, b)
+    svc1.drain(timeout_s=300)
+    assert metrics.get("serving.recovery.hstore_save") - hs0 == 1
+    full0 = metrics.get("amg.setup.full")
+    rest0 = metrics.get("amg.setup.restored")
+    retr0 = metrics.get("serving.retrace")
+    svc2 = SolveService(cfg)           # the "restarted process"
+    t2 = svc2.submit(geo10, b)
+    svc2.drain(timeout_s=300)
+    assert metrics.get("amg.setup.full") - full0 == 0
+    assert metrics.get("amg.setup.restored") - rest0 == 1
+    assert metrics.get("serving.retrace") - retr0 == 0
+    eng = svc2.buckets.peek(t2.fingerprint)
+    assert eng.hier_restored and eng.aot_warm
+    np.testing.assert_array_equal(np.asarray(t2.result.x),
+                                  np.asarray(t1.result.x))
+
+
+# ---------------------------------------------------------------------------
+# lock split (ROADMAP 3e)
+# ---------------------------------------------------------------------------
+
+
+def test_submit_never_waits_for_device_cycle(poisson16, monkeypatch):
+    """The lock-split contention proof: while a scheduler cycle is
+    blocked inside device stepping, submit() still completes — it
+    contends only with bookkeeping, never with a cycle of device
+    work (ROADMAP 3e)."""
+    svc = SolveService(_svc_cfg(
+        extra="serving_chunk_iters=1, s:tolerance=1e-14"))
+    t1 = svc.submit(poisson16, _rhs(poisson16, 36))
+    svc.step()                          # build + admit
+    assert not t1.done
+    in_step, release = threading.Event(), threading.Event()
+    orig_step = BucketEngine.step
+
+    def blocked_step(self):
+        in_step.set()
+        assert release.wait(30)
+        return orig_step(self)
+
+    monkeypatch.setattr(BucketEngine, "step", blocked_step)
+    th = threading.Thread(target=svc.step)
+    th.start()
+    try:
+        assert in_step.wait(30)         # cycle is inside device work
+        t0 = time.monotonic()
+        t2 = svc.submit(poisson16, _rhs(poisson16, 37))
+        dt = time.monotonic() - t0
+        assert th.is_alive()            # the cycle is STILL blocked
+        assert not t2.done and dt < 5.0
+    finally:
+        release.set()
+        th.join()
+    monkeypatch.setattr(BucketEngine, "step", orig_step)
+    svc.drain(timeout_s=300)
+    assert t1.result.converged and t2.result.converged
+
+
+# ---------------------------------------------------------------------------
+# backpressure & load shedding
+# ---------------------------------------------------------------------------
+
+
+def test_shed_deadline_unmeetable_overloaded(poisson16):
+    """serving_shed_policy=deadline: once the live estimator is
+    trained, a request whose deadline cannot be met at the current
+    queue depth is shed OVERLOADED at submit — before it ever queues."""
+    svc = SolveService(_svc_cfg(
+        extra="serving_shed_policy=deadline"))
+    warm = svc.submit(poisson16, _rhs(poisson16, 38))
+    svc.drain(timeout_s=300)
+    assert warm.result.converged       # estimator now trained
+    svc._exec_recent.extend([0.05, 0.05, 0.05])
+    shd0 = metrics.get("serving.shed.deadline")
+    t = svc.submit(poisson16, _rhs(poisson16, 39), deadline_s=1e-4)
+    assert t.done
+    assert t.result.status_code == int(SolveStatus.OVERLOADED)
+    assert metrics.get("serving.shed.deadline") - shd0 == 1
+    # a generous deadline is admitted and served normally
+    t2 = svc.submit(poisson16, _rhs(poisson16, 40), deadline_s=1e6)
+    svc.drain(timeout_s=300)
+    assert t2.result.converged
+
+
+def test_shed_tenant_quota(poisson16):
+    """serving_tenant_quota: a tenant at its live-request quota has
+    further submits shed OVERLOADED; other tenants are unaffected."""
+    svc = SolveService(_svc_cfg(extra="serving_tenant_quota=1"))
+    q0 = metrics.get("serving.shed.quota")
+    t1 = svc.submit(poisson16, _rhs(poisson16, 41), tenant="greedy")
+    t2 = svc.submit(poisson16, _rhs(poisson16, 42), tenant="greedy")
+    t3 = svc.submit(poisson16, _rhs(poisson16, 43), tenant="modest")
+    assert not t1.done and not t3.done
+    assert t2.done and t2.result.status == "overloaded"
+    assert metrics.get("serving.shed.quota") - q0 == 1
+    assert svc.stats()["tenants"]["greedy"]["shed"] == 1
+    svc.drain(timeout_s=300)
+    assert t1.result.converged and t3.result.converged
+
+
+# ---------------------------------------------------------------------------
+# supervision, quarantine & the service-level chaos scenarios
+# ---------------------------------------------------------------------------
+
+
+def test_step_crash_quarantines_and_resumes_bit_identical(poisson16):
+    """A device-step exception mid-flight quarantines the bucket: the
+    in-flight slot requeues with its LIVE state, the rebuilt bucket
+    resumes it, and the final iterate is bit-identical to a run that
+    never crashed (default policy: STEP_FAILED>requeue)."""
+    extra = "serving_chunk_iters=1, s:tolerance=1e-12"
+    ref = SolveService(_svc_cfg(extra=extra))
+    b = _rhs(poisson16, 44)
+    rt = ref.submit(poisson16, b)
+    ref.drain(timeout_s=300)
+    svc = SolveService(_svc_cfg(extra=extra))
+    q0 = metrics.get("serving.recovery.quarantined")
+    rq0 = metrics.get("serving.recovery.requeued")
+    t = svc.submit(poisson16, b)
+    svc.step()                          # build + admit + first cycle
+    with faultinject.inject("step_crash", fires=1):
+        svc.step()                      # crashes -> quarantine
+    assert metrics.get("serving.recovery.quarantined") - q0 == 1
+    assert metrics.get("serving.recovery.requeued") - rq0 == 1
+    assert not t.done
+    svc.drain(timeout_s=300)
+    assert t.result.converged
+    assert t.result.iterations == rt.result.iterations
+    np.testing.assert_array_equal(np.asarray(t.result.x),
+                                  np.asarray(rt.result.x))
+
+
+def test_wedged_bucket_detected_and_recovered(poisson16):
+    """The supervisor satellite: a bucket whose progress heartbeat
+    flatlines (scripted step_wedge — cycles run, iteration counters
+    frozen) is quarantined after serving_supervisor_cycles and its
+    work requeued; the scheduler never hangs."""
+    svc = SolveService(_svc_cfg(
+        extra="serving_supervisor_cycles=2, serving_chunk_iters=1,"
+              " s:tolerance=1e-12"))
+    q0 = metrics.get("serving.recovery.quarantined")
+    t = svc.submit(poisson16, _rhs(poisson16, 45))
+    svc.step()
+    with faultinject.inject("step_wedge", fires=4):
+        for _ in range(5):
+            svc.step()
+    assert metrics.get("serving.recovery.quarantined") - q0 >= 1
+    svc.drain(timeout_s=300)
+    assert t.done and t.result.converged
+
+
+def test_build_crash_retry_backoff_converges(poisson16):
+    """BUILD_FAILED>retry_backoff: a crashed bucket build leaves its
+    tickets queued behind a bounded exponential backoff; the retry
+    succeeds and the tickets converge (vs the default reject)."""
+    svc = SolveService(_svc_cfg(
+        extra="serving_fault_policy=BUILD_FAILED>retry_backoff,"
+              " serving_retry_backoff_s=0.01"))
+    r0 = metrics.get("serving.recovery.build_retries")
+    with faultinject.inject("build_crash", fires=1):
+        t = svc.submit(poisson16, _rhs(poisson16, 46))
+        svc.drain(timeout_s=300)
+    assert t.result.converged
+    assert metrics.get("serving.recovery.build_retries") - r0 == 1
+
+
+def test_build_crash_attempts_bounded_then_reject(poisson16):
+    """An always-crashing build cannot retry forever: after
+    serving_retry_max_attempts the tickets reject with BREAKDOWN and
+    the error attached — bounded, terminal, no hang."""
+    svc = SolveService(_svc_cfg(
+        extra="serving_fault_policy=BUILD_FAILED>retry_backoff,"
+              " serving_retry_backoff_s=0.001,"
+              " serving_retry_max_attempts=2"))
+    with faultinject.inject("build_crash", fires=None):
+        t = svc.submit(poisson16, _rhs(poisson16, 47))
+        svc.drain(timeout_s=60)
+    assert t.done
+    assert t.result.status_code == int(SolveStatus.BREAKDOWN)
+    assert isinstance(t.error, faultinject.ChaosInjected)
+    assert svc.idle
+
+
+def test_step_crash_attempts_bounded_then_reject(poisson16):
+    """A bucket whose device step crashes EVERY cycle cannot loop
+    quarantine->rebuild->quarantine forever: a successful rebuild does
+    not reset the fault-attempt counter (only a terminal completion
+    does), so serving_retry_max_attempts bounds STEP_FAILED too and
+    the tickets reject terminally."""
+    svc = SolveService(_svc_cfg(
+        extra="serving_retry_max_attempts=1, serving_chunk_iters=1"))
+    with faultinject.inject("step_crash", fires=None):
+        t = svc.submit(poisson16, _rhs(poisson16, 51))
+        svc.drain(timeout_s=120)
+    assert t.done
+    assert t.result.status_code == int(SolveStatus.BREAKDOWN)
+    assert svc.idle
+    # ...and a healthy completion clears the counter: the same
+    # fingerprint serves normally once the fault is gone
+    t2 = svc.submit(poisson16, _rhs(poisson16, 52))
+    svc.drain(timeout_s=300)
+    assert t2.result.converged
+
+
+def test_journal_corrupt_pattern_self_heals(poisson16, tmp_path):
+    """A corrupt PATTERN file (shared across a fingerprint's records)
+    is deleted at the failed replay read, so the next submit rewrites
+    it — corruption cannot permanently poison a fingerprint's
+    durability."""
+    cfg = _svc_cfg(extra=f"serving_journal_dir={tmp_path},"
+                         " serving_chunk_iters=1, s:tolerance=1e-12")
+    svc = SolveService(cfg)
+    with faultinject.inject("journal_corrupt", fires=1):
+        svc.submit(poisson16, _rhs(poisson16, 53))  # pattern write torn
+    del svc
+    succ = SolveService(cfg)          # replay drops the corrupt record
+    assert succ.stats()["journal_pending"] == 0
+    # durability restored: a new journaled request round-trips a crash
+    t = succ.submit(poisson16, _rhs(poisson16, 54))
+    for _ in range(3):
+        succ.step()
+    assert not t.done
+    del succ
+    succ2 = SolveService(cfg)
+    done = succ2.drain(timeout_s=300)
+    assert len(done) == 1 and done[0].result.converged
+
+
+def test_engine_admit_occupied_slot_still_raises(poisson16):
+    """Direct BucketEngine users keep the strict occupied-slot guard:
+    the scheduler's reservation protocol (unique occupant objects)
+    must not have weakened the default-occupant path."""
+    from amgx_tpu.errors import BadParametersError
+    eng = BucketEngine(_svc_cfg(), "default", poisson16, slots=2,
+                       chunk=4, dtype=np.float64)
+    eng.admit(0, poisson16, _rhs(poisson16, 55))
+    with pytest.raises(BadParametersError, match="occupied"):
+        eng.admit(0, poisson16, _rhs(poisson16, 56))
+
+
+def test_bucket_failure_status_does_not_poison_neighbors(poisson16):
+    """Status interplay inside a chunked bucket: a slot that hits
+    NAN_DETECTED mid-chunk (injected SpMV NaN baked into the bucket's
+    traces) finalizes with that status while a neighbor slot in the
+    SAME bucket still finalizes CONVERGED — per-slot statuses are
+    independent, and the bucket keeps serving afterwards."""
+    with faultinject.inject("spmv_nan", iteration=3, fires=None):
+        # armed at build: the engine's chunked step trace carries the
+        # iteration-3 corruption for the bucket's lifetime
+        svc = SolveService(_svc_cfg(extra="serving_chunk_iters=2"))
+        bad = svc.submit(poisson16, _rhs(poisson16, 48))
+        zero = svc.submit(poisson16, np.zeros(poisson16.num_rows))
+        svc.drain(timeout_s=300)
+    assert bad.done
+    assert bad.result.status_code == int(SolveStatus.NAN_DETECTED)
+    assert not bad.result.converged
+    # the all-zero rhs converges at iteration 0 — before the fault
+    # iteration — in the SAME poisoned bucket
+    assert zero.done and zero.result.converged
+    assert zero.result.iterations == 0
+    # and the bucket is not poisoned for the service: a fresh service
+    # (clean trace epoch) serves the same pattern fine
+    svc2 = SolveService(_svc_cfg(extra="serving_chunk_iters=2"))
+    ok = svc2.submit(poisson16, _rhs(poisson16, 48))
+    svc2.drain(timeout_s=300)
+    assert ok.result.converged
+
+
+def test_clock_skew_deadlines_stay_terminal(poisson16):
+    """Chaos: with the service clock skewed forward, deadline
+    bookkeeping stays consistent (submit and expiry read the same
+    skewed clock) and every ticket still terminates."""
+    with faultinject.inject("clock_skew", value=600.0, fires=None):
+        svc = SolveService(_svc_cfg())
+        t1 = svc.submit(poisson16, _rhs(poisson16, 49), deadline_s=1e9)
+        t2 = svc.submit(poisson16, _rhs(poisson16, 50), deadline_s=0.0)
+        svc.drain(timeout_s=300)
+    assert t1.done and t1.result.converged
+    assert t2.done and t2.result.status_code == \
+        int(SolveStatus.DEADLINE_EXCEEDED)
+
+
+# ---------------------------------------------------------------------------
 # telemetry catalog + bench smoke
 # ---------------------------------------------------------------------------
 
@@ -476,8 +870,26 @@ def test_serving_metrics_declared():
                  "serving.cache.hit", "serving.cache.miss",
                  "serving.cache.evictions", "serving.retrace",
                  "serving.aot.export", "serving.aot.load",
-                 "serving.aot.error", "batch.bucket_evictions"):
+                 "serving.aot.error", "batch.bucket_evictions",
+                 # fault-tolerance layer
+                 "serving.recovery.checkpoints",
+                 "serving.recovery.replayed",
+                 "serving.recovery.resumed",
+                 "serving.recovery.restart_fresh",
+                 "serving.recovery.journal_corrupt",
+                 "serving.recovery.quarantined",
+                 "serving.recovery.salvaged",
+                 "serving.recovery.requeued",
+                 "serving.recovery.build_retries",
+                 "serving.recovery.hstore_save",
+                 "serving.recovery.hstore_load",
+                 "serving.recovery.hstore_skip",
+                 "serving.recovery.hstore_error",
+                 "serving.dedupe", "serving.shed.overload",
+                 "serving.shed.deadline", "serving.shed.quota",
+                 "amg.setup.restored", "resilience.config_fallback"):
         assert name in snap
+    assert "serving.exec_s" in metrics.HISTOGRAMS
 
 
 def test_bench_serving_smoke():
@@ -499,3 +911,30 @@ def test_bench_serving_smoke():
     assert res["aot_loads"] >= 1
     assert res["deadline_requests"] > 0
     assert res["deadline_statuses_ok"]
+
+
+@pytest.mark.slow
+def test_bench_chaos_smoke():
+    """The `bench.py chaos --smoke` acceptance gates: kill-and-recover
+    resumes bit-identically with zero full setups / zero retraces,
+    every scripted fault scenario ends all-tickets-terminal, and the
+    2x-saturation shed load keeps admitted work inside its deadline
+    with sheds classified OVERLOADED. (slow: ~1 min of scripted
+    service scenarios — the per-scenario unit tests above are the
+    tier-1 subset.)"""
+    import bench
+    jax.config.update("jax_compilation_cache_dir",
+                      "/tmp/amgx_tpu_jax_cache")
+    res = bench.bench_chaos(smoke=True)
+    assert res["killed_inflight"] > 0
+    assert res["recover_replayed"] > 0 and res["recover_resumed"] > 0
+    assert res["recover_bitwise_ok"]
+    assert res["restart_full_setups"] == 0
+    assert res["restart_hier_restored"] >= 1
+    assert res["restart_retraces"] == 0
+    assert res["recover_all_terminal"]
+    assert res["chaos_recover_wall_s"] > 0
+    assert res["chaos_all_terminal"], res["chaos_scenarios"]
+    assert res["shed_all_overloaded"]
+    assert res["shed_admitted_deadline_misses"] == 0
+    assert res["shed_ok"]

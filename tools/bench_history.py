@@ -99,6 +99,12 @@ SERIES: Tuple[Tuple[str, str, float, str], ...] = (
      "serving sustained throughput under the open-loop bench load"),
     ("serving_p99_ms", "lower", 0.60,
      "serving p99 submit-to-complete latency (ms)"),
+    ("chaos_recover_wall_s", "lower", 0.60,
+     "serving kill-and-recover wall: journal replay + persisted "
+     "hierarchies + AOT warm start to fully drained (s)"),
+    ("chaos_accepted_p99_ms", "lower", 0.60,
+     "p99 latency of ADMITTED requests under 2x-saturation shed load "
+     "(ms) — must stay within the deadline budget"),
     ("mc_dist_fused_speedup", "higher", 0.25,
      "distributed fused-vs-unfused cycle speedup (MULTICHIP)"),
 )
