@@ -208,6 +208,112 @@ def rs_split(A: CsrMatrix, strong):
     return jnp.asarray(cf, jnp.int32)
 
 
+def _hash_key(n):
+    """The PMIS integer hash (same mixing as _hash01, kept as int64):
+    a deterministic per-vertex tie-break that is bit-identical on
+    every backend (pure uint32 arithmetic, no float rounding)."""
+    i = jnp.arange(n, dtype=jnp.uint32)
+    h = i * jnp.uint32(2654435761)
+    h = (h ^ (h >> 16)) * jnp.uint32(0x45D9F3B)
+    h = h ^ (h >> 16)
+    return (h & jnp.uint32(0xFFFFF)).astype(jnp.int64)
+
+
+def rs_sweep(A: CsrMatrix, strong, max_rounds: int = 200):
+    """Device-parallel RS first pass: a PMIS-style independent-set
+    FIXPOINT with the RS weight as priority (SParSH-AMG's CPU-GPU
+    split taken all the way onto the device, arXiv:2007.00056; CLJP
+    family). Eager jnp over concrete shapes, so it runs inside the
+    setup_backend=device pipeline with zero host-serial work.
+
+    Per round, over the current UNDECIDED set:
+
+      key_i  = lambda_i * 2^20 + hash(i)         (int64, lambda_i =
+               the LIVE RS weight: S^T in-degree plus one bump per
+               strong neighbor already turned FINE — the bucket
+               queue's exact weight function, updated per round
+               instead of per pop)
+      C:       undecided i whose key beats every undecided neighbor
+               in S | S^T (the serial pop's conflict set: a selection
+               can only FINE its S^T-dependents, so strict local
+               maxima are simultaneously safe)
+      F:       undecided j with a new COARSE point in S(j)
+      bump:    +1 per (newly FINE j -> undecided k in S(j)) edge
+
+    Initialization matches the queue: lambda=0 vertices start FINE
+    (COARSE when fully isolated) and never bump their neighbors.
+
+    NOT bit-equivalent to the serial bucket queue: the queue's
+    dynamic LIFO tie-break makes its pop order inherently serial (a
+    weight bump re-queues a vertex at its bucket's head), so the host
+    path (`selector_device_sweep=0`, or setup_backend=host with
+    `auto`) keeps the queue as the reference implementation and
+    quality oracle, while this sweep is bit-deterministic ACROSS
+    BACKENDS — host-jnp and device runs produce identical splits
+    (integer arithmetic only), which is what the device-setup parity
+    contract checks. Leftover UNDECIDED vertices after `max_rounds`
+    (hash-collision stalemates, < 2^-20 per adjacent pair) turn FINE
+    exactly like the PMIS fixpoint's tail."""
+    n = A.num_rows
+    rows, cols, _ = A.coo()
+    rows = jnp.asarray(rows)
+    cols = jnp.asarray(cols)
+    st = jnp.asarray(strong, bool)
+    mask = st & (cols < n) & (cols != rows)
+    er = rows[mask]          # directed strength edges: ec in S(er)
+    ec = cols[mask]
+    one = jnp.ones(er.shape, jnp.int64)
+    lam = jnp.zeros((n,), jnp.int64).at[ec].add(one)   # S^T in-degree
+    out_deg = jnp.zeros((n,), jnp.int64).at[er].add(one)
+    idx = jnp.arange(n, dtype=jnp.int64)
+    key_base = _hash_key(n)
+    state = jnp.full((n,), UNDECIDED, jnp.int32)
+    # lambda == 0: never queued — FINE, except fully isolated points
+    # (no edges either way) which cannot interpolate -> COARSE
+    no_in = lam == 0
+    state = jnp.where(no_in & (out_deg == 0), COARSE,
+                      jnp.where(no_in, FINE, state))
+    for _ in range(max_rounds):
+        und = state == UNDECIDED
+        if not bool(jnp.any(und)):
+            break
+        key = lam * jnp.int64(1 << 20) + key_base
+        live = und[er] & und[ec]
+        km = jnp.where(live, key[ec], jnp.int64(-1))
+        nbr = jnp.full((n,), jnp.int64(-1)).at[er].max(km)
+        nbr = nbr.at[ec].max(jnp.where(live, key[er], jnp.int64(-1)))
+        new_c = und & (key > nbr)
+        state = jnp.where(new_c, COARSE, state)
+        # undecided j strongly depending on a new C point -> FINE
+        f_hit = jnp.zeros((n,), bool).at[er].max(new_c[ec])
+        newly_f = und & ~new_c & f_hit
+        state = jnp.where(newly_f, FINE, state)
+        # RS weight update: each newly-FINE j bumps its still-
+        # undecided strong neighbors k in S(j) by one per edge
+        und2 = state == UNDECIDED
+        lam = lam.at[ec].add(jnp.where(newly_f[er] & und2[ec],
+                                       jnp.int64(1), jnp.int64(0)))
+    return jnp.where(state == COARSE, 1, 0).astype(jnp.int32)
+
+
+def _rs_first_pass(cfg, scope, A: CsrMatrix, strong):
+    """RS/HMIS first-pass dispatch: the host bucket queue (the
+    reference), or the device-parallel sweep. `selector_device_sweep`
+    auto = sweep exactly when the setup pipeline is device-forced
+    (setup_backend=device, PR-3 threadlocal), 1 = always sweep (the
+    cross-backend parity shape), 0 = always the bucket queue (the
+    escape hatch that restores bit-identical splits vs host builds)."""
+    mode = str(cfg.get("selector_device_sweep", scope))
+    from ...matrix import device_setup_forced
+    if mode == "1" or (mode == "auto" and device_setup_forced()):
+        from ...profiling import trace_region
+        from ...telemetry import metrics as _tm
+        _tm.inc("amg.selector.device_sweep")
+        with trace_region("selector.device_sweep"):
+            return rs_sweep(A, strong)
+    return rs_split(A, strong)
+
+
 def _two_hop_strength(A: CsrMatrix, strong):
     """Boolean S@S (distance-2 strength) as a COO edge list, built with
     the sort-based expand machinery (aggressive coarsening graph)."""
@@ -237,20 +343,25 @@ class PMISSelector(ClassicalSelector):
 
 @registry.classical_selectors.register("RS")
 class RSSelector(ClassicalSelector):
-    """Serial Ruge-Stueben first pass (rs.cu host path)."""
+    """Ruge-Stueben first pass: the serial bucket queue (rs.cu host
+    path) or, under the device setup pipeline, the device-parallel
+    independent-set sweep (`selector_device_sweep`)."""
 
     def mark_coarse_fine_points(self, A, strong):
-        return rs_split(A, strong)
+        return _rs_first_pass(self.cfg, self.scope, A, strong)
 
 
 @registry.classical_selectors.register("HMIS")
 class HMISSelector(ClassicalSelector):
-    """Host RS pass, then PMIS seeded with the RS result
-    (hmis.cu:55-82). Single-device the PMIS pass keeps the RS
-    assignment; it exists to resolve partition-boundary points."""
+    """RS first pass, then PMIS seeded with the RS result
+    (hmis.cu:55-82). Single-device the PMIS pass keeps the first
+    pass's assignment; it exists to resolve partition-boundary
+    points. The first pass routes like RSSelector: the host bucket
+    queue by default, the device-parallel sweep under the device
+    setup pipeline (`selector_device_sweep`)."""
 
     def mark_coarse_fine_points(self, A, strong):
-        cf = rs_split(A, strong)
+        cf = _rs_first_pass(self.cfg, self.scope, A, strong)
         return pmis_split(A, strong, init=cf)
 
 

@@ -57,10 +57,12 @@ def _smooth_restrict(amg, level, data, b, x, sweeps: int):
     """Presmooth + restriction: with cycle_fusion, aggregation/DIA
     levels emit the segment-summed coarse rhs from the presmoother
     kernel's epilogue (ops/smooth.py) — the residual never round-trips
-    HBM and `level.restrict` disappears from the trace — and
-    distributed DIA levels run the halo-folded per-shard kernel
+    HBM and `level.restrict` disappears from the trace — classical
+    DIA levels do the same through their WEIGHTED row-segment slabs
+    (bc = R r summed inside the kernel, general CSR interpolation),
+    and distributed DIA levels run the halo-folded per-shard kernel
     (distributed/fused.py) before their explicit sharded restriction.
-    Everything else (classical levels, cycle_fusion=0, unsupported
+    Everything else (cycle_fusion=0, non-DIA levels, unsupported
     layouts) composes exactly the prior smooth_residual -> restrict
     pair."""
     if amg.cycle_fusion and sweeps > 0 and \
@@ -74,10 +76,11 @@ def _smooth_restrict(amg, level, data, b, x, sweeps: int):
 
 def _prolongate_smooth(amg, level, data, b, x, xc, sweeps: int):
     """Prolongation + correction + postsmooth: with cycle_fusion,
-    aggregation/DIA levels fold x + P xc into the postsmoother
-    kernel's first application (ops/smooth.py), removing the
-    correction add's full-vector pass. Falls back to the prior
-    x + prolongate -> smooth compose bit-for-bit."""
+    aggregation AND classical DIA levels fold x + P xc into the
+    postsmoother kernel's first application (ops/smooth.py —
+    aggregate-id gather or the weighted multi-entry CSR-row gather),
+    removing the correction add's full-vector pass. Falls back to the
+    prior x + prolongate -> smooth compose bit-for-bit."""
     if amg.cycle_fusion and sweeps > 0 and \
             "prolongate" in _fusion_caps(level, data):
         out = level.prolongate_smooth(data, b, x, xc, sweeps)

@@ -114,7 +114,9 @@ class AMGLevel:
     # instead of raising. Aggregation levels override the hooks with
     # the fused grid-transfer kernels (presmooth+restrict in one
     # pallas_call, prolongate+correction folded into the postsmoother's
-    # first application). Distributed levels advertise NOTHING here on
+    # first application); classical levels do the same through the
+    # WEIGHTED row-segment slabs of their general CSR interpolation
+    # (amg/classical). Distributed levels advertise NOTHING here on
     # purpose: their fusion — the halo-folded per-shard smoother
     # kernel (distributed/fused.py) — rides inside the smoother's own
     # smooth/smooth_residual dispatch (ops/smooth.fused_smooth sees the
@@ -765,6 +767,12 @@ class AMG:
             op = getattr(level, name, None)
             if op is not None and op.initialized:
                 pieces.append(op.slim_for_spmv())
+        # fused-cycle transfer slabs (built at setup by the level
+        # classes): ship with the level instead of as a first-solve
+        # straggler
+        memo = getattr(level, "_xfer_memo", None)
+        if memo is not None and memo[0] is not None:
+            pieces.append(memo[0])
         if level.smoother is not None:
             pieces.append(level.smoother.solve_data())
         self._prefetch_leaves(pieces)

@@ -201,6 +201,16 @@ def _register_default_parameters():
       "levels may take the host numpy fast paths when the data is "
       "host-resident (eager dispatch overhead beats the compute there); "
       "0 forces every level onto the device pipeline", 0, None, 0)
+    R("selector_device_sweep", str, "RS/HMIS first-pass implementation: "
+      "auto = the device-parallel independent-set sweep (PMIS-style "
+      "fixpoint with the live RS weight as priority, "
+      "amg/classical/selectors.py rs_sweep) exactly when the setup "
+      "pipeline is device-forced (setup_backend=device), the host "
+      "bucket queue otherwise; 1 = always the sweep (bit-deterministic "
+      "across backends — the device-setup parity shape); 0 = always "
+      "the host-serial bucket queue (the reference; restores "
+      "bit-identical splits between host and device builds)",
+      "auto", ("auto", "0", "1"))
     R("amg_precision", str, "precision of the stored hierarchy + cycle "
       "(TPU-native mixed-precision preconditioning, the dDFI-mode analog: "
       "a float32/bfloat16 cycle inside an f64 flexible Krylov solver)",

@@ -159,12 +159,17 @@ def affine_window_sweeps(offsets, vals_w, b_w, x_w, taus, dinv_w,
 
 
 def restrict_multi(R: jax.Array, xfer) -> jax.Array:
-    """BC = segment-sum of R (B, n) over aggregates, via the
-    structure-only child-index slab (m gathers, no scatter)."""
+    """BC = R-restriction of the residual slab (B, n) via the
+    child-index slab (m gathers, no scatter): the aggregation
+    segment-sum, or — when the slab carries weights (general CSR
+    interpolation, classical levels) — the weighted row-segment sum
+    bc[c] = sum_j cwt[j][c] * r[ctab[j][c]]."""
     ctab = xfer.ctab.reshape(xfer.m, -1)
     valid = ctab >= 0
     idx = jnp.where(valid, ctab, 0)
     g = R[:, idx]                                   # (B, m, ncr*128)
+    if xfer.cwt is not None:
+        g = g * xfer.cwt.reshape(xfer.m, -1)[None]
     bc = jnp.where(valid[None], g, 0.0).sum(axis=1)
     return bc[:, : xfer.nc]
 
@@ -179,9 +184,21 @@ def _agg_content(A: CsrMatrix, xfer) -> jax.Array:
 
 def prolong_corr_multi(A: CsrMatrix, X: jax.Array, XC: jax.Array,
                        xfer) -> jax.Array:
-    """X + P XC (piecewise-constant prolongation = gather by aggregate
-    id) for (B, n) X and (B, nc) XC."""
-    return X + XC[:, _agg_content(A, xfer)]
+    """X + P XC for (B, n) X and (B, nc) XC: gather by aggregate id
+    (piecewise-constant aggregation P), or the weighted row-segment
+    gather X += sum_j pwt[j] * XC[ptab[j]] (general CSR P)."""
+    if xfer.ptab is None:
+        return X + XC[:, _agg_content(A, xfer)]
+    from .pallas_spmv import LANES, transfer_quota_rows
+    aqf = transfer_quota_rows(A.dia_offsets, A.num_rows)[0]
+    n = A.num_rows
+    lo, hi = aqf * LANES, aqf * LANES + n
+    pt = xfer.ptab.reshape(xfer.mp, -1)[:, lo:hi]   # (mp, n)
+    pw = xfer.pwt.reshape(xfer.mp, -1)[:, lo:hi]
+    valid = pt >= 0
+    g = XC[:, jnp.where(valid, pt, 0)]              # (B, mp, n)
+    corr = (jnp.where(valid, pw, 0.0)[None] * g).sum(axis=1)
+    return X + corr
 
 
 def smooth_restrict_dia_multi(A: CsrMatrix, B: jax.Array, X: jax.Array,

@@ -581,6 +581,12 @@ def _dia_smooth_call(vals_q, dinv_q, taus, b, x, offsets, num_rows,
 # ---------------------------------------------------------------------------
 
 TRANSFER_MAX_CHILD = 16     # largest aggregate the epilogue fuses
+# weighted (general-CSR) transfer slabs: classical interpolation rows
+# are short (interp_max_elements-truncated) but a coarse point's
+# R-row — the set of fine points it interpolates — runs longer than
+# any aggregate, so the weighted child table gets its own cap (the
+# plans still arbitrate the real VMEM/traffic cost per block size)
+CSR_TRANSFER_MAX_CHILD = 32
 
 
 def coarse_pad_rows(nc: int) -> int:
@@ -603,17 +609,29 @@ def transfer_quota_rows(offsets, num_rows: int):
 
 @jax.tree_util.register_pytree_node_class
 class TransferSlabs:
-    """Setup-built transfer payloads of one aggregation level.
+    """Setup-built transfer payloads of one aggregation OR classical
+    level.
 
     Children (device arrays): `ctab` (m, ncr, 128) int32 child-index
-    slab; `atab` (quota rows, 128) int32 aggregate-id slab; `bases`
-    {br: (cb, pcb)} per-candidate-block-size int32 coarse window bases
-    (restriction / prolongation). Static aux: `nc` coarse rows, `ncr`
-    padded coarse 128-lane rows, `m` max aggregate size, and `windows`
-    ((br, cw, pcw), ...) — the static coarse-window row counts the plan
-    functions check VMEM against."""
+    slab (restriction: fine slot of coarse row c's j-th source entry,
+    -1 absent); `atab` (quota rows, 128) int32 aggregate-id slab
+    (aggregation prolongation: ONE unit-weight coarse id per fine
+    slot); `bases` {br: (cb, pcb)} per-candidate-block-size int32
+    coarse window bases (restriction / prolongation). General-CSR
+    (classical interpolation) levels add the WEIGHTED row-segment
+    slabs: `cwt` (m, ncr, 128) restriction weights aligned with ctab,
+    and `ptab`/`pwt` (mp, quota rows, 128) — the j-th (coarse id,
+    weight) entry of P's row per fine slot, replacing atab. Static
+    aux: `nc` coarse rows, `ncr` padded coarse 128-lane rows, `m` max
+    restriction row length, `windows` ((br, cw, pcw), ...) — the
+    static coarse-window row counts the plan functions check VMEM
+    against — `mp` max prolongation row length, and `wavg`/`pavg`
+    (ceil average R/P row lengths: the plans' honest unfused-traffic
+    term for the weighted forms)."""
 
-    def __init__(self, ctab, atab, bases, nc, ncr, m, windows):
+    def __init__(self, ctab, atab, bases, nc, ncr, m, windows,
+                 cwt=None, ptab=None, pwt=None, mp=1, wavg=None,
+                 pavg=None):
         self.ctab = ctab
         self.atab = atab
         self.bases = bases
@@ -621,27 +639,45 @@ class TransferSlabs:
         self.ncr = ncr
         self.m = m
         self.windows = windows
+        self.cwt = cwt
+        self.ptab = ptab
+        self.pwt = pwt
+        self.mp = mp
+        self.wavg = m if wavg is None else wavg
+        self.pavg = mp if pavg is None else pavg
 
     def tree_flatten(self):
-        return ((self.ctab, self.atab, self.bases),
-                (self.nc, self.ncr, self.m, self.windows))
+        return ((self.ctab, self.atab, self.bases, self.cwt,
+                 self.ptab, self.pwt),
+                (self.nc, self.ncr, self.m, self.windows, self.mp,
+                 self.wavg, self.pavg))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(children[0], children[1], children[2], *aux)
+        nc, ncr, m, windows, mp, wavg, pavg = aux
+        return cls(children[0], children[1], children[2], nc, ncr, m,
+                   windows, cwt=children[3], ptab=children[4],
+                   pwt=children[5], mp=mp, wavg=wavg, pavg=pavg)
 
 
 def dia_restrict_plan(offsets, k: int, num_rows: int, n_steps: int,
-                      m: int, windows):
+                      m: int, windows, weighted: bool = False,
+                      wavg=None):
     """Block plan for the smoother+restriction-epilogue kernel, or
     None. Mirrors dia_smooth_plan(with_residual=True) plus the epilogue
-    buffers: m double-buffered child-index windows and the pipelined
-    partial-coarse output block."""
-    if not offsets or m < 1 or m > TRANSFER_MAX_CHILD:
+    buffers: m double-buffered child-index windows (and, `weighted`,
+    the matching weight windows of the general-CSR form) and the
+    pipelined partial-coarse output block. `wavg` (weighted only) is
+    the ceil-average R row length — the honest per-window cost of the
+    unfused SWELL restriction the fusion replaces."""
+    cap = CSR_TRANSFER_MAX_CHILD if weighted else TRANSFER_MAX_CHILD
+    if not offsets or m < 1 or m > cap:
         return None
     n_app = int(n_steps) + 1
     if n_steps < 1 or n_app > SMOOTH_MAX_APPS:
         return None
+    wavg = m if wavg is None else wavg
+    tabs = 2 if weighted else 1          # index (+ weight) tables
     wmap = {w[0]: w[1] for w in windows}
     mr0, Mr0 = smooth_halo_rows(offsets)
     H = mr0 + Mr0
@@ -654,16 +690,18 @@ def dia_restrict_plan(offsets, k: int, num_rows: int, n_steps: int,
         win_x = win_v + H
         vmem = (2 * k * win_v + 2 * (2 * win_v + win_x)
                 + 2 * br                 # x output pipeline
-                + 2 * m * cw             # child-index windows (int32)
+                + 2 * tabs * m * cw      # child windows (int32 [+ f32])
                 + 2 * cw                 # partial-coarse output pipeline
                 ) * LANES * 4
         if vmem > _SMOOTH_VMEM_BUDGET:
             continue
         # traffic guard vs the unfused compose: n_app passes over A
-        # plus the standalone restrict pass (r write + r/agg read + bc
-        # write ~ 3*br + cw)
-        fused = (k + 2) * win_v + win_x + (m + 1) * cw
-        unfused = n_app * (k + 3) * br + 3 * br + cw
+        # plus the standalone restrict pass (r write + r read + bc
+        # write ~ 3*br + cw; weighted: + the R vals/cols stream the
+        # unfused SWELL SpMV would read, ~ 2*wavg*cw)
+        fused = (k + 2) * win_v + win_x + (tabs * m + 1) * cw
+        unfused = n_app * (k + 3) * br + 3 * br + cw \
+            + (2 * wavg * cw if weighted else 0)
         if n_app > 1 and fused >= 0.95 * unfused:
             continue
         n_blocks = -(-rows128 // br)
@@ -672,16 +710,20 @@ def dia_restrict_plan(offsets, k: int, num_rows: int, n_steps: int,
 
 
 def dia_prolong_plan(offsets, k: int, num_rows: int, n_steps: int,
-                     windows):
+                     windows, mp: int = 1, weighted: bool = False,
+                     pavg=None):
     """Block plan for the prolongation-prologue+smoother kernel, or
     None. with_residual is never true here (the correction folds into
-    the POST-smoother); the prologue adds the aggregate-id window and
-    the coarse-vector window to the budget."""
-    if not offsets:
+    the POST-smoother); the prologue adds the aggregate-id window (or,
+    general CSR, mp index+weight window pairs) and the coarse-vector
+    window to the budget."""
+    if not offsets or mp < 1 or mp > TRANSFER_MAX_CHILD:
         return None
     n_app = int(n_steps)
     if n_app < 1 or n_app > SMOOTH_MAX_APPS:
         return None
+    pavg = mp if pavg is None else pavg
+    tabs = 2 if weighted else 1
     wmap = {w[0]: w[2] for w in windows}
     mr0, Mr0 = smooth_halo_rows(offsets)
     H = mr0 + Mr0
@@ -694,15 +736,17 @@ def dia_prolong_plan(offsets, k: int, num_rows: int, n_steps: int,
         win_x = win_v + H
         vmem = (2 * k * win_v + 2 * (2 * win_v + win_x)
                 + 2 * br                 # x output pipeline
-                + 2 * win_x              # aggregate-id windows (int32)
+                + 2 * tabs * mp * win_x  # id (+ weight) windows
                 + 2 * pcw                # coarse-vector windows
                 ) * LANES * 4
         if vmem > _SMOOTH_VMEM_BUDGET:
             continue
         # guard vs unfused: n_app passes plus the correction pass
-        # (x read + xc/agg read + x write ~ 2*br + pcw)
-        fused = (k + 2) * win_v + win_x + win_x + pcw
-        unfused = n_app * (k + 3) * br + 2 * br + pcw
+        # (x read + xc read + x write ~ 2*br + pcw; weighted: + the P
+        # vals/cols stream of the unfused SWELL prolongation)
+        fused = (k + 2) * win_v + win_x + tabs * mp * win_x + pcw
+        unfused = n_app * (k + 3) * br + 2 * br + pcw \
+            + (2 * pavg * br if weighted else 0)
         if fused >= 0.95 * unfused and n_app > 1:
             continue
         n_blocks = -(-rows128 // br)
@@ -724,7 +768,9 @@ def dia_restrict_supported(A, x_dtype, n_steps: int, xfer) -> bool:
         return False
     k = A.dia_vals.shape[0]
     return dia_restrict_plan(A.dia_offsets, k, A.num_rows, n_steps,
-                             xfer.m, xfer.windows) is not None
+                             xfer.m, xfer.windows,
+                             weighted=xfer.cwt is not None,
+                             wavg=xfer.wavg) is not None
 
 
 def dia_prolong_supported(A, x_dtype, n_steps: int, xfer) -> bool:
@@ -732,36 +778,50 @@ def dia_prolong_supported(A, x_dtype, n_steps: int, xfer) -> bool:
         return False
     k = A.dia_vals.shape[0]
     return dia_prolong_plan(A.dia_offsets, k, A.num_rows, n_steps,
-                            xfer.windows) is not None
+                            xfer.windows, mp=xfer.mp,
+                            weighted=xfer.ptab is not None,
+                            pavg=xfer.pavg) is not None
 
 
 def _dia_smooth_restrict_kernel(offsets, br, n_app, mr0, Mr0, win_x,
                                 win_v, n_steps, has_dinv, n_blocks,
-                                slab_shift, m, cw, dtype):
+                                slab_shift, m, cw, has_w, dtype):
     """Kernel body factory: the dia_smooth body (window coordinates
     documented on _dia_smooth_kernel) with the residual epilogue
     replaced by per-block partial coarse segment-sums — r is gathered
     through the child-index window into the block's coarse rows and
-    never written to HBM."""
+    never written to HBM. `has_w` (general-CSR / classical form)
+    gathers a weight window next to each child-index window and the
+    partial sums become weighted: bc[c] = sum_j w[j][c] * r[ct[j][c]]
+    (the aggregation form is the unit-weight special case)."""
     ro = [mr0 + (o - (o % LANES)) // LANES for o in offsets]
     rl = [o % LANES for o in offsets]
 
     def kernel(*refs):
-        # refs: xp, vals_q, bp, [dinv_q], ctab, cb, taus,
-        #       out_x, out_bc, xbuf, vbuf, bbuf, [dbuf], cbuf, sems
+        # refs: xp, vals_q, bp, [dinv_q], ctab, [cwt], cb, taus,
+        #       out_x, out_bc, xbuf, vbuf, bbuf, [dbuf], cbuf, [wbuf],
+        #       sems
         xp_ref, vals_ref, bp_ref = refs[0], refs[1], refs[2]
         off = 3
         dinv_ref = refs[off] if has_dinv else None
         off += 1 if has_dinv else 0
-        ctab_ref, cb_ref, taus_ref = refs[off], refs[off + 1], refs[off + 2]
-        off += 3
+        ctab_ref = refs[off]
+        off += 1
+        cwt_ref = refs[off] if has_w else None
+        off += 1 if has_w else 0
+        cb_ref, taus_ref = refs[off], refs[off + 1]
+        off += 2
         y_ref, bc_ref = refs[off], refs[off + 1]
         off += 2
         xbuf, vbuf, bbuf = refs[off], refs[off + 1], refs[off + 2]
         off += 3
         dbuf = refs[off] if has_dinv else None
         off += 1 if has_dinv else 0
-        cbuf, sems = refs[off], refs[off + 1]
+        cbuf = refs[off]
+        off += 1
+        wbuf = refs[off] if has_w else None
+        off += 1 if has_w else 0
+        sems = refs[off]
 
         i = pl.program_id(0)
         slot = jax.lax.rem(i, jnp.int32(2))
@@ -792,6 +852,12 @@ def _dia_smooth_restrict_kernel(offsets, br, n_app, mr0, Mr0, win_x,
                     ctab_ref.at[j, pl.ds(cbv, cw)],
                     cbuf.at[jnp.int32(s), j],
                     sems.at[jnp.int32(s), nsem + j]))
+            if has_w:
+                for j in range(m):
+                    ops.append(pltpu.make_async_copy(
+                        cwt_ref.at[j, pl.ds(cbv, cw)],
+                        wbuf.at[jnp.int32(s), j],
+                        sems.at[jnp.int32(s), nsem + m + j]))
             return ops
 
         @pl.when(i == 0)
@@ -852,6 +918,8 @@ def _dia_smooth_restrict_kernel(offsets, br, n_app, mr0, Mr0, win_x,
             rel = idxj - base
             valid = (idxj >= 0) & (rel >= 0) & (rel < br * LANES)
             g = jnp.take(rflat, jnp.where(valid, rel, 0))
+            if has_w:
+                g = g * wbuf[slot, j]
             part = part + jnp.where(valid, g, jnp.zeros((), dtype))
         bc_ref[...] = part
 
@@ -869,9 +937,11 @@ def _dia_smooth_restrict_call(vals_q, dinv_q, taus, b, x, xfer,
     k = vals_q.shape[0]
     n_steps = taus.shape[0]
     has_dinv = dinv_q is not None
+    has_w = xfer.cwt is not None
     dtype = vals_q.dtype
     plan = dia_restrict_plan(offsets, k, num_rows, n_steps, xfer.m,
-                             xfer.windows)
+                             xfer.windows, weighted=has_w,
+                             wavg=xfer.wavg)
     br, n_app, mr0, Mr0, win_x, win_v, nb, cw = plan
     qf, qc, qb = smooth_quota_rows(offsets, num_rows)
     assert vals_q.shape[1] == qf + qc + qb
@@ -892,8 +962,8 @@ def _dia_smooth_restrict_call(vals_q, dinv_q, taus, b, x, xfer,
 
     kernel = _dia_smooth_restrict_kernel(
         offsets, br, n_app, mr0, Mr0, win_x, win_v, n_steps, has_dinv,
-        nb, slab_shift, xfer.m, cw, dtype)
-    n_sem = (4 if has_dinv else 3) + xfer.m
+        nb, slab_shift, xfer.m, cw, has_w, dtype)
+    n_sem = (4 if has_dinv else 3) + xfer.m * (2 if has_w else 1)
     in_specs = [
         pl.BlockSpec(memory_space=pl.ANY),          # xp
         pl.BlockSpec(memory_space=pl.ANY),          # vals_q
@@ -905,6 +975,9 @@ def _dia_smooth_restrict_call(vals_q, dinv_q, taus, b, x, xfer,
         operands.append(dinv_q)
     in_specs.append(pl.BlockSpec(memory_space=pl.ANY))   # ctab
     operands.append(xfer.ctab)
+    if has_w:
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))   # cwt
+        operands.append(xfer.cwt.astype(dtype))
     in_specs.append(pl.BlockSpec((nb,), lambda i: (jnp.int32(0),),
                                  memory_space=pltpu.SMEM))
     operands.append(cb.astype(jnp.int32))
@@ -929,6 +1002,8 @@ def _dia_smooth_restrict_call(vals_q, dinv_q, taus, b, x, xfer,
     if has_dinv:
         scratch.append(pltpu.VMEM((2, win_v, LANES), dtype))
     scratch.append(pltpu.VMEM((2, xfer.m, cw, LANES), jnp.int32))
+    if has_w:
+        scratch.append(pltpu.VMEM((2, xfer.m, cw, LANES), dtype))
     scratch.append(pltpu.SemaphoreType.DMA((2, n_sem)))
     y2, parts = pl.pallas_call(
         kernel,
@@ -940,7 +1015,8 @@ def _dia_smooth_restrict_call(vals_q, dinv_q, taus, b, x, xfer,
         cost_estimate=pl.CostEstimate(
             flops=2 * n_app * k * nb * br * LANES,
             bytes_accessed=((k + 2) * win_v + win_x
-                            + (xfer.m + 1) * cw + br) * nb * LANES * 4,
+                            + (xfer.m * (2 if has_w else 1) + 1) * cw
+                            + br) * nb * LANES * 4,
             transcendentals=0,
         ),
         interpret=interpret,
@@ -965,33 +1041,46 @@ def _dia_smooth_restrict_call(vals_q, dinv_q, taus, b, x, xfer,
 
 def _dia_prolong_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x,
                                win_v, n_steps, has_dinv, n_blocks,
-                               slab_shift, ashift, pcw, dtype):
+                               slab_shift, ashift, pcw, mp, has_w,
+                               dtype):
     """Kernel body factory: the dia_smooth body with a prologue that
     folds the coarse correction in — the state window becomes
     x + P xc (gather of the block's coarse window through the
     aggregate-id window) BEFORE the first sweep, so the correction
     add's full-vector HBM pass disappears. `ashift` is the static
-    offset of the x-window base inside the quota-padded atab slab."""
+    offset of the x-window base inside the quota-padded atab/ptab
+    slab. The general-CSR (classical) form — `has_w` — gathers mp
+    (coarse id, weight) window pairs per fine slot and accumulates
+    x += sum_j w[j] * xc[id[j]]; the aggregation form (mp=1, no
+    weights, 2-D atab) is unchanged."""
     ro = [mr0 + (o - (o % LANES)) // LANES for o in offsets]
     rl = [o % LANES for o in offsets]
 
     def kernel(*refs):
-        # refs: xp, vals_q, bp, [dinv_q], xcp, atab, pcb, taus,
-        #       out_x, xbuf, vbuf, bbuf, [dbuf], xcbuf, abuf, sems
+        # refs: xp, vals_q, bp, [dinv_q], xcp, atab|ptab, [pwt], pcb,
+        #       taus, out_x, xbuf, vbuf, bbuf, [dbuf], xcbuf, abuf,
+        #       [wbuf], sems
         xp_ref, vals_ref, bp_ref = refs[0], refs[1], refs[2]
         off = 3
         dinv_ref = refs[off] if has_dinv else None
         off += 1 if has_dinv else 0
-        xcp_ref, atab_ref, pcb_ref, taus_ref = \
-            refs[off], refs[off + 1], refs[off + 2], refs[off + 3]
-        off += 4
+        xcp_ref, atab_ref = refs[off], refs[off + 1]
+        off += 2
+        pwt_ref = refs[off] if has_w else None
+        off += 1 if has_w else 0
+        pcb_ref, taus_ref = refs[off], refs[off + 1]
+        off += 2
         y_ref = refs[off]
         off += 1
         xbuf, vbuf, bbuf = refs[off], refs[off + 1], refs[off + 2]
         off += 3
         dbuf = refs[off] if has_dinv else None
         off += 1 if has_dinv else 0
-        xcbuf, abuf, sems = refs[off], refs[off + 1], refs[off + 2]
+        xcbuf, abuf = refs[off], refs[off + 1]
+        off += 2
+        wbuf = refs[off] if has_w else None
+        off += 1 if has_w else 0
+        sems = refs[off]
 
         i = pl.program_id(0)
         slot = jax.lax.rem(i, jnp.int32(2))
@@ -1020,9 +1109,21 @@ def _dia_prolong_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x,
             ops.append(pltpu.make_async_copy(
                 xcp_ref.at[pl.ds(pcb_ref[blk], pcw)],
                 xcbuf.at[jnp.int32(s)], sems.at[jnp.int32(s), nsem]))
-            ops.append(pltpu.make_async_copy(
-                atab_ref.at[pl.ds(abase, win_x)],
-                abuf.at[jnp.int32(s)], sems.at[jnp.int32(s), nsem + 1]))
+            nsem += 1
+            if has_w:
+                for j in range(mp):
+                    ops.append(pltpu.make_async_copy(
+                        atab_ref.at[j, pl.ds(abase, win_x)],
+                        abuf.at[jnp.int32(s), j],
+                        sems.at[jnp.int32(s), nsem + j]))
+                    ops.append(pltpu.make_async_copy(
+                        pwt_ref.at[j, pl.ds(abase, win_x)],
+                        wbuf.at[jnp.int32(s), j],
+                        sems.at[jnp.int32(s), nsem + mp + j]))
+            else:
+                ops.append(pltpu.make_async_copy(
+                    atab_ref.at[pl.ds(abase, win_x)],
+                    abuf.at[jnp.int32(s)], sems.at[jnp.int32(s), nsem]))
             return ops
 
         @pl.when(i == 0)
@@ -1062,12 +1163,21 @@ def _dia_prolong_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x,
         # prologue: s = x + P xc over the WHOLE x window (the sweeps
         # consume halo rows, which need the corrected state too)
         s = xbuf[slot]
-        aw = abuf[slot]                                # (win_x, 128)
         xcw = xcbuf[slot].reshape(pcw * LANES)
-        rel = aw - pcb_ref[i] * jnp.int32(LANES)
-        valid = (aw >= 0) & (rel >= 0) & (rel < pcw * LANES)
-        corr0 = jnp.take(xcw, jnp.where(valid, rel, 0))
-        s = s + jnp.where(valid, corr0, jnp.zeros((), dtype))
+        if has_w:
+            for j in range(mp):
+                aw = abuf[slot, j]                     # (win_x, 128)
+                rel = aw - pcb_ref[i] * jnp.int32(LANES)
+                valid = (aw >= 0) & (rel >= 0) & (rel < pcw * LANES)
+                g = jnp.take(xcw, jnp.where(valid, rel, 0))
+                g = g * wbuf[slot, j]
+                s = s + jnp.where(valid, g, jnp.zeros((), dtype))
+        else:
+            aw = abuf[slot]                            # (win_x, 128)
+            rel = aw - pcb_ref[i] * jnp.int32(LANES)
+            valid = (aw >= 0) & (rel >= 0) & (rel < pcw * LANES)
+            corr0 = jnp.take(xcw, jnp.where(valid, rel, 0))
+            s = s + jnp.where(valid, corr0, jnp.zeros((), dtype))
         for t in range(n_steps):
             tau = taus_ref[t]
             mid = jax.lax.slice_in_dim(s, mr0, mr0 + win_v, 1, 0)
@@ -1094,14 +1204,17 @@ def _dia_prolong_smooth_call(vals_q, dinv_q, taus, b, x, xc, xfer,
     k = vals_q.shape[0]
     n_steps = taus.shape[0]
     has_dinv = dinv_q is not None
+    has_w = xfer.ptab is not None
     dtype = vals_q.dtype
-    plan = dia_prolong_plan(offsets, k, num_rows, n_steps, xfer.windows)
+    plan = dia_prolong_plan(offsets, k, num_rows, n_steps, xfer.windows,
+                            mp=xfer.mp, weighted=has_w, pavg=xfer.pavg)
     br, n_app, mr0, Mr0, win_x, win_v, nb, pcw = plan
     qf, qc, qb = smooth_quota_rows(offsets, num_rows)
     assert vals_q.shape[1] == qf + qc + qb
     slab_shift = qf - (n_app - 1) * mr0
     aqf, aqc, aqb = transfer_quota_rows(offsets, num_rows)
-    assert xfer.atab.shape[0] == aqf + aqc + aqb
+    id_slab = xfer.ptab if has_w else xfer.atab
+    assert id_slab.shape[1 if has_w else 0] == aqf + aqc + aqb
     ashift = aqf - n_app * mr0
     n = num_rows
     pcb = xfer.bases[br][1]
@@ -1122,8 +1235,9 @@ def _dia_prolong_smooth_call(vals_q, dinv_q, taus, b, x, xc, xfer,
 
     kernel = _dia_prolong_smooth_kernel(
         offsets, br, n_app, mr0, Mr0, win_x, win_v, n_steps, has_dinv,
-        nb, slab_shift, ashift, pcw, dtype)
-    n_sem = (4 if has_dinv else 3) + 2
+        nb, slab_shift, ashift, pcw, xfer.mp, has_w, dtype)
+    n_sem = (4 if has_dinv else 3) + 1 \
+        + (2 * xfer.mp if has_w else 1)
     in_specs = [
         pl.BlockSpec(memory_space=pl.ANY),          # xp
         pl.BlockSpec(memory_space=pl.ANY),          # vals_q
@@ -1135,8 +1249,11 @@ def _dia_prolong_smooth_call(vals_q, dinv_q, taus, b, x, xc, xfer,
         operands.append(dinv_q)
     in_specs.append(pl.BlockSpec(memory_space=pl.ANY))   # xcp
     operands.append(xcp)
-    in_specs.append(pl.BlockSpec(memory_space=pl.ANY))   # atab
-    operands.append(xfer.atab)
+    in_specs.append(pl.BlockSpec(memory_space=pl.ANY))   # atab | ptab
+    operands.append(id_slab)
+    if has_w:
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))   # pwt
+        operands.append(xfer.pwt.astype(dtype))
     in_specs.append(pl.BlockSpec((nb,), lambda i: (jnp.int32(0),),
                                  memory_space=pltpu.SMEM))
     operands.append(pcb.astype(jnp.int32))
@@ -1154,7 +1271,12 @@ def _dia_prolong_smooth_call(vals_q, dinv_q, taus, b, x, xc, xfer,
     if has_dinv:
         scratch.append(pltpu.VMEM((2, win_v, LANES), dtype))
     scratch.append(pltpu.VMEM((2, pcw, LANES), dtype))
-    scratch.append(pltpu.VMEM((2, win_x, LANES), jnp.int32))
+    if has_w:
+        scratch.append(pltpu.VMEM((2, xfer.mp, win_x, LANES),
+                                  jnp.int32))
+        scratch.append(pltpu.VMEM((2, xfer.mp, win_x, LANES), dtype))
+    else:
+        scratch.append(pltpu.VMEM((2, win_x, LANES), jnp.int32))
     scratch.append(pltpu.SemaphoreType.DMA((2, n_sem)))
     y2 = pl.pallas_call(
         kernel,
@@ -1165,7 +1287,8 @@ def _dia_prolong_smooth_call(vals_q, dinv_q, taus, b, x, xc, xfer,
         scratch_shapes=scratch,
         cost_estimate=pl.CostEstimate(
             flops=2 * n_app * k * nb * br * LANES,
-            bytes_accessed=((k + 2) * win_v + 2 * win_x + pcw + br)
+            bytes_accessed=((k + 2) * win_v + win_x + pcw + br
+                            + (2 * xfer.mp if has_w else 1) * win_x)
             * nb * LANES * 4,
             transcendentals=0,
         ),

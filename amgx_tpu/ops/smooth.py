@@ -358,6 +358,53 @@ def fused_smooth(data, b, x, taus, dinv=None, with_residual=True):
 # ---------------------------------------------------------------------------
 
 
+def _coarse_window_tables(crmin, crmax, n: int, ncr: int, offsets):
+    """Per-candidate-block-size coarse window sizes + base tables from
+    per-128-lane-row coarse-ROW min/max reach arrays (sentinel `big`
+    min / -1 max for rows referencing nothing). Shared by the
+    aggregation and the general-CSR slab builders so the window math
+    the plans budget against can never fork."""
+    import numpy as np
+    L = _ps.LANES
+    rows128 = max(1, -(-n // L))
+    big = np.int64(1) << 60
+    mr0, Mr0 = _ps.smooth_halo_rows(offsets)
+    K1 = _ps.SMOOTH_MAX_APPS * mr0
+    K2 = _ps.SMOOTH_MAX_APPS * Mr0
+
+    def _block_minmax(lo_off, hi_off, br, nb):
+        mn = np.full(nb, big)
+        mx = np.full(nb, np.int64(-1))
+        for i in range(nb):
+            lo = max(0, i * br + lo_off)
+            hi = min(rows128, i * br + br + hi_off)
+            if hi > lo:
+                mn[i] = crmin[lo:hi].min()
+                mx[i] = crmax[lo:hi].max()
+        return mn, mx
+
+    windows = []
+    bases = {}
+    for br in _ps.smooth_br_candidates(n):
+        nb = -(-rows128 // br)
+        if nb > 4096:
+            continue        # base-table build cost guard (tiny brs at
+            # huge n are never picked by the plans anyway)
+        mn, mx = _block_minmax(0, 0, br, nb)
+        mn = np.where(mx < 0, 0, np.minimum(mn, ncr - 1))
+        mx = np.maximum(mx, mn)
+        cw = int(min(ncr, -(-int((mx - mn).max() + 1) // 8) * 8))
+        cb = np.clip(mn, 0, ncr - cw).astype(np.int32)
+        mn2, mx2 = _block_minmax(-K1, K2, br, nb)
+        mn2 = np.where(mx2 < 0, 0, np.minimum(mn2, ncr - 1))
+        mx2 = np.maximum(mx2, mn2)
+        pcw = int(min(ncr, -(-int((mx2 - mn2).max() + 1) // 8) * 8))
+        pcb = np.clip(mn2, 0, ncr - pcw).astype(np.int32)
+        windows.append((br, cw, pcw))
+        bases[br] = (jnp.asarray(cb), jnp.asarray(pcb))
+    return tuple(windows), bases
+
+
 def build_transfer_slabs(A, agg, nc: int):
     """Structure-only transfer payloads for the fused grid-transfer
     kernels (host numpy build, one device upload per (re)setup):
@@ -400,44 +447,109 @@ def build_transfer_slabs(A, agg, nc: int):
     big = np.int64(1) << 60
     crmin = np.where(a2 >= 0, a2 // L, big).min(axis=1)
     crmax = np.where(a2 >= 0, a2 // L, -1).max(axis=1)
-    mr0, Mr0 = _ps.smooth_halo_rows(offsets)
-    K1 = _ps.SMOOTH_MAX_APPS * mr0
-    K2 = _ps.SMOOTH_MAX_APPS * Mr0
-
-    def _block_minmax(lo_off, hi_off, br, nb):
-        mn = np.full(nb, big)
-        mx = np.full(nb, np.int64(-1))
-        for i in range(nb):
-            lo = max(0, i * br + lo_off)
-            hi = min(rows128, i * br + br + hi_off)
-            if hi > lo:
-                mn[i] = crmin[lo:hi].min()
-                mx[i] = crmax[lo:hi].max()
-        return mn, mx
-
-    windows = []
-    bases = {}
-    for br in _ps.smooth_br_candidates(n):
-        nb = -(-rows128 // br)
-        if nb > 4096:
-            continue        # base-table build cost guard (tiny brs at
-            # huge n are never picked by the plans anyway)
-        mn, mx = _block_minmax(0, 0, br, nb)
-        mn = np.where(mx < 0, 0, np.minimum(mn, ncr - 1))
-        mx = np.maximum(mx, mn)
-        cw = int(min(ncr, -(-int((mx - mn).max() + 1) // 8) * 8))
-        cb = np.clip(mn, 0, ncr - cw).astype(np.int32)
-        mn2, mx2 = _block_minmax(-K1, K2, br, nb)
-        mn2 = np.where(mx2 < 0, 0, np.minimum(mn2, ncr - 1))
-        mx2 = np.maximum(mx2, mn2)
-        pcw = int(min(ncr, -(-int((mx2 - mn2).max() + 1) // 8) * 8))
-        pcb = np.clip(mn2, 0, ncr - pcw).astype(np.int32)
-        windows.append((br, cw, pcw))
-        bases[br] = (jnp.asarray(cb), jnp.asarray(pcb))
+    windows, bases = _coarse_window_tables(crmin, crmax, n, ncr,
+                                           offsets)
     if not windows:
         return None
     return _ps.TransferSlabs(jnp.asarray(ctab), jnp.asarray(atab),
-                             bases, int(nc), ncr, m, tuple(windows))
+                             bases, int(nc), ncr, m, windows)
+
+
+def build_csr_transfer_slabs(A, P, R):
+    """WEIGHTED row-segment transfer payloads for the fused
+    grid-transfer kernels over general CSR interpolation (classical
+    Ruge-Stuben levels; host numpy build, one device upload). The
+    aggregation slabs generalize entrywise:
+
+    - restriction (R = P^T, nc x n): ctab[j][c] = fine slot of R row
+      c's j-th entry (-1 absent), cwt[j][c] = its weight — the kernel
+      epilogue computes bc[c] = sum_j cwt[j][c] * r[ctab[j][c]];
+    - prolongation (P, n x nc): ptab[j][slot] / pwt[j][slot] = the
+      j-th (coarse id, weight) entry of P's row at that fine slot,
+      quota-padded like atab — the prologue folds
+      x += sum_j pwt[j] * xc[ptab[j]] into the postsmoother's first
+      application.
+
+    Classical structure reuse keeps P/R (values included) across
+    value resetups, so these slabs are structure-lifetime payloads
+    exactly like the aggregation child tables. Returns None when A
+    has no eligible DIA layout, P/R shapes disagree with A, or a row
+    exceeds the child caps (CSR_TRANSFER_MAX_CHILD restriction /
+    TRANSFER_MAX_CHILD prolongation)."""
+    import numpy as np
+    if not _slab_eligible(A) or A.dia_offsets is None:
+        return None
+    if P is None or R is None or getattr(P, "is_block", True):
+        return None
+    offsets = A.dia_offsets
+    n = A.num_rows
+    nc = int(P.num_cols)
+    if int(P.num_rows) != n or nc < 1 or int(R.num_rows) != nc \
+            or int(R.num_cols) != n:
+        return None
+    pro = np.asarray(P.row_offsets).astype(np.int64)
+    pci = np.asarray(P.col_indices).astype(np.int64)
+    pv = np.asarray(P.values)
+    rro = np.asarray(R.row_offsets).astype(np.int64)
+    rci = np.asarray(R.col_indices).astype(np.int64)
+    rv = np.asarray(R.values)
+    rlen = np.diff(rro)
+    plen = np.diff(pro)
+    m = int(rlen.max()) if nc else 0
+    mp = int(plen.max()) if n else 0
+    if m < 1 or m > _ps.CSR_TRANSFER_MAX_CHILD \
+            or mp < 1 or mp > _ps.TRANSFER_MAX_CHILD:
+        return None
+    ncr = _ps.coarse_pad_rows(nc)
+    L = _ps.LANES
+    # restriction row segments, entry j of R row c
+    jpos = np.arange(rci.shape[0], dtype=np.int64) \
+        - np.repeat(rro[:-1], rlen)
+    crow = np.repeat(np.arange(nc, dtype=np.int64), rlen)
+    ctab = np.full((m, ncr * L), -1, np.int32)
+    cwt = np.zeros((m, ncr * L), rv.dtype)
+    ctab[jpos, crow] = rci.astype(np.int32)
+    cwt[jpos, crow] = rv
+    ctab = ctab.reshape(m, ncr, L)
+    cwt = cwt.reshape(m, ncr, L)
+    # prolongation row segments, entry j of P row i, quota-padded
+    aqf, aqc, aqb = _ps.transfer_quota_rows(offsets, n)
+    rows_q = aqf + aqc + aqb
+    jp = np.arange(pci.shape[0], dtype=np.int64) \
+        - np.repeat(pro[:-1], plen)
+    prow = np.repeat(np.arange(n, dtype=np.int64), plen)
+    ptab = np.full((mp, rows_q * L), -1, np.int32)
+    pwt = np.zeros((mp, rows_q * L), pv.dtype)
+    ptab[jp, aqf * L + prow] = pci.astype(np.int32)
+    pwt[jp, aqf * L + prow] = pv
+    ptab = ptab.reshape(mp, rows_q, L)
+    pwt = pwt.reshape(mp, rows_q, L)
+    # per-fine-slot coarse reach (min/max coarse id P's row touches)
+    # -> per-128-row coarse-ROW reach -> per-block window bases
+    big = np.int64(1) << 60
+    minc = np.full(n, big, np.int64)
+    maxc = np.full(n, np.int64(-1), np.int64)
+    np.minimum.at(minc, prow, pci)
+    np.maximum.at(maxc, prow, pci)
+    rows128 = max(1, -(-n // L))
+    minp = np.full((rows128 * L,), big, np.int64)
+    maxp = np.full((rows128 * L,), np.int64(-1), np.int64)
+    minp[:n] = minc
+    maxp[:n] = maxc
+    mn2 = minp.reshape(rows128, L)
+    mx2 = maxp.reshape(rows128, L)
+    crmin = np.where(mx2 >= 0, mn2 // L, big).min(axis=1)
+    crmax = np.where(mx2 >= 0, mx2 // L, -1).max(axis=1)
+    windows, bases = _coarse_window_tables(crmin, crmax, n, ncr,
+                                           offsets)
+    if not windows:
+        return None
+    wavg = max(1, -(-int(rlen.sum()) // max(nc, 1)))
+    pavg = max(1, -(-int(plen.sum()) // max(n, 1)))
+    return _ps.TransferSlabs(
+        jnp.asarray(ctab), None, bases, int(nc), ncr, m, windows,
+        cwt=jnp.asarray(cwt), ptab=jnp.asarray(ptab),
+        pwt=jnp.asarray(pwt), mp=mp, wavg=wavg, pavg=pavg)
 
 
 def _xla_restrict_single(A, taus, b, x, dinv, xfer):
@@ -756,6 +868,10 @@ def coarse_tail_cycle(amg, shape: str, data, lvl: int, b, x):
         xfer = ld.get("xfer")
         smd = ld.get("smoother")
         if xfer is None or smd is None:
+            return None
+        if xfer.ptab is not None:
+            # weighted (classical) slabs: _tail_compute's gathers are
+            # unit-weight — those levels keep per-level kernels
             return None
         fused = smd.get("fused")
         A = ld["A"]

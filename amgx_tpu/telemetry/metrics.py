@@ -364,6 +364,10 @@ declare_counter("amg.setup.restored",
                 "setups served from a persisted structure snapshot "
                 "(serving/hstore.py: load + structure-reuse rebuild — "
                 "the crash-recovery path that replaces a full setup)")
+declare_counter("amg.selector.device_sweep",
+                "RS/HMIS first passes taken by the device-parallel "
+                "independent-set sweep instead of the host-serial "
+                "bucket queue (selector_device_sweep routing)")
 
 # GEO Galerkin CSR-structure device cache (amg/aggregation/galerkin.py):
 # a miss at 256^3 re-uploads ~1 GB of structure arrays per warm setup

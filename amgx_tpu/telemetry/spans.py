@@ -54,6 +54,12 @@ DECLARED_SPANS: Tuple[str, ...] = (
     "amg.L*.interp",
     "amg.L*.layoutP",
     "amg.L*.transposeR",
+    "amg.L*.xfer_slabs",
+    # classical device-parallel RS/HMIS first pass: runs INSIDE the
+    # amg.L*.cfsplit leaf on the main thread, so it is declared
+    # OUTSIDE the amg.* accounted prefix (summing both would
+    # double-count the selector wall)
+    "selector.device_sweep",
     "amg.L*.rap",
     "amg.L*.galerkin",
     "amg.L*.layout",

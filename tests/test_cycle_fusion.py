@@ -317,10 +317,12 @@ def test_cycle_fusion_off_restores_pr4_composition():
     assert str(jaxpr2) == str(_trace_cycle(", amg:cycle_fusion=0")[1])
 
 
-def test_classical_levels_fall_back_unfused():
-    """Classical (explicit-P/R) hierarchies decline every hook: the
-    fused cycle of a classical config is identical to its unfused
-    cycle and still solves."""
+def test_classical_fused_cycle_matches_unfused():
+    """Classical hierarchies now RIDE the fused hooks (ISSUE 12:
+    weighted row-segment slabs — see tests/test_classical_fusion.py
+    for the kernel-level proofs); this guards the integration from the
+    aggregation suite's angle: the fused classical cycle solves to the
+    same answer as the cycle_fusion=0 composition."""
     cfg = ("solver(s)=PCG, s:max_iters=40, s:tolerance=1e-7,"
            " s:convergence=RELATIVE_INI, s:monitor_residual=1,"
            " s:preconditioner(amg)=AMG, amg:algorithm=CLASSICAL,"

@@ -91,13 +91,93 @@ class TestClassicalParity:
                        _amg(extra + "setup_backend=device", A))
 
     @pytest.mark.slow
-    def test_hmis_parity(self):
-        """HMIS keeps its host-serial RS pass in BOTH backends (the
-        reference runs RS on the host even in device builds) — the
-        device pipeline covers the PMIS fixup; splits must agree."""
+    def test_hmis_parity_queue_escape_hatch(self):
+        """selector_device_sweep=0 pins the host-serial bucket queue in
+        BOTH backends (the pre-ISSUE-12 composition): splits must be
+        bit-identical across backends — the escape hatch that restores
+        the old host-RS-everywhere behavior."""
         A = gallery.poisson("5pt", 18, 18).init()
-        _assert_parity(_amg("selector=HMIS, setup_backend=host", A),
-                       _amg("selector=HMIS, setup_backend=device", A))
+        extra = "selector=HMIS, selector_device_sweep=0, setup_backend="
+        _assert_parity(_amg(extra + "host", A),
+                       _amg(extra + "device", A))
+
+
+class TestSelectorDeviceSweep:
+    """The device-parallel RS/HMIS first pass (ISSUE 12: rs_sweep, a
+    PMIS-style fixpoint with the live RS weight as priority). The
+    sweep is a DIFFERENT algorithm from the serial bucket queue (whose
+    dynamic LIFO tie-break is inherently serial), so its parity
+    contract is across BACKENDS: integer-keyed, bit-identical splits
+    whether it runs in the host or the forced-device pipeline."""
+
+    @pytest.mark.parametrize("selector", ["HMIS", "RS"])
+    def test_sweep_backend_parity(self, selector):
+        A = gallery.poisson("5pt", 18, 18).init()
+        extra = (f"selector={selector}, selector_device_sweep=1,"
+                 " setup_backend=")
+        _assert_parity(_amg(extra + "host", A),
+                       _amg(extra + "device", A))
+
+    def test_device_backend_routes_to_sweep(self):
+        """setup_backend=device + selector_device_sweep=auto takes the
+        sweep (counted); the host backend keeps the bucket queue."""
+        from amgx_tpu.telemetry import metrics as _tm
+        A = gallery.poisson("5pt", 12, 12).init()
+        c0 = int(_tm.get("amg.selector.device_sweep"))
+        _amg("selector=HMIS, setup_backend=host", A)
+        assert int(_tm.get("amg.selector.device_sweep")) == c0
+        d = _amg("selector=HMIS, setup_backend=device", A)
+        assert int(_tm.get("amg.selector.device_sweep")) > c0
+        assert all(lv.built_backend == "device" for lv in d.levels)
+
+    def test_sweep_covers_fine_points(self):
+        """Every FINE point with a strong edge must see a COARSE
+        neighbor (classical interpolation's hard requirement) — the
+        sweep's equivalent of the queue's coverage invariant."""
+        from amgx_tpu import registry
+        from amgx_tpu.amg.classical.selectors import rs_sweep
+        A = gallery.poisson("9pt", 16, 16).init()
+        cfg = Config.from_string(
+            "algorithm=CLASSICAL, strength_threshold=0.25")
+        st = registry.strength.create("AHAT", cfg, "default")
+        strong = st.strong_mask(A)
+        cf = np.asarray(rs_sweep(A, strong))
+        n = A.num_rows
+        ro = np.asarray(A.row_offsets)
+        ci = np.asarray(A.col_indices)
+        stn = np.asarray(strong, bool)
+        rows = np.repeat(np.arange(n), np.diff(ro))
+        mask = stn & (ci < n) & (ci != rows)
+        er, ec = rows[mask], ci[mask]
+        covered = np.zeros(n, bool)
+        np.maximum.at(covered, er, cf[ec] == 1)
+        has_edge = np.zeros(n, bool)
+        has_edge[er] = True
+        assert not ((cf == 0) & has_edge & ~covered).any()
+        assert 0.1 < cf.mean() < 0.9      # a real split, not all-C/F
+
+    @pytest.mark.slow
+    def test_sweep_hierarchy_converges_like_queue(self):
+        """Solver quality oracle: a sweep-coarsened HMIS hierarchy
+        converges within a few iterations of the bucket-queue build."""
+        A = gallery.poisson("7pt", 12, 12, 12).init()
+        b = np.ones(A.num_rows)
+        iters = {}
+        for mode in ("0", "1"):
+            cfg = Config.from_string(
+                "solver(s)=PCG, s:max_iters=80, s:tolerance=1e-8,"
+                " s:convergence=RELATIVE_INI, s:monitor_residual=1,"
+                " s:preconditioner(amg)=AMG, amg:algorithm=CLASSICAL,"
+                " amg:selector=HMIS, amg:interpolator=D2,"
+                " amg:smoother=JACOBI_L1, amg:max_iters=1,"
+                " amg:min_coarse_rows=16, amg:max_levels=10,"
+                f" amg:selector_device_sweep={mode}")
+            s = amgx.create_solver(cfg)
+            s.setup(A)
+            r = s.solve(jnp.asarray(b))
+            assert bool(r.converged), mode
+            iters[mode] = int(r.iterations)
+        assert abs(iters["0"] - iters["1"]) <= 5, iters
 
 
 class TestAggregationParity:

@@ -84,6 +84,15 @@ SERIES: Tuple[Tuple[str, str, float, str], ...] = (
      "classical PMIS+D2 128^3 warm setup wall (s) — ROADMAP item 2"),
     ("classical_pmis_d2_128^3_solve_s", "lower", 0.40,
      "classical PMIS+D2 128^3 solve wall (s)"),
+    # ISSUE 12 classical-fusion headline walls: recorded from r06 on
+    # (the fused classical path + device selector land between r05 and
+    # r06), so the 24x classical-vs-flagship gap is sentinel-tracked
+    ("classical_128^3_setup_s", "lower", 0.40,
+     "classical 128^3 warm setup wall (s), fused-classical era — the "
+     "24x-gap tentpole's setup target (< 10 s)"),
+    ("classical_128^3_solve_s", "lower", 0.40,
+     "classical 128^3 solve wall (s), fused-classical era — the "
+     "24x-gap tentpole's solve target (< 2 s)"),
     ("spmv_vs_ceiling", "higher", 0.50,
      "DIA SpMV achieved bandwidth vs the rig's streaming ceiling "
      "(tunnel bandwidth swings ~2x run to run — r02-r04 recorded "
