@@ -25,6 +25,16 @@ from ..errors import BadConfigurationError
 from ..matrix import CsrMatrix
 
 
+def _record_route(route: str, A):
+    """Flight-recorder trail of the setup-routing decision (full build
+    vs value/structure resetup vs restored-from-snapshot) — ONE event
+    shape for all four routes (telemetry/flightrec.py; lazy import:
+    telemetry must stay importable without the amg package)."""
+    from ..telemetry import flightrec
+    flightrec.record("resetup.route", route=route,
+                     rows=int(A.num_rows))
+
+
 class AMGLevel:
     """One hierarchy level: fine matrix + transfer operators + smoother.
 
@@ -245,6 +255,7 @@ class AMG:
             if ghosts and ghosts[0].A.num_rows == A.num_rows:
                 return self._setup_restored(A, ghosts)
         _tm.inc("amg.setup.full")
+        _record_route("full", A)
         t0 = time.perf_counter()
         self.levels = []
         self._data_cache = None
@@ -297,6 +308,7 @@ class AMG:
         from ..profiling import trace_region
         from ..telemetry import metrics as _tm
         _tm.inc("amg.setup.restored")
+        _record_route("restored", A)
         self.levels = list(ghosts)
         self._data_cache = None
         self._put_cache = {}
@@ -472,8 +484,10 @@ class AMG:
                 if try_value_resetup(self, A):
                     self._last_resetup_value_only = True
                     _tm.inc("amg.resetup.value")
+                    _record_route("value", A)
                     return self
         _tm.inc("amg.resetup.structure")
+        _record_route("structure", A)
         # a structure resetup rebuilds levels and retraces the cycle:
         # the recorded tail boundary and the memoized report level
         # table are for the OLD hierarchy (the value-only path above

@@ -929,6 +929,19 @@ def AMGX_service_ticket_download(tkt_h, sol_h):
 
 
 @_api
+@_outputs(1)
+def AMGX_ticket_trace(tkt_h):
+    """rc, the ticket's request trace id (or None when
+    serving_tracing=0): the correlation key connecting this request's
+    Perfetto flow chain, its flight-recorder events and its journal
+    record — hand it to tools/flightrec.py --trace for a per-request
+    postmortem."""
+    from .serving import ServiceTicket
+    t = _get(tkt_h, ServiceTicket)
+    return RC.OK, t.trace_id
+
+
+@_api
 def AMGX_service_ticket_destroy(tkt_h):
     _handles.pop(tkt_h, None)
     return RC.OK

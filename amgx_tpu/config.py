@@ -465,6 +465,30 @@ def _register_default_parameters():
     R("serving_retry_max_attempts", int, "bound on per-fingerprint "
       "build/step recovery attempts; beyond it the affected tickets "
       "reject with BREAKDOWN", 3, None, 0)
+    # request-path observability (telemetry/spans.py flow chains +
+    # telemetry/flightrec.py)
+    R("serving_tracing", int, "request-path tracing: every ticket "
+      "mints a trace id and the serving pipeline emits per-lifecycle "
+      "spans (submit / shed / queue / build / admit / chunk-cycle / "
+      "checkpoint / finalize) tagged with it, exported as one "
+      "connected Perfetto flow chain per request "
+      "(spans.export_chrome_trace); the journal persists trace ids so "
+      "a crash-recovered resume links its spans to the ORIGINAL "
+      "trace. Host-side dict appends only — bench.py obs gates the "
+      "on/off overhead at <= 2%; 0 restores the pre-tracing span set",
+      1, BOOL01)
+    R("serving_replica_id", str, "replica/shard label stamped on "
+      "every OpenMetrics sample (replica=\"...\") so multi-replica "
+      "scrapes don't collide — the fleet-router prerequisite. '' "
+      "defers to the AMGX_REPLICA_ID env var; either is process-wide "
+      "(one replica = one process)", "")
+    R("flightrec_dir", str, "directory for the crash-surviving flight "
+      "recorder (telemetry/flightrec.py): state transitions (bucket "
+      "builds/quarantines, shed decisions + feasibility estimates, "
+      "fallback hops, resetup routing, chaos injections) append one "
+      "JSON line each, rotated and corruption-tolerant, for "
+      "tools/flightrec.py postmortems. '' = in-memory ring only "
+      "(AMGX_TPU_FLIGHTREC_DIR env also attaches a directory)", "")
     R("fallback_policy", str, "resilience chains "
       "'STATUS>action[=arg]|...' (actions: retry, rescale_retry, "
       "switch_solver=<NAME>, escalate_sweeps), applied host-side by "

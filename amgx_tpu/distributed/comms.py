@@ -12,11 +12,25 @@ add_from_halo analogs).
 The reference's two backends (MPI host-buffer staging vs GPU-direct,
 comms_mpi_hostbuffer_stream.cu / comms_mpi_gpudirect.cu) collapse to one:
 collectives ride ICI/DCN directly, chosen by the mesh topology.
+
+COMMS TELEMETRY: collectives are emitted by the traced program, so
+nothing host-side can count executed exchanges — but every exchange
+SITE passes through here exactly once per trace, with its window
+shapes statically known. `record_exchange` is that hook: each halo /
+packed-edge exchange site reports its mode and per-direction window
+element counts AT TRACE TIME; the modeled bytes (window elements x
+itemsize x sending ranks — exact by construction from the partition
+metadata, the number AmgX's interior/boundary split reasons about)
+feed the declared dist.* counters and, inside a `collect_exchanges()`
+scope, a per-site table the distributed solver merges into
+`report.distributed["comms"]` — the data needed to attribute the
+multi-chip per-chip-throughput gate.
 """
 from __future__ import annotations
 
 import contextlib
-from typing import Optional
+import threading
+from typing import Any, Dict, List, Optional
 
 _ACTIVE_AXIS: Optional[str] = None
 
@@ -36,6 +50,70 @@ def collective_axis(name: Optional[str]):
 
 def active_axis() -> Optional[str]:
     return _ACTIVE_AXIS
+
+
+# -- trace-time exchange telemetry -------------------------------------
+
+_collect_lock = threading.Lock()
+_collecting: Optional[List[Dict[str, Any]]] = None
+
+
+@contextlib.contextmanager
+def collect_exchanges():
+    """Collect the exchange sites traced inside the block into the
+    yielded list (one dict per site: site/mode/elems + modeled bytes
+    per direction). The distributed solver wraps the first call of a
+    freshly built shard_map program in this scope — tracing happens
+    there — and keeps the table for `report.distributed`."""
+    global _collecting
+    table: List[Dict[str, Any]] = []
+    with _collect_lock:
+        prev, _collecting = _collecting, table
+    try:
+        yield table
+    finally:
+        with _collect_lock:
+            _collecting = prev
+
+
+def record_exchange(site: str, mode: str, elems_fwd: int,
+                    elems_bwd: int, itemsize: int, n_ranks: int):
+    """Report one traced exchange site (called by the halo exchange
+    implementations while their program is being traced).
+
+    `elems_fwd`/`elems_bwd` are the PER-HOP window element counts in
+    the forward (toward rank+1) / backward (toward rank-1) direction;
+    the modeled per-direction bytes multiply by itemsize and by the
+    number of ranks that actually send in that direction:
+    - ring / packed-edge permutes: n_ranks - 1 hops per direction;
+    - a2a: every rank ships its full (n_ranks x max_pair) send buffer
+      — callers pass elems = n_ranks * max_pair per direction-half
+      with both directions folded into fwd (the collective has no
+      direction), bwd = 0;
+    - gather: every rank broadcasts its tile to the other n_ranks - 1
+      — callers fold the n_ranks sending tiles into elems
+      (n_ranks * tile), same direction folding.
+    Counters count traced SITES (one per site per traced program),
+    never executed iterations — documented in the catalog."""
+    from ..telemetry import metrics as _tm
+    bytes_fwd = int(elems_fwd) * int(itemsize) * max(n_ranks - 1, 0)
+    bytes_bwd = int(elems_bwd) * int(itemsize) * max(n_ranks - 1, 0)
+    _tm.inc("dist.exchange.calls")
+    _tm.inc(f"dist.exchange.{mode}")
+    if bytes_fwd:
+        _tm.inc("dist.comms.bytes_fwd", bytes_fwd)
+    if bytes_bwd:
+        _tm.inc("dist.comms.bytes_bwd", bytes_bwd)
+    with _collect_lock:
+        if _collecting is not None:
+            _collecting.append({
+                "site": str(site), "mode": str(mode),
+                "n_ranks": int(n_ranks),
+                "elems_fwd": int(elems_fwd),
+                "elems_bwd": int(elems_bwd),
+                "itemsize": int(itemsize),
+                "bytes_fwd": bytes_fwd, "bytes_bwd": bytes_bwd,
+            })
 
 
 def edge_permutes(n_ranks: int):

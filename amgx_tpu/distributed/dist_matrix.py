@@ -118,14 +118,21 @@ class ShardMatrix:
         (`halo_corrupt` — the link-fault model, faultinject.py): a
         trace-time no-op unless armed inside a solve-loop iteration."""
         from ..resilience import faultinject as _fault
+        from . import comms as _comms
         if self.n_ranks == 1:
             return jnp.zeros((self.n_halo,), x.dtype)
         ax = self.axis_name
+        itemsize = jnp.dtype(x.dtype).itemsize
+        site = f"halo/{self.n_local}"
         if self.exchange_mode == "ring":
-            from . import comms as _comms
             xp = jnp.concatenate([x, jnp.zeros((1,), x.dtype)])  # pad slot
             buf_next = xp[self.send_next]       # cols for rank+1
             buf_prev = xp[self.send_prev]       # cols for rank-1
+            # trace-time site report: per-hop window = the gathered
+            # boundary buffers (exactly what each ppermute ships)
+            _comms.record_exchange(
+                site, "ring", int(self.send_next.shape[0]),
+                int(self.send_prev.shape[0]), itemsize, self.n_ranks)
             fwd, bwd = _comms.edge_permutes(self.n_ranks)
             from_prev = jax.lax.ppermute(buf_next, ax, fwd)
             from_next = jax.lax.ppermute(buf_prev, ax, bwd)
@@ -136,11 +143,23 @@ class ShardMatrix:
         if self.exchange_mode == "a2a":
             xp = jnp.concatenate([x, jnp.zeros((1,), x.dtype)])
             bufs = xp[self.a2a_send]            # (n_ranks, max_pair)
+            # direction-free collective: every rank ships its whole
+            # send matrix; folded into fwd (comms.record_exchange docs)
+            _comms.record_exchange(
+                site, "a2a", int(bufs.shape[0] * bufs.shape[1]), 0,
+                itemsize, self.n_ranks)
             recv = jax.lax.all_to_all(bufs, ax, split_axis=0,
                                       concat_axis=0, tiled=True)
             halo = jnp.zeros((self.n_halo + 1,), x.dtype)
             halo = halo.at[self.a2a_recv].set(recv)
             return _fault.corrupt_halo(halo[: self.n_halo])
+        # all_gather: EVERY rank broadcasts its tile to the other
+        # n_ranks - 1 — fold the n_ranks sending tiles into elems so
+        # the (n_ranks - 1) hop factor yields the mesh total, matching
+        # the ring/a2a accounting convention
+        _comms.record_exchange(
+            site, "gather", int(self.n_local_cols) * self.n_ranks,
+            0, itemsize, self.n_ranks)
         x_all = jax.lax.all_gather(x, ax, tiled=True)   # padded global
         idx = jnp.clip(self.halo_src, 0, x_all.shape[0] - 1)
         return _fault.corrupt_halo(x_all[idx])

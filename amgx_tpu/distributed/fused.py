@@ -223,6 +223,15 @@ def _exchange_windows(x, b, fx, bx, fb, bb, axis, n_ranks):
     from ..resilience import faultinject as _fault
     nl = x.shape[0]
     fwd, bwd = comms.edge_permutes(n_ranks)
+    # trace-time site report: the packed (x window + b window) buffer
+    # each direction's single ppermute ships per fused call — the
+    # exact bytes the halo-folded path pays instead of one full halo
+    # per sweep. Both-windows-empty emits NO collective below, so it
+    # reports no site either (a counted site must mean real traffic)
+    if fx + fb > 0 or bx + bb > 0:
+        comms.record_exchange(
+            f"edge/{nl}", "edge_fused", fx + fb, bx + bb,
+            jnp.dtype(x.dtype).itemsize, n_ranks)
     hx_f = hb_f = hx_b = hb_b = None
     if fx + fb > 0:
         send_f = jnp.concatenate([x[nl - fx:], b[nl - fb:]]) \

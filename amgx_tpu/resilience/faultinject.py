@@ -148,6 +148,20 @@ def _check_env():
             kw[k] = float(v)
     _SPEC = FaultSpec(parts[0].strip(), **kw)
     _bump()
+    # the env path is how a LIVE process gets a drill — its arming
+    # must hit the postmortem trail exactly like a programmatic arm()
+    _flightrec(_SPEC.kind, armed=True, fires=_SPEC.fires)
+
+
+def _flightrec(kind: str, **fields):
+    """Record a chaos event on the flight recorder — the postmortem
+    trail must name the injected cause (lazy import: telemetry must
+    stay importable without resilience and vice versa)."""
+    try:
+        from ..telemetry import flightrec
+        flightrec.record("chaos", fault=kind, **fields)
+    except Exception:
+        pass
 
 
 def arm(spec: FaultSpec):
@@ -156,6 +170,9 @@ def arm(spec: FaultSpec):
     _ENV_CHECKED = True          # explicit arming overrides the env
     _SPEC = spec
     _bump()
+    # the arming itself is a state transition worth a postmortem line
+    # (an always-on fault like clock_skew never "fires" countably)
+    _flightrec(spec.kind, armed=True, fires=spec.fires)
 
 
 def disarm():
@@ -191,7 +208,10 @@ def consume(kind: str):
     """Record one firing (one poisoned trace, or one applied galerkin
     perturbation). Called at trace/apply time by the hooks' owners."""
     s = active(kind)
-    if s is not None and s.fires is not None:
+    if s is None:
+        return
+    _flightrec(kind, fired=True)
+    if s.fires is not None:
         s.fires -= 1
         _bump()
 

@@ -207,6 +207,11 @@ class ResilientSolver:
                 break
             action, arg = step
             attempts += 1
+            from ..telemetry import flightrec
+            flightrec.record(
+                "fallback.hop", action=action, arg=arg or None,
+                attempt=attempts,
+                from_status=SolveStatus(res.status_code).name)
             res = self._run_action(action, arg, b, x0,
                                    zero_initial_guess)
             history.append(

@@ -331,13 +331,27 @@ class BucketEngine:
         if occupant is True or self.occupant[slot] is not occupant:
             raise BadParametersError(f"serving: slot {slot} is occupied")
 
+    def _trace_args(self, *occupants):
+        """Span args tagging a stage with its occupants' request trace
+        ids (serving request-path tracing; None when nothing is tagged
+        — tickets only carry trace ids when serving_tracing=1, so the
+        knob gates this without the engine knowing it)."""
+        ids = [tr for o in occupants
+               for tr in [getattr(o, "trace_id", None)] if tr]
+        if not ids:
+            return None
+        if len(ids) == 1:
+            return {"trace": ids[0]}
+        return {"traces": ids}
+
     def admit(self, slot: int, A: CsrMatrix, b, x0=None,
               occupant: Any = True):
         """Fill `slot` with a new system at a cycle boundary: splice
         its values into the per-slot data rows (value-resetup path),
         scatter its freshly initialized solve state, mark occupied."""
         self._check_reserved(slot, occupant)
-        with trace_region("serving.admit"):
+        with trace_region("serving.admit",
+                          args=self._trace_args(occupant)):
             snap, b = self._splice_slot(slot, A, b)
             x0 = self._zeros_single() if x0 is None \
                 else jnp.asarray(x0, self.dtype)
@@ -364,7 +378,8 @@ class BucketEngine:
                 "serving: checkpointed state keys do not match this "
                 "bucket's solve state (solver config drifted across "
                 "the restart?)")
-        with trace_region("serving.admit"):
+        with trace_region("serving.admit",
+                          args=self._trace_args(occupant)):
             _snap, b = self._splice_slot(slot, A, b)
             for k, v in state_row.items():
                 ref = self._state[k]
@@ -402,7 +417,8 @@ class BucketEngine:
         _fi.service_crash("step_crash")
         if _fi.step_wedged():
             return []
-        with trace_region("serving.step"):
+        with trace_region("serving.step",
+                          args=self._trace_args(*self.occupant)):
             self._state = self._bstep(self._data_tree(), self._B,
                                       self._state)
             # one eager reduction, ONE awaited buffer: remote rigs pay
@@ -426,7 +442,9 @@ class BucketEngine:
         bookkeeping."""
         if not slot_list:
             return {}
-        with trace_region("serving.finalize"):
+        with trace_region("serving.finalize",
+                          args=self._trace_args(
+                              *(self.occupant[j] for j in slot_list))):
             X, stats = self._bfinish(self._data_tree(), self._B,
                                      self._state)
             stats = np.asarray(stats)

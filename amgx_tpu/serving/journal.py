@@ -116,10 +116,16 @@ class SolveJournal:
                       A: CsrMatrix, b: np.ndarray,
                       x0: Optional[np.ndarray],
                       deadline_remaining_s: Optional[float],
-                      request_key: Optional[str]) -> str:
+                      request_key: Optional[str],
+                      trace_id: Optional[str] = None) -> str:
         """Persist one request; returns its journal id. The pattern
         (index arrays + shape metadata) is written once per
-        fingerprint, the per-request record holds only values/rhs."""
+        fingerprint, the per-request record holds only values/rhs.
+        `trace_id` is the request's span-flow trace id: persisting it
+        is what lets a crash-recovered resume tag its spans with the
+        ORIGINAL trace (one connected Perfetto chain across both
+        service incarnations) and lets tools/flightrec.py join the
+        flight-recorder trail to journal records."""
         with self._lock:
             seq, self._seq = self._seq, self._seq + 1
         jid = f"{seq:08d}"
@@ -142,6 +148,7 @@ class SolveJournal:
         meta = {"id": jid, "seq": seq, "key": request_key or None,
                 "tenant": str(tenant), "fingerprint": str(fingerprint),
                 "deadline_remaining_s": deadline_remaining_s,
+                "trace": trace_id or None,
                 "status": "pending"}
         self._write_json(self._jpath(jid, "json"), meta)
         with self._lock:

@@ -56,6 +56,7 @@ complete asynchronously (`ticket.wait()`).
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import threading
 import time
@@ -70,7 +71,9 @@ from ..matrix import CsrMatrix
 from ..resilience import faultinject as _fi
 from ..resilience.status import SolveStatus
 from ..solvers.base import SolveResult
+from ..telemetry import flightrec as _fr
 from ..telemetry import metrics as _tm
+from ..telemetry import spans as _spans
 from .aot import AotStore
 from .cache import HierarchyCache, solve_data_bytes
 from .engine import BucketEngine
@@ -115,6 +118,15 @@ class ServiceTicket:
     journal_id: Optional[str] = None
     resume_state: Optional[Dict[str, np.ndarray]] = None
     admit_t: Optional[float] = None
+    # request trace id (telemetry/spans.py): every lifecycle span of
+    # this request is tagged with it, so the Perfetto export connects
+    # them into one flow chain; persisted in the journal so a
+    # crash-recovered resume keeps the ORIGINAL id
+    trace_id: Optional[str] = None
+    # submit wall in spans' perf_counter epoch (the retroactive
+    # serving.queue span's start; service_now() is skew-hookable and
+    # lives in a different epoch)
+    _perf_submit: float = 0.0
     _event: threading.Event = dataclasses.field(
         default_factory=threading.Event)
 
@@ -162,6 +174,14 @@ class SolveService:
         from ..resilience.policy import parse_service_policy
         self._svc_policy = parse_service_policy(
             cfg.get("serving_fault_policy", scope))
+        # request-path tracing + fleet observability knobs
+        self.tracing = bool(int(cfg.get("serving_tracing", scope)))
+        replica = str(cfg.get("serving_replica_id", scope)).strip()
+        if replica:
+            _tm.set_replica_label(replica)
+        frdir = str(cfg.get("flightrec_dir", scope)).strip()
+        if frdir:
+            _fr.configure(frdir)
         aot_dir = str(cfg.get("serving_aot_dir", scope)).strip()
         self.aot: Optional[AotStore] = \
             AotStore(aot_dir) if aot_dir else None
@@ -209,11 +229,68 @@ class SolveService:
         # completed journaled tickets awaiting their record_done write
         # (flushed outside the lock each cycle)
         self._journal_doneq: List[ServiceTicket] = []
+        # flight-recorder events minted under the service lock queue
+        # here and flush outside it (disk write + flush per event —
+        # the PR-11 lock-split discipline applies to the recorder
+        # exactly as it does to the journal); a deferred BREAKDOWN
+        # dump rides along (it prints through the user's output
+        # callback, which must never run lock-held)
+        self._fr_q: List[Tuple[str, Optional[str], Dict[str, Any]]] = []
+        self._fr_dump_reason: Optional[str] = None
         # per-tenant tallies for stats()
         self._tenants: Dict[str, Dict[str, int]] = {}
         if self.journal is not None and \
                 int(cfg.get("serving_recover", scope)):
             self.recover()
+
+    # -- request-path tracing ----------------------------------------------
+    # (the _raw aliases keep tools/check_spans.py honest: _tspan/_tmark
+    # call sites carry the literal names the lint checks, while these
+    # forwarding bodies — generic `name` parameters like the spans
+    # engine itself — stay off its span-call surface)
+    _raw_span = staticmethod(_spans.span)
+    _raw_mark = staticmethod(_spans.mark)
+
+    def _tspan(self, name: str, **args):
+        """A lifecycle span tagged with request-trace args, or a
+        no-op when serving_tracing=0 (the pre-tracing span set)."""
+        if not self.tracing:
+            return contextlib.nullcontext()
+        return self._raw_span(name, annotate=False, args=args)
+
+    def _tmark(self, name: str, **args):
+        if self.tracing:
+            self._raw_mark(name, args=args)
+
+    def _fr_enqueue(self, kind: str, trace: Optional[str] = None,
+                    **fields):
+        """Queue a flight event minted while the service lock is held
+        (callers: shed / build-failure / quarantine bookkeeping). The
+        crash-survival window widens by at most one cycle — the same
+        accepted-durable-once-returned model the journal documents."""
+        self._fr_q.append((kind, trace, fields))
+
+    def _flush_flightrec(self):
+        """Write queued flight events + any deferred BREAKDOWN dump.
+        File IO and output-callback work — callers must NOT hold the
+        service lock."""
+        with self._lock:
+            q, self._fr_q = self._fr_q, []
+            reason, self._fr_dump_reason = self._fr_dump_reason, None
+        for kind, trace, fields in q:
+            _fr.record(kind, trace=trace, **fields)
+        if reason is not None:
+            _fr.dump_recent(reason=reason)
+
+    def _trace_list(self, tickets) -> Optional[List[str]]:
+        """trace ids of `tickets` (None entries skipped), or None when
+        tracing is off / nothing is tagged — batched stages (step /
+        checkpoint / finalize) tag the whole set they touched."""
+        if not self.tracing:
+            return None
+        ids = [t.trace_id for t in tickets
+               if t is not None and t.trace_id]
+        return ids or None
 
     # -- submission --------------------------------------------------------
     def _tenant(self, name: str) -> Dict[str, int]:
@@ -255,13 +332,17 @@ class SolveService:
             submit_t=now,
             deadline_t=None if deadline_s is None
             else now + float(deadline_s),
-            request_key=request_key or None)
+            request_key=request_key or None,
+            trace_id=_spans.new_trace_id() if self.tracing else None,
+            _perf_submit=time.perf_counter())
         _tm.inc("serving.requests")
         # ONE lock section for dedupe-recheck + shed decision + key
         # registration + enqueue: splitting these would let concurrent
         # submits breach the queue bound / tenant quota (check-then-act)
         # or double-enqueue one request_key
-        with self._lock:
+        shed_early = False
+        with self._tspan("serving.submit", trace=ticket.trace_id,
+                         tenant=ticket.tenant), self._lock:
             if request_key:
                 live = self._keyed.get(request_key)
                 if live is not None:      # lost the race to a twin
@@ -270,12 +351,22 @@ class SolveService:
             self._tenant(ticket.tenant)["submitted"] += 1
             shed = self._shed_reason(ticket, deadline_s)
             if shed is not None:
-                self._shed(ticket, shed)
-                return ticket
-            if request_key:
-                self._keyed[request_key] = ticket
-            self._queue.append(ticket)
-            _tm.set_gauge("serving.queue_depth", len(self._queue))
+                reason, est = shed
+                self._shed(ticket, reason, est, deadline_s)
+                shed_early = True
+            else:
+                if request_key:
+                    self._keyed[request_key] = ticket
+                self._queue.append(ticket)
+                _tm.set_gauge("serving.queue_depth", len(self._queue))
+        # queue-wait epoch starts where the submit span ends: the
+        # retroactive serving.queue span then follows serving.submit
+        # on the flow chain instead of overlapping it
+        ticket._perf_submit = time.perf_counter()
+        if shed_early:
+            # the shed's flight event (file IO) writes off the lock
+            self._flush_flightrec()
+            return ticket
         # journal outside the lock (file IO must not block other
         # submitters or the scheduler). The request only counts as
         # accepted-durable once submit() RETURNS — a crash inside this
@@ -291,7 +382,8 @@ class SolveService:
                     A=A, b=b, x0=ticket.x0,
                     deadline_remaining_s=None if deadline_s is None
                     else float(deadline_s),
-                    request_key=request_key or None)
+                    request_key=request_key or None,
+                    trace_id=ticket.trace_id)
                 if ticket.done:
                     self._journal_done(ticket, ticket.result)
             except Exception:
@@ -324,7 +416,10 @@ class SolveService:
             A=None, b=np.asarray(x), x0=None,
             tenant=rec.get("tenant", "default"),
             fingerprint=rec.get("fingerprint", ""), submit_t=now,
-            deadline_t=None, request_key=request_key)
+            deadline_t=None, request_key=request_key,
+            # same knob gate as recover(): a serving_tracing=0
+            # incarnation hands out no trace ids, journaled or not
+            trace_id=rec.get("trace") if self.tracing else None)
         t._complete(SolveResult(
             x=np.asarray(x), iterations=int(iterations),
             converged=status_code == int(SolveStatus.CONVERGED),
@@ -334,12 +429,15 @@ class SolveService:
 
     # -- load shedding -----------------------------------------------------
     def _shed_reason(self, t: ServiceTicket,
-                     deadline_s: Optional[float]) -> Optional[str]:
-        """Admission control (lock held): None = admit, else the shed
-        class ('overload' queue bound / 'quota' tenant fairness /
-        'deadline' unmeetable-by-estimate)."""
+                     deadline_s: Optional[float]
+                     ) -> Optional[Tuple[str, Optional[float]]]:
+        """Admission control (lock held): None = admit, else (shed
+        class, feasibility estimate): 'overload' queue bound / 'quota'
+        tenant fairness / 'deadline' unmeetable-by-estimate — the
+        estimate rides along so the shed decision is auditable (the
+        flight recorder logs it with the decision)."""
         if self.max_queue and len(self._queue) >= self.max_queue:
-            return "overload"
+            return "overload", None
         if self.tenant_quota:
             live = sum(1 for q in self._queue if q.tenant == t.tenant)
             for key in self.buckets.keys():
@@ -350,11 +448,11 @@ class SolveService:
                             if o is not None and getattr(o, "tenant", None)
                             == t.tenant)
             if live >= self.tenant_quota:
-                return "quota"
+                return "quota", None
         if self.shed_policy == "deadline" and deadline_s is not None:
             est = self._estimate_latency_s()
             if est is not None and float(deadline_s) < est:
-                return "deadline"
+                return "deadline", est
         return None
 
     def _estimate_latency_s(self) -> Optional[float]:
@@ -386,13 +484,27 @@ class SolveService:
                       "quota": "serving.shed.quota",
                       "deadline": "serving.shed.deadline"}
 
-    def _shed(self, t: ServiceTicket, reason: str):
+    def _shed(self, t: ServiceTicket, reason: str,
+              estimate_s: Optional[float] = None,
+              deadline_s: Optional[float] = None):
         """Complete without solving: OVERLOADED + the initial iterate
         (the early honest rejection — admitted work keeps its deadline
-        promise, unserviceable work finds out immediately)."""
+        promise, unserviceable work finds out immediately). The
+        decision is auditable: an instant span on the request's flow
+        chain and a flight-recorder event carrying the feasibility
+        estimate it was made on."""
         x = t.x0 if t.x0 is not None else np.zeros_like(t.b)
         _tm.inc("serving.rejected")
         _tm.inc(self._SHED_COUNTERS[reason])
+        self._tmark("serving.shed", trace=t.trace_id, reason=reason,
+                    estimate_s=estimate_s)
+        self._fr_enqueue("shed", trace=t.trace_id, reason=reason,
+                         tenant=t.tenant,
+                         estimate_s=None if estimate_s is None
+                         else round(float(estimate_s), 6),
+                         deadline_s=None if deadline_s is None
+                         else round(float(deadline_s), 6),
+                         queue_depth=len(self._queue))
         tt = self._tenant(t.tenant)
         tt["rejected"] += 1
         tt["shed"] += 1
@@ -409,6 +521,8 @@ class SolveService:
         _tm.inc("serving.rejected")
         _tm.inc("serving.deadline_miss")
         _tm.inc("serving.deadline_action.reject")
+        self._fr_enqueue("deadline.miss", trace=t.trace_id,
+                         tenant=t.tenant, where="queued")
         tt = self._tenant(t.tenant)
         tt["rejected"] += 1
         tt["deadline_miss"] += 1
@@ -422,6 +536,12 @@ class SolveService:
         self._tenant(t.tenant)["completed"] += 1
         self._completed_total += 1
         t._complete(result)
+        # the flow chain's terminal anchor: finalize/complete, tagged
+        # with the trace id minted at submit (or restored from the
+        # journal — linking both incarnations' spans)
+        self._tmark("serving.complete", trace=t.trace_id,
+                    status=getattr(result, "status", None),
+                    iterations=int(result.iterations))
         if t.request_key:
             self._keyed.pop(t.request_key, None)
         # per-tenant solve-latency distribution: recorded for EVERY
@@ -445,14 +565,21 @@ class SolveService:
     def _fail_ticket(self, t: ServiceTicket, err: Exception):
         """Complete a ticket whose bucket build or admission raised:
         BREAKDOWN status + the exception on ticket.error — never a
-        wedged queue or a scheduler-killing raise."""
+        wedged queue or a scheduler-killing raise. The flight
+        recorder's last-N events dump through the output callback:
+        a BREAKDOWN is exactly the moment the event trail leading up
+        to it is worth reading."""
         t.error = err
         _tm.inc("serving.rejected")
         self._tenant(t.tenant)["rejected"] += 1
+        self._fr_enqueue("ticket.breakdown", trace=t.trace_id,
+                         tenant=t.tenant, error=str(err)[:160])
         self._finish(t, SolveResult(
             x=np.zeros_like(t.b), iterations=0, converged=False,
             res_norm=np.asarray(np.inf), norm0=np.asarray(np.inf),
             status_code=int(SolveStatus.BREAKDOWN)))
+        if self._fr_dump_reason is None:   # first failure names the dump
+            self._fr_dump_reason = f"BREAKDOWN: {str(err)[:80]}"
 
     # -- crash recovery ----------------------------------------------------
     def recover(self) -> int:
@@ -479,10 +606,23 @@ class SolveService:
                 fingerprint=meta["fingerprint"], submit_t=now,
                 deadline_t=None if remaining is None
                 else now + float(remaining),
-                request_key=meta.get("key"))
+                request_key=meta.get("key"),
+                # the ORIGINAL trace id, persisted at submit: this
+                # incarnation's spans join the dead process's flow
+                # chain instead of starting an orphan one. Gated on
+                # THIS incarnation's knob: a serving_tracing=0
+                # successor must keep its pre-tracing span set even
+                # for requests a tracing predecessor journaled
+                trace_id=(meta.get("trace") or _spans.new_trace_id())
+                if self.tracing else None,
+                _perf_submit=time.perf_counter())
             t.journal_id = meta["id"]
             t.resume_state = state
             _tm.inc("serving.recovery.replayed")
+            self._tmark("serving.resume", trace=t.trace_id,
+                        journal_id=t.journal_id,
+                        checkpointed=state is not None)
+            t._perf_submit = time.perf_counter()
             with self._lock:
                 self._tenant(t.tenant)["submitted"] += 1
                 if t.request_key:
@@ -519,7 +659,16 @@ class SolveService:
         from ..profiling import trace_region
         with self._lock:
             busy = [self.buckets.peek(k) for k in self.buckets.keys()]
-        with trace_region("serving.checkpoint"):
+        ck_tickets = [
+            eng.occupant[j]
+            for eng in busy if eng is not None and not eng.idle
+            for j in range(eng.slots)
+            if eng.occupant[j] is not None
+            and getattr(eng.occupant[j], "journal_id", None) is not None]
+        ck_traces = self._trace_list(ck_tickets)
+        with trace_region("serving.checkpoint",
+                          args={"traces": ck_traces}
+                          if ck_traces else None):
             for eng in busy:
                 if eng is None or eng.idle:
                     continue
@@ -569,6 +718,8 @@ class SolveService:
         """Build failed (lock held): reject the fingerprint's queued
         tickets, or leave them queued behind a bounded backoff."""
         action = self._fault_action(fp, "BUILD_FAILED")
+        self._fr_enqueue("bucket.build_failed", fingerprint=fp[:24],
+                         action=action, error=str(err)[:160])
         if action == "reject":
             self._faulted.pop(fp, None)
             still = []
@@ -581,6 +732,9 @@ class SolveService:
             self._queue = still
         else:
             _tm.inc("serving.recovery.build_retries")
+            self._fr_enqueue("bucket.build_retry", fingerprint=fp[:24],
+                             attempts=int(self._faulted.get(
+                                 fp, {}).get("attempts", 0)))
 
     def _quarantine(self, key: str, eng: BucketEngine, err, event: str,
                     completed: List[ServiceTicket]):
@@ -597,6 +751,11 @@ class SolveService:
         a half-quarantined engine as admittable)."""
         from ..profiling import trace_region
         _tm.inc("serving.recovery.quarantined")
+        self._fr_enqueue("bucket.quarantine", fingerprint=key[:24],
+                         event=event, error=None if err is None
+                         else str(err)[:160],
+                         inflight=sum(1 for o in eng.occupant
+                                      if o is not None))
         with trace_region("serving.quarantine"):
             occupied = [j for j in range(eng.slots)
                         if eng.occupant[j] is not None]
@@ -618,6 +777,8 @@ class SolveService:
                 eng.occupant[j] = None
                 if j in results:
                     _tm.inc("serving.recovery.salvaged")
+                    self._fr_enqueue("slot.salvage", trace=t.trace_id,
+                                     fingerprint=key[:24], slot=j)
                     self._finish(t, results[j])
                     completed.append(t)
                     continue
@@ -625,6 +786,9 @@ class SolveService:
                     t.resume_state = rows[j]
                 t.admit_t = None
                 _tm.inc("serving.recovery.requeued")
+                self._fr_enqueue("slot.requeue", trace=t.trace_id,
+                                 fingerprint=key[:24], slot=j,
+                                 has_state=rows is not None)
                 requeue_tickets.append(t)
             self.buckets.pop(key)
             self._progress.pop(key, None)
@@ -642,11 +806,23 @@ class SolveService:
 
     # -- scheduling --------------------------------------------------------
     def _build_engine(self, t: ServiceTicket) -> BucketEngine:
-        return BucketEngine(
-            self.cfg, self.scope, t.A, slots=self.slots,
-            chunk=self.chunk, dtype=t.b.dtype,
-            fingerprint=t.fingerprint, aot=self.aot,
-            hstore=self.hstore)
+        """One bucket build, wrapped in a serving.build span tagged
+        with the TRIGGERING ticket's trace (the build serves every
+        same-fingerprint ticket, but the oldest unserved one caused
+        it) and logged on the flight recorder."""
+        with self._tspan("serving.build", trace=t.trace_id,
+                         fingerprint=t.fingerprint[:24]):
+            eng = BucketEngine(
+                self.cfg, self.scope, t.A, slots=self.slots,
+                chunk=self.chunk, dtype=t.b.dtype,
+                fingerprint=t.fingerprint, aot=self.aot,
+                hstore=self.hstore)
+        _fr.record("bucket.build", trace=t.trace_id,
+                   fingerprint=t.fingerprint[:24],
+                   wall_s=round(eng.build_time, 4),
+                   aot_warm=eng.aot_warm,
+                   hier_restored=eng.hier_restored)
+        return eng
 
     def _builder(self, t: ServiceTicket):
         """Builder-thread body: one bucket build off the scheduler
@@ -789,6 +965,21 @@ class SolveService:
                 _tm.observe("serving.queue_wait_s",
                             t.admit_t - t.submit_t,
                             labels={"tenant": t.tenant})
+                if self.tracing and t.trace_id:
+                    # the queue wait, recorded retroactively now that
+                    # it is known — the flow chain's submit->admit gap
+                    # becomes a visible slice instead of dead air. On
+                    # a synthetic per-request lane: on this scheduler
+                    # thread's real track it would partially overlap
+                    # the open cycle slices (same-track slices must
+                    # nest in the Chrome trace format)
+                    pnow = time.perf_counter()
+                    _spans.record_span(
+                        "serving.queue", t._perf_submit,
+                        max(0.0, pnow - t._perf_submit),
+                        args={"trace": t.trace_id,
+                              "tenant": t.tenant},
+                        tid=_spans.trace_track(t.trace_id))
                 eng.occupant[slot] = t      # reservation
                 admissions.append((eng, slot, t))
             self._queue = remaining
@@ -889,6 +1080,10 @@ class SolveService:
                     eng.release(j)
                     res = results[j]
                     _tm.inc("serving.deadline_miss")
+                    self._fr_enqueue(
+                        "deadline.miss", trace=t.trace_id,
+                        tenant=t.tenant, where="inflight",
+                        action=self.deadline_action)
                     self._tenant(t.tenant)["deadline_miss"] += 1
                     res.converged = False
                     res.status_code = int(
@@ -905,8 +1100,10 @@ class SolveService:
             self.buckets.evict_to_budget()
             _tm.set_gauge("serving.queue_depth", len(self._queue))
             _tm.set_gauge("serving.inflight", self._inflight())
-        # 8. journal completions + checkpoint cadence + periodic prune
-        # (device pulls + file IO, all outside the lock)
+        # 8. journal completions + flight events + checkpoint cadence
+        # + periodic prune (device pulls + file IO, all outside the
+        # lock)
+        self._flush_flightrec()
         self._flush_journal_done()
         if self.journal is not None and self.ckpt_cycles > 0 \
                 and self._cycle % self.ckpt_cycles == 0:

@@ -21,6 +21,14 @@ solve tables; ours is structured and machine-readable):
   `profiling.trace_region`, exported as Chrome/Perfetto trace-event
   JSON (`spans.export_chrome_trace`); `telemetry_sync=1` fences device
   work at span boundaries so host spans bound device occupancy.
+- `telemetry.flightrec` — crash-surviving flight recorder: a bounded
+  append-and-rotate structured event log of state transitions (bucket
+  builds/quarantines/requeues, shed decisions with their feasibility
+  estimate, fallback-chain hops, resetup routing, chaos injections),
+  each stamped with the request trace id; on a BREAKDOWN the serving
+  layer dumps the last-N events through output.py, and
+  `tools/flightrec.py` pretty-prints + journal-correlates a log for
+  postmortems.
 - `telemetry.report` — `SolveReport`: in-trace solve metrics (riding
   the monitor's packed stats array at zero added device->host syncs)
   plus static per-level kernel-activity metadata, attached to
@@ -35,5 +43,5 @@ way, so `telemetry=0` and `telemetry=1` compile identical XLA).
 """
 from __future__ import annotations
 
-from . import diagnostics, metrics, spans  # noqa: F401
+from . import diagnostics, flightrec, metrics, spans  # noqa: F401
 from .report import SolveReport, build_report, validate_report  # noqa: F401

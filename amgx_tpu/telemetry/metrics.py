@@ -39,10 +39,18 @@ distributed/solver.py), and device-memory watermarks per phase
 from __future__ import annotations
 
 import bisect
+import os
 import threading
 from typing import Dict, Optional, Tuple, Union
 
 _lock = threading.Lock()
+# fleet identity: a replica/shard label stamped on EVERY OpenMetrics
+# sample so multi-replica scrapes don't collide (the ROADMAP-3a fleet
+# prerequisite). Sources, later wins: AMGX_REPLICA_ID env (read once,
+# lazily) then the serving_replica_id config knob (SolveService
+# construction calls set_replica_label)
+_replica: Optional[str] = None
+_replica_env_checked = False
 _counters: Dict[str, int] = {}
 _gauges: Dict[str, float] = {}
 # (name, sorted-label-items tuple) -> {"counts": [..], "sum": ., "count": .}
@@ -292,7 +300,31 @@ def _om_num(v) -> str:
     return repr(v)
 
 
+def set_replica_label(replica: Optional[str]):
+    """Set (or clear, with None/'') the replica label every
+    OpenMetrics sample carries as `replica="..."`. Process-wide, like
+    the registry itself: one serving replica = one process."""
+    global _replica, _replica_env_checked
+    _replica_env_checked = True
+    _replica = str(replica) if replica else None
+
+
+def replica_label() -> Optional[str]:
+    """The active replica label (AMGX_REPLICA_ID env read lazily once;
+    an explicit set_replica_label overrides either way)."""
+    global _replica, _replica_env_checked
+    if not _replica_env_checked:
+        _replica_env_checked = True
+        env = os.environ.get("AMGX_REPLICA_ID", "").strip()
+        if env:
+            _replica = env
+    return _replica
+
+
 def _om_labels(items) -> str:
+    rep = replica_label()
+    if rep is not None and not any(k == "replica" for k, _v in items):
+        items = (("replica", rep),) + tuple(items)
     if not items:
         return ""
     return "{" + ",".join(
@@ -306,21 +338,25 @@ def to_openmetrics() -> str:
     `_bucket{le=...}` + `_sum`/`_count` per histogram label set, and
     the mandatory `# EOF` terminator. Declared-but-untouched counters
     and histograms expose zeros (stable scrape shape); unsampled
-    gauges are omitted (a gauge has no meaningful zero)."""
+    gauges are omitted (a gauge has no meaningful zero). When a
+    replica label is configured (`AMGX_REPLICA_ID` env or the
+    serving_replica_id knob via set_replica_label), EVERY sample
+    carries `replica="..."` so multi-replica scrapes never collide."""
     lines = []
     with _lock:
         for name in sorted(COUNTERS):
             om = _om_name(name)
             lines.append(f"# HELP {om} {_om_escape(COUNTERS[name])}")
             lines.append(f"# TYPE {om} counter")
-            lines.append(f"{om}_total {_om_num(_counters.get(name, 0))}")
+            lines.append(f"{om}_total{_om_labels(())} "
+                         f"{_om_num(_counters.get(name, 0))}")
         for name in sorted(GAUGES):
             if name not in _gauges:
                 continue
             om = _om_name(name)
             lines.append(f"# HELP {om} {_om_escape(GAUGES[name])}")
             lines.append(f"# TYPE {om} gauge")
-            lines.append(f"{om} {_om_num(_gauges[name])}")
+            lines.append(f"{om}{_om_labels(())} {_om_num(_gauges[name])}")
         for name in sorted(HISTOGRAMS):
             om = _om_name(name)
             edges = HISTOGRAM_EDGES[name]
@@ -542,6 +578,51 @@ declare_histogram("serving.exec_s",
                   "in-bucket half of solve latency — what the shed "
                   "policy's deadline-feasibility estimate reads",
                   _LATENCY_EDGES_S)
+
+# distributed comms/shard telemetry (distributed/comms.py records at
+# TRACE time — collectives are emitted by the traced program, so the
+# honest countable event is the traced exchange SITE; bytes are the
+# MODELED per-direction window sizes of that site, exact by
+# construction from the partition metadata, not measured wire traffic)
+declare_counter("dist.exchange.calls",
+                "halo/edge exchange sites traced (all modes; one per "
+                "exchange site per traced program, NOT per executed "
+                "iteration)")
+declare_counter("dist.exchange.ring",
+                "ring-mode halo exchange sites traced (two ppermutes "
+                "per site)")
+declare_counter("dist.exchange.a2a",
+                "all-to-all-mode halo exchange sites traced")
+declare_counter("dist.exchange.gather",
+                "all-gather-mode halo exchange sites traced (the "
+                "dense-boundary fallback)")
+declare_counter("dist.exchange.edge_fused",
+                "packed edge-window exchange sites traced by the "
+                "halo-folded fused path (distributed/fused.py: one "
+                "collective per fused smoother call)")
+declare_counter("dist.comms.bytes_fwd",
+                "modeled bytes shipped FORWARD (toward rank+1) per "
+                "traced exchange site, summed over the whole mesh "
+                "(per-hop window elements x itemsize x sending ranks)")
+declare_counter("dist.comms.bytes_bwd",
+                "modeled bytes shipped BACKWARD (toward rank-1) per "
+                "traced exchange site, summed over the whole mesh")
+declare_gauge("dist.shard.rows_imbalance",
+              "per-shard row imbalance of the live partition "
+              "(max rows over mean rows; 1.0 = perfectly balanced)")
+declare_gauge("dist.shard.nnz_imbalance",
+              "per-shard nonzero imbalance of the live partition "
+              "(max nnz over mean nnz) — the load-balance number the "
+              "per-chip-throughput gate attribution needs")
+
+# flight recorder (telemetry/flightrec.py)
+declare_counter("flightrec.events",
+                "flight-recorder events recorded (state transitions: "
+                "builds, quarantines, sheds, fallback hops, resetup "
+                "routing, chaos injections)")
+declare_counter("flightrec.dropped",
+                "corrupt flight-recorder lines dropped at read "
+                "(torn-write tolerance; the postmortem never wedges)")
 
 # device-memory watermarks per phase (memory_info allocator statistics
 # sampled at phase boundaries; the backend's own peak_bytes_in_use is

@@ -23,16 +23,32 @@ debugging mode, not a production default.
 trace-event JSON ("X" complete events, microseconds), loadable by
 Perfetto / chrome://tracing — the host-side timeline that sits next to
 the device timeline `profiling.start_trace` captures via jax.profiler.
+
+REQUEST TRACING: spans (and instant `mark()` events) accept an `args`
+dict; an args entry `trace=<id>` (or `traces=[ids]` for batched
+stages touching several requests) tags the event with a request trace
+id (`new_trace_id()`; serving mints one per ServiceTicket). The
+export turns each trace id's tagged events into a Perfetto FLOW — a
+connected s→t→…→f arrow chain through the tagged slices — so one
+request's submit→queue→build→admit→chunk-cycles→checkpoint→finalize
+path reads as a single arrow chain in the trace viewer, across
+threads and (because the serving journal persists trace ids) across
+service incarnations when a crash-recovered resume re-tags the
+original id. `record_span()` records a span retroactively with
+explicit timing (queue waits measured between submit and admission;
+per-shard synthetic tracks use its `tid` override).
 """
 from __future__ import annotations
 
 import contextlib
 import fnmatch
+import hashlib
+import itertools
 import json
 import os
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 # ---------------------------------------------------------------------------
 # span-name registry
@@ -86,6 +102,21 @@ DECLARED_SPANS: Tuple[str, ...] = (
     "serving.quarantine",
     "serving.hstore_save",
     "serving.hstore_load",
+    # request-path tracing (serving_tracing knob): per-ticket
+    # lifecycle stages tagged with the ticket's trace id — submit
+    # bookkeeping, shed decisions (instant), the retroactive queue
+    # wait, the build the candidate ticket triggered, journal-replay
+    # resume, and the terminal completion (instant; the flow chain's
+    # last anchor)
+    "serving.submit",
+    "serving.shed",
+    "serving.queue",
+    "serving.build",
+    "serving.resume",
+    "serving.complete",
+    # distributed comms/shard telemetry: one synthetic track per
+    # shard in the Perfetto export (record_span with a per-shard tid)
+    "shard.solve",
     # solver-tree entry points (dynamic solver names: CG.solve, ...).
     # NO catch-all patterns belong here: a `<anything>.*` entry would
     # let any typo'd two-segment name pass the static registry check
@@ -159,11 +190,15 @@ def _stack() -> list:
 
 
 @contextlib.contextmanager
-def span(name: str, annotate: bool = True):
+def span(name: str, annotate: bool = True,
+         args: Optional[Dict[str, Any]] = None):
     """Record one hierarchical span (and accumulate the flat timer).
     With annotate=True the region is also a jax.profiler
     TraceAnnotation, so it shows up in captured device profiles — the
-    nvtxRange analog `profiling.trace_region` has always been."""
+    nvtxRange analog `profiling.trace_region` has always been.
+    `args` attaches extra key/values to the exported event; a
+    `trace`/`traces` entry additionally enrolls the span in that
+    request's Perfetto flow chain (module docs)."""
     if _sync:
         _fence()
     stack = _stack()
@@ -189,12 +224,67 @@ def span(name: str, annotate: bool = True):
         rec = {"name": name, "ts": t_start - _t0, "dur": dt,
                "depth": len(stack), "parent": parent,
                "tid": threading.get_ident()}
-        with _lock:
-            _records.append(rec)
-            if len(_records) > _MAX_RECORDS:
-                del _records[: _MAX_RECORDS // 2]
-            calls, tot = _flat.get(name, (0, 0.0))
-            _flat[name] = (calls + 1, tot + dt)
+        if args:
+            rec["args"] = dict(args)
+        _commit(rec, name, dt)
+
+
+def _commit(rec: dict, name: str, dt: float):
+    with _lock:
+        _records.append(rec)
+        if len(_records) > _MAX_RECORDS:
+            del _records[: _MAX_RECORDS // 2]
+        calls, tot = _flat.get(name, (0, 0.0))
+        _flat[name] = (calls + 1, tot + dt)
+
+
+def mark(name: str, args: Optional[Dict[str, Any]] = None):
+    """Record one INSTANT event (zero-duration; exported as a Chrome
+    'i' event) — lifecycle points like a shed decision or a request's
+    terminal completion, where a span would be noise. Shares the span
+    registry (check_spans lints mark names too) and the flow-chain
+    tagging via args."""
+    stack = _stack()
+    rec = {"name": name, "ts": time.perf_counter() - _t0, "dur": 0.0,
+           "depth": len(stack), "parent": stack[-1] if stack else None,
+           "tid": threading.get_ident(), "ph": "i"}
+    if args:
+        rec["args"] = dict(args)
+    _commit(rec, name, 0.0)
+
+
+def record_span(name: str, t_start: float, dur: float,
+                args: Optional[Dict[str, Any]] = None,
+                tid: Optional[int] = None):
+    """Record a span RETROACTIVELY with explicit timing: `t_start` in
+    time.perf_counter() units, `dur` in seconds. Used for intervals
+    only known after the fact (a ticket's queue wait, measured when it
+    is admitted) and — via the `tid` override — for synthetic tracks
+    (one Perfetto track per shard: the per-shard tallies of a
+    distributed solve). Flat-timer accounting matches span()."""
+    rec = {"name": name, "ts": t_start - _t0, "dur": float(dur),
+           "depth": 0, "parent": None,
+           "tid": int(tid) if tid is not None else threading.get_ident()}
+    if args:
+        rec["args"] = dict(args)
+    _commit(rec, name, float(dur))
+
+
+# ---------------------------------------------------------------------------
+# request trace ids
+# ---------------------------------------------------------------------------
+
+_trace_seq = itertools.count(1)
+
+
+def new_trace_id() -> str:
+    """Mint a process-unique request trace id (pid + monotone counter
+    + a coarse time suffix so ids stay distinct across process
+    restarts — the successor of a crashed service mints fresh ids for
+    new work while journal-replayed requests keep their ORIGINAL id,
+    which is what links their spans across incarnations)."""
+    return (f"{os.getpid():x}-{next(_trace_seq):x}-"
+            f"{int(time.time() * 1e3) & 0xFFFFFF:x}")
 
 
 def records() -> List[dict]:
@@ -233,25 +323,96 @@ def reset():
 # ---------------------------------------------------------------------------
 
 
+def _flow_id(trace: str) -> int:
+    """Stable positive int flow id for a request trace id (Chrome
+    flow events bind on (cat, name, id); the id must survive export
+    across processes, so it is a digest, not an enumeration)."""
+    return int.from_bytes(
+        hashlib.blake2b(str(trace).encode(), digest_size=6).digest(),
+        "big")
+
+
+def trace_track(trace: str, base: int = 2_000_000) -> int:
+    """Synthetic per-request track id for RETROACTIVE request-lane
+    spans (the serving.queue wait): recorded on the admitting
+    scheduler thread's real tid they would partially overlap its open
+    cycle slices, which the Chrome trace format forbids (same-track
+    slices must nest). One derived track per trace id keeps every
+    request's lane self-consistent; a digest collision between two
+    concurrent requests costs only a cosmetic overlap on a synthetic
+    lane, never a corrupt scheduler track."""
+    return base + _flow_id(str(trace)) % 1_000_000
+
+
 def chrome_trace_events() -> List[dict]:
-    """The recorded spans as Chrome trace-event 'X' (complete) events:
-    ts/dur in microseconds from the trace epoch, one track per host
-    thread. Nesting is positional (Perfetto stacks overlapping events
-    on a track), so parent linkage needs no explicit ids."""
+    """The recorded spans as Chrome trace-event events — 'X' complete
+    slices (instant marks as 'i') with ts/dur in microseconds from the
+    trace epoch, one track per host thread. Nesting is positional
+    (Perfetto stacks overlapping events on a track), so parent linkage
+    needs no explicit ids.
+
+    Events whose args carry a request trace id (`trace=<id>` /
+    `traces=[ids]`) additionally yield Perfetto FLOW events: per trace
+    id, the tagged events sorted by start time become one s→t→…→f
+    chain, each flow anchor emitted at its slice's start on the same
+    pid/tid so it binds to that slice — the single connected arrow
+    chain per request the serving layer's tracing promises. Flow
+    anchors only bind to SLICES, so a trace-tagged instant mark (a
+    shed decision, the terminal serving.complete) exports as a
+    1-microsecond 'X' slice instead of an unbindable 'i' event —
+    untagged marks stay true instants."""
     evs = []
+    flows: Dict[str, List[Tuple[float, int, int]]] = {}
     for r in records():
-        evs.append({
+        args = {"depth": r["depth"], "parent": r["parent"]}
+        extra = r.get("args") or {}
+        args.update(extra)
+        ph = r.get("ph", "X")
+        tr = extra.get("trace")
+        tagged = ([tr] if tr else []) + [
+            t for t in (extra.get("traces") or ()) if t]
+        if ph == "i" and tagged:
+            ph = "X"                 # bindable micro-slice (see docs)
+        ev = {
             "name": r["name"],
             "cat": (ACCOUNTED_PREFIX.rstrip(".")
                     if r["name"].startswith(ACCOUNTED_PREFIX)
                     else r["name"].split(".", 1)[0]),
-            "ph": "X",
+            "ph": ph,
             "ts": round(r["ts"] * 1e6, 3),
-            "dur": round(r["dur"] * 1e6, 3),
+            "dur": max(round(r["dur"] * 1e6, 3),
+                       1.0 if tagged else 0.0),
             "pid": os.getpid(),
             "tid": r["tid"],
-            "args": {"depth": r["depth"], "parent": r["parent"]},
-        })
+            "args": args,
+        }
+        if ph == "i":
+            ev["s"] = "t"            # thread-scoped instant
+            del ev["dur"]
+        evs.append(ev)
+        for t in tagged:
+            flows.setdefault(str(t), []).append(
+                (ev["ts"], ev["pid"], ev["tid"]))
+    for trace, anchors in flows.items():
+        if len(anchors) < 2:
+            continue                 # nothing to connect
+        anchors.sort()
+        fid = _flow_id(trace)
+        last = len(anchors) - 1
+        for i, (ts, pid, tid) in enumerate(anchors):
+            fe = {
+                "name": "request",
+                "cat": "trace.flow",
+                "ph": "s" if i == 0 else ("f" if i == last else "t"),
+                "id": fid,
+                "ts": ts,
+                "pid": pid,
+                "tid": tid,
+                "args": {"trace": trace},
+            }
+            if fe["ph"] == "f":
+                fe["bp"] = "e"       # bind to the ENCLOSING slice
+            evs.append(fe)
     return evs
 
 

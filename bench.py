@@ -854,6 +854,7 @@ def bench_chaos(n: int = 16, smoke: bool = False):
     from amgx_tpu.resilience import faultinject as fi
     from amgx_tpu.resilience.status import SolveStatus
     from amgx_tpu.serving import SolveService
+    from amgx_tpu.telemetry import flightrec as _frec
     from amgx_tpu.telemetry import metrics as _tm
 
     if smoke:
@@ -923,15 +924,26 @@ def bench_chaos(n: int = 16, smoke: bool = False):
     def terminal(tickets, svc):
         return bool(all(t.done for t in tickets) and svc.idle)
 
+    def fr_cause(kind, since):
+        """The flight-recorder postmortem contract per scenario: the
+        LAST chaos event recorded since the scenario started names
+        the injected fault — the event trail explains what hit the
+        service, not merely that something did."""
+        chaos = _frec.events(kind="chaos", since_seq=since)
+        return bool(chaos) and chaos[-1].get("fault") == kind
+
     # builder crash -> bounded backoff retry -> converges
+    seq0 = _frec.last_seq()
     svc = svc_new("serving_fault_policy=BUILD_FAILED>retry_backoff,"
                   " serving_retry_backoff_s=0.01")
     with fi.inject("build_crash", fires=1):
         ts = [svc.submit(A, bs[0])]
         svc.drain(timeout_s=600)
     scen_ok["builder_crash"] = terminal(ts, svc) and \
-        ts[0].result.converged
+        ts[0].result.converged and fr_cause("build_crash", seq0) and \
+        bool(_frec.events(kind="bucket.build_failed", since_seq=seq0))
     # device-step exception -> quarantine -> requeue -> rebuilt bucket
+    seq0 = _frec.last_seq()
     svc = svc_new()
     ts = [svc.submit(A, b) for b in bs[:2]]
     svc.step()
@@ -939,8 +951,11 @@ def bench_chaos(n: int = 16, smoke: bool = False):
         svc.step()
     svc.drain(timeout_s=600)
     scen_ok["step_crash"] = terminal(ts, svc) and \
-        all(t.result.converged for t in ts)
+        all(t.result.converged for t in ts) and \
+        fr_cause("step_crash", seq0) and \
+        bool(_frec.events(kind="bucket.quarantine", since_seq=seq0))
     # wedged bucket -> heartbeat supervisor quarantine
+    seq0 = _frec.last_seq()
     svc = svc_new("serving_supervisor_cycles=2")
     ts = [svc.submit(A, bs[0])]
     svc.step()
@@ -948,8 +963,10 @@ def bench_chaos(n: int = 16, smoke: bool = False):
         for _ in range(6):
             svc.step()
     svc.drain(timeout_s=600)
-    scen_ok["wedged_bucket"] = terminal(ts, svc)
+    scen_ok["wedged_bucket"] = terminal(ts, svc) and \
+        fr_cause("step_wedge", seq0)
     # journal torn write -> dropped at replay, successor keeps serving
+    seq0 = _frec.last_seq()
     jd2 = tempfile.mkdtemp(prefix="amgx_chaos_j2_")
     svc = svc_new(f"serving_journal_dir={jd2}")
     with fi.inject("journal_corrupt", fires=1):
@@ -959,25 +976,29 @@ def bench_chaos(n: int = 16, smoke: bool = False):
     ts = [svc.submit(A, bs[1])]
     svc.drain(timeout_s=600)
     scen_ok["journal_corrupt"] = terminal(ts, svc) and \
-        ts[0].result.converged
+        ts[0].result.converged and fr_cause("journal_corrupt", seq0)
     # AOT-store torn write -> load fails -> degrades to retracing
+    seq0 = _frec.last_seq()
     ad2 = tempfile.mkdtemp(prefix="amgx_chaos_a2_")
     with fi.inject("aot_corrupt", fires=None):
         svc = svc_new(f"serving_aot_dir={ad2}")
         svc.submit(A, bs[0])
         svc.drain(timeout_s=600)
+    scen_aot_cause = fr_cause("aot_corrupt", seq0)
     svc = svc_new(f"serving_aot_dir={ad2}")
     ts = [svc.submit(A, bs[1])]
     svc.drain(timeout_s=600)
     scen_ok["aot_corrupt"] = terminal(ts, svc) and \
-        ts[0].result.converged
+        ts[0].result.converged and scen_aot_cause
     # clock skew: deadline bookkeeping under a shifted clock
+    seq0 = _frec.last_seq()
     with fi.inject("clock_skew", value=300.0, fires=None):
         svc = svc_new()
         ts = [svc.submit(A, bs[0], deadline_s=1e9),
               svc.submit(A, bs[1])]
         svc.drain(timeout_s=600)
-    scen_ok["clock_skew"] = terminal(ts, svc)
+    scen_ok["clock_skew"] = terminal(ts, svc) and \
+        fr_cause("clock_skew", seq0)
     out["chaos_scenarios"] = scen_ok
     out["chaos_all_terminal"] = bool(all(scen_ok.values()))
 
@@ -1260,6 +1281,73 @@ def bench_obs(n_flagship: int = 128, n_classical: int = 64,
     except Exception as e:  # pragma: no cover - bench robustness
         out["perfetto_valid"] = False
         out["perfetto_error"] = str(e)[:120]
+
+    # ---- serving tracing replay ---------------------------------------
+    # request-path tracing (serving_tracing) on vs off over the SAME
+    # serving load: the per-ticket lifecycle spans + flow tagging are
+    # host-side dict appends, so the paired-median per-request cost
+    # must stay within 2%. Runs AFTER the full-timeline export above,
+    # and resets the span buffer post-warmup, so BENCH_obs_requests
+    # carries ONLY the burst's request chains — a per-request
+    # artifact, not a second copy of the whole solver timeline.
+    try:
+        from amgx_tpu.presets import SERVING_CG
+        from amgx_tpu.serving import SolveService
+
+        ns = 20
+        As = amgx.gallery.poisson("7pt", ns, ns, ns).init()
+        rng = np.random.default_rng(11)
+        bsrv = [rng.standard_normal(As.num_rows) for _ in range(6)]
+
+        def _svc(tracing):
+            return SolveService(Config.from_string(
+                SERVING_CG + ", serving_bucket_slots=4,"
+                f" serving_chunk_iters=8, serving_tracing={tracing}"))
+
+        svc_on, svc_off = _svc(1), _svc(0)
+        for svc in (svc_on, svc_off):     # build bucket + warm traces
+            for b_ in bsrv[:4]:
+                svc.submit(As, b_)
+            svc.drain(timeout_s=300)
+
+        def _burst(svc):
+            t0 = time.perf_counter()
+            ts = [svc.submit(As, b_) for b_ in bsrv]
+            svc.drain(timeout_s=300)
+            assert all(t.done and t.result.converged for t in ts)
+            return (time.perf_counter() - t0) / len(bsrv)
+
+        spans.reset()       # requests-only artifact from here on
+        tr_ratios = []
+        for _ in range(reps):
+            tr_ratios.append(_burst(svc_on) / _burst(svc_off))
+        tr_ratios.sort()
+        out["serving_trace_overhead_pct"] = round(
+            100.0 * (tr_ratios[len(tr_ratios) // 2] - 1.0), 2)
+        out["serving_trace_overhead_pair_spread"] = [
+            round(100.0 * (tr_ratios[0] - 1.0), 2),
+            round(100.0 * (tr_ratios[-1] - 1.0), 2)]
+        out["serving_trace_ok"] = bool(
+            abs(out["serving_trace_overhead_pct"]) <= 2.0)
+        req_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_obs_requests.json")
+        out["serving_trace_events"] = spans.export_chrome_trace(
+            req_path)
+        with open(req_path) as f:
+            reqdoc = json.load(f)
+        flows = [e for e in reqdoc["traceEvents"]
+                 if e.get("cat") == "trace.flow"]
+        starts = sum(1 for e in flows if e["ph"] == "s")
+        out["serving_trace_flow_events"] = len(flows)
+        out["serving_trace_flow_chains"] = starts
+        out["serving_trace_artifact"] = os.path.basename(req_path)
+        # every traced burst request must have minted a flow chain
+        out["serving_trace_flows_ok"] = bool(
+            starts >= len(bsrv) and len(flows) > 2 * starts)
+    except Exception as e:  # pragma: no cover - bench robustness
+        out["serving_trace_error"] = str(e)[:200]
+        out["serving_trace_ok"] = False
     return out
 
 
@@ -1532,6 +1620,11 @@ def main():
             extra["obs_diagnostics_ok"] = obs.get("diagnostics_ok")
             extra["obs_diagnostics_bottleneck_level"] = \
                 obs.get("diagnostics_bottleneck_level")
+            extra["serving_trace_overhead_pct"] = \
+                obs.get("serving_trace_overhead_pct")
+            extra["serving_trace_ok"] = obs.get("serving_trace_ok")
+            extra["serving_trace_flow_chains"] = \
+                obs.get("serving_trace_flow_chains")
         finally:
             signal.alarm(0)
             signal.signal(signal.SIGALRM, old)

@@ -15,6 +15,11 @@ faults (resilience/faultinject.py service kinds):
    quarantined by the supervisor and its work requeued.
 4. OVERLOAD SHED — a burst beyond what the deadline allows is shed
    early with OVERLOADED (never a queued-then-missed surprise).
+5. POSTMORTEM — the crash-surviving flight recorder's event trail
+   (chaos injections, quarantines, shed decisions with their
+   feasibility estimates, resetup routing) read back the way
+   `tools/flightrec.py` would read a dead process's log, correlated
+   with the journal by request trace id.
 
 Run:  python examples/chaos_demo.py
 """
@@ -65,9 +70,12 @@ def main():
     successor = SolveService(Config.from_string(base_cfg + ", " + durable))
     done = successor.drain()
     t = done[0]
+    recovered_trace = t.trace_id
     same = np.array_equal(np.asarray(t.result.x), np.asarray(rt.result.x))
     print(f"   successor replayed the journal: {t.result.iterations} "
           f"iters, bit-identical={same}")
+    print(f"   trace id survived the crash: {t.trace_id == vt.trace_id} "
+          f"(both incarnations' spans share one Perfetto flow chain)")
     snap = metrics.snapshot()
     for k in ("serving.recovery.replayed", "serving.recovery.resumed",
               "serving.recovery.checkpoints", "amg.setup.restored",
@@ -113,6 +121,19 @@ def main():
     missed = sum(t.result.status == "deadline_exceeded" for t in burst)
     print(f"   burst of 8 at a 20ms deadline: shed={shed} "
           f"(OVERLOADED, immediate), admitted-but-missed={missed}")
+
+    # -- 5. postmortem: the flight recorder's event trail ----------------
+    print("== 5. postmortem: flight-recorder readout ==")
+    from amgx_tpu.telemetry import flightrec
+    for e in flightrec.events(last=12):
+        print("   " + flightrec.format_event(e))
+    # the journal correlation tools/flightrec.py runs on a DEAD
+    # process's directories: the trace id persisted at submit is the
+    # join key between the event trail and the journaled request
+    print(f"   (crash-recovered request's trace id: {recovered_trace}; "
+          f"run `python tools/flightrec.py <flightrec_dir> "
+          f"--journal {root}/journal` against a crashed service's "
+          f"directories for the full correlated view)")
     print("done.")
 
 

@@ -377,9 +377,17 @@ def test_distributed_report():
     assert res.converged
     rep = res.report
     assert rep is not None
-    assert rep.distributed == {
-        "n_ranks": 2, "axis": "p", "n_global": A.num_rows,
-        "rows_per_shard": A.num_rows // 2}
+    dist = rep.distributed
+    assert dist["n_ranks"] == 2 and dist["axis"] == "p"
+    assert dist["n_global"] == A.num_rows
+    assert dist["rows_per_shard"] == A.num_rows // 2
+    # comms/shard telemetry (ISSUE 13): the traced exchange-site table
+    # with modeled bytes, and the per-shard rows/nnz tallies
+    assert dist["comms"] and all(
+        e["mode"] == "ring" and e["bytes_fwd"] > 0
+        for e in dist["comms"])
+    assert dist["shards"]["rows"] == [A.num_rows // 2] * 2
+    assert dist["shards"]["rows_imbalance"] == 1.0
     assert validate_report(rep.to_dict()) == []
 
 

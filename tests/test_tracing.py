@@ -1,0 +1,528 @@
+"""Request-path tracing, comms/shard telemetry and the crash-surviving
+flight recorder (ISSUE 13).
+
+- spans.py extensions: span args, instant marks, retroactive spans,
+  trace-id minting, Perfetto flow-event export;
+- serving trace propagation: one flow chain per request across the
+  lifecycle stages, the journal persisting trace ids so a
+  submitted->crashed->recovered->finalized request yields ONE
+  connected chain across both service incarnations (the acceptance);
+- comms telemetry: modeled per-direction bytes matching hand-computed
+  halo window sizes EXACTLY on a 4-shard mesh, the report comms
+  table, shard-imbalance gauges;
+- flight recorder: append-and-rotate durability, corruption-tolerant
+  reads, the event sources (shed/quarantine/build/fallback/resetup/
+  chaos), the BREAKDOWN last-N dump through the output callback;
+- satellites: the OpenMetrics replica label and the check_spans
+  dead-metric contract."""
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import amgx_tpu as amgx
+from amgx_tpu import gallery
+from amgx_tpu.config import Config
+from amgx_tpu.presets import BATCHED_CG
+from amgx_tpu.resilience import faultinject
+from amgx_tpu.serving import SolveService
+from amgx_tpu.telemetry import flightrec, metrics, spans
+from amgx_tpu.telemetry.flightrec import FlightRecorder
+
+amgx.initialize()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def poisson16():
+    return gallery.poisson("5pt", 16, 16).init()
+
+
+def _svc_cfg(extra=""):
+    return Config.from_string(
+        BATCHED_CG + ", serving_bucket_slots=2, serving_chunk_iters=4"
+        + (", " + extra if extra else ""))
+
+
+def _rhs(A, seed=0):
+    return np.random.default_rng(seed).standard_normal(A.num_rows)
+
+
+def _flow_events(trace_id):
+    """The exported flow-chain events of one request trace, plus the
+    slice/mark events tagged with it, in export (time) order."""
+    evs = spans.chrome_trace_events()
+    flow = [e for e in evs if e.get("cat") == "trace.flow"
+            and e["args"].get("trace") == trace_id]
+    tagged = [e for e in evs if e.get("cat") != "trace.flow"
+              and (e["args"].get("trace") == trace_id
+                   or trace_id in (e["args"].get("traces") or ()))]
+    flow.sort(key=lambda e: e["ts"])
+    tagged.sort(key=lambda e: e["ts"])
+    return flow, tagged
+
+
+# ---------------------------------------------------------------------------
+# spans: args / marks / retroactive spans / flow export
+# ---------------------------------------------------------------------------
+
+
+def test_span_args_and_flow_export():
+    tr = spans.new_trace_id()
+    with spans.span("serving.submit", annotate=False,
+                    args={"trace": tr, "tenant": "acme"}):
+        pass
+    spans.mark("serving.complete", args={"trace": tr})
+    flow, tagged = _flow_events(tr)
+    assert [e["name"] for e in tagged] == ["serving.submit",
+                                           "serving.complete"]
+    assert tagged[0]["args"]["tenant"] == "acme"
+    # a two-anchor chain: one start, one finish, ids equal, each
+    # anchored at its slice's pid/tid so Perfetto binds them
+    assert [e["ph"] for e in flow] == ["s", "f"]
+    assert flow[0]["id"] == flow[1]["id"]
+    assert flow[1]["bp"] == "e"
+    for fe, sl in zip(flow, tagged):
+        assert (fe["pid"], fe["tid"]) == (sl["pid"], sl["tid"])
+        assert fe["ts"] == sl["ts"]
+
+
+def test_mark_is_instant_event():
+    spans.mark("serving.shed", args={"reason": "quota"})
+    ev = [e for e in spans.chrome_trace_events()
+          if e["name"] == "serving.shed"][-1]
+    assert ev["ph"] == "i" and ev["s"] == "t" and "dur" not in ev
+    assert ev["args"]["reason"] == "quota"
+
+
+def test_record_span_retroactive_and_tid_override():
+    import time
+    t0 = time.perf_counter() - 0.25
+    spans.record_span("shard.solve", t0, 0.125,
+                      args={"shard": 3}, tid=1_000_003)
+    rec = [r for r in spans.records()
+           if r["name"] == "shard.solve"][-1]
+    assert rec["tid"] == 1_000_003
+    assert rec["dur"] == pytest.approx(0.125)
+    # flat-timer accounting matches span() semantics
+    assert spans.flat_timers()["shard.solve"][0] >= 1
+
+
+def test_new_trace_ids_unique():
+    ids = {spans.new_trace_id() for _ in range(100)}
+    assert len(ids) == 100
+
+
+def test_single_anchor_trace_yields_no_flow():
+    tr = spans.new_trace_id()
+    spans.mark("serving.shed", args={"trace": tr})
+    flow, _ = _flow_events(tr)
+    assert flow == []            # nothing to connect
+
+
+# ---------------------------------------------------------------------------
+# serving trace propagation
+# ---------------------------------------------------------------------------
+
+
+def test_request_flow_chain_connects_lifecycle(poisson16):
+    svc = SolveService(_svc_cfg())
+    t = svc.submit(poisson16, _rhs(poisson16, 1), tenant="acme")
+    assert t.trace_id
+    svc.drain(timeout_s=300)
+    assert t.result.converged
+    flow, tagged = _flow_events(t.trace_id)
+    names = [e["name"] for e in tagged]
+    # the lifecycle stages, in order: submit bookkeeping, the build
+    # this (oldest unserved) ticket triggered, the retroactive queue
+    # wait, the admit splice, chunk cycles, finalize, completion
+    for stage in ("serving.submit", "serving.build", "serving.queue",
+                  "serving.admit", "serving.step", "serving.finalize",
+                  "serving.complete"):
+        assert stage in names, f"missing lifecycle stage {stage}"
+    assert names[0] == "serving.submit"
+    assert names[-1] == "serving.complete"
+    # one connected arrow chain: s ... t ... f, a single flow id
+    assert len(flow) == len(tagged)
+    assert flow[0]["ph"] == "s" and flow[-1]["ph"] == "f"
+    assert all(e["ph"] == "t" for e in flow[1:-1])
+    assert len({e["id"] for e in flow}) == 1
+
+
+def test_shed_decision_on_chain_with_estimate(poisson16):
+    svc = SolveService(_svc_cfg(extra="serving_max_queue=1"))
+    seq0 = flightrec.last_seq()
+    t1 = svc.submit(poisson16, _rhs(poisson16, 2))
+    t2 = svc.submit(poisson16, _rhs(poisson16, 3))  # shed: queue bound
+    assert t2.done and t2.result.status == "overloaded"
+    _, tagged = _flow_events(t2.trace_id)
+    assert [e["name"] for e in tagged] == ["serving.submit",
+                                           "serving.shed",
+                                           "serving.complete"]
+    ev = flightrec.events(kind="shed", since_seq=seq0)[-1]
+    assert ev["trace"] == t2.trace_id
+    assert ev["reason"] == "overload"
+    svc.drain(timeout_s=300)
+    assert t1.result.converged
+
+
+def test_deadline_miss_flight_event(poisson16):
+    seq0 = flightrec.last_seq()
+    svc = SolveService(_svc_cfg())
+    t = svc.submit(poisson16, _rhs(poisson16, 12), deadline_s=0.0)
+    svc.step()                       # queued expiry fires immediately
+    assert t.done and t.result.status == "deadline_exceeded"
+    ev = flightrec.events(kind="deadline.miss", since_seq=seq0)
+    assert ev and ev[-1]["trace"] == t.trace_id
+    assert ev[-1]["where"] == "queued"
+    svc.drain(timeout_s=300)
+
+
+def test_tracing_off_restores_pretracing_span_set(poisson16):
+    before = {r["name"] for r in spans.records()}
+    n_submit = sum(1 for r in spans.records()
+                   if r["name"] == "serving.submit")
+    svc = SolveService(_svc_cfg(extra="serving_tracing=0"))
+    t = svc.submit(poisson16, _rhs(poisson16, 4))
+    assert t.trace_id is None
+    svc.drain(timeout_s=300)
+    assert t.result.converged
+    after = sum(1 for r in spans.records()
+                if r["name"] == "serving.submit")
+    assert after == n_submit     # no lifecycle spans minted
+    del before
+
+
+def test_crash_recovered_request_is_one_chain(poisson16, tmp_path):
+    """THE acceptance: a submitted->crashed->recovered->finalized
+    request yields one Perfetto trace whose flow events connect
+    submit through finalize across BOTH service incarnations under a
+    single trace id."""
+    kr = (f"serving_journal_dir={tmp_path}, serving_checkpoint_cycles=1,"
+          " serving_chunk_iters=1, s:tolerance=1e-12")
+    victim = SolveService(_svc_cfg(extra=kr))
+    vt = victim.submit(poisson16, _rhs(poisson16, 5),
+                       request_key="trace-kr")
+    orig_trace = vt.trace_id
+    assert orig_trace
+    for _ in range(4):
+        victim.step()
+    assert not vt.done           # genuinely mid-flight
+    del victim                   # the "crash"
+    succ = SolveService(_svc_cfg(extra=kr))   # journal replays here
+    done = succ.drain(timeout_s=300)
+    assert len(done) == 1 and done[0].done
+    # the successor's ticket carries the ORIGINAL trace id (persisted
+    # in the journal at submit)
+    assert done[0].trace_id == orig_trace
+    flow, tagged = _flow_events(orig_trace)
+    names = [e["name"] for e in tagged]
+    # incarnation 1 contributed the submit, incarnation 2 the resume
+    # and the completion — all under one trace id
+    assert names[0] == "serving.submit"
+    assert "serving.resume" in names
+    assert "serving.checkpoint" in names
+    assert names[-1] == "serving.complete"
+    # one connected chain: single flow id, s first, f last
+    assert len(flow) >= 4
+    assert flow[0]["ph"] == "s" and flow[-1]["ph"] == "f"
+    assert len({e["id"] for e in flow}) == 1
+
+
+def test_journal_persists_trace_id(poisson16, tmp_path):
+    svc = SolveService(_svc_cfg(
+        extra=f"serving_journal_dir={tmp_path}"))
+    t = svc.submit(poisson16, _rhs(poisson16, 6))
+    meta = svc.journal.pending()[0]
+    assert meta["trace"] == t.trace_id
+    svc.drain(timeout_s=300)
+
+
+def test_capi_ticket_trace(poisson16):
+    from amgx_tpu import capi
+    assert capi.AMGX_initialize() == 0
+    rc, cfg_h = capi.AMGX_config_create(
+        BATCHED_CG + ", serving_bucket_slots=2")
+    rc, rsrc_h = capi.AMGX_resources_create_simple(cfg_h)
+    rc, svc_h = capi.AMGX_service_create(rsrc_h, "dDDI", cfg_h)
+    rc, m_h = capi.AMGX_matrix_create(rsrc_h, "dDDI")
+    rc, b_h = capi.AMGX_vector_create(rsrc_h, "dDDI")
+    ro = np.asarray(poisson16.row_offsets)
+    ci = np.asarray(poisson16.col_indices)
+    v = np.asarray(poisson16.values)
+    assert capi.AMGX_matrix_upload_all(
+        m_h, poisson16.num_rows, v.size, 1, 1, ro, ci, v, None) == 0
+    b = _rhs(poisson16, 7)
+    assert capi.AMGX_vector_upload(b_h, b.size, 1, b) == 0
+    rc, tkt = capi.AMGX_service_submit(svc_h, m_h, b_h, "acme", None)
+    assert rc == 0
+    rc, trace = capi.AMGX_ticket_trace(tkt)
+    assert rc == 0 and trace        # the flow/journal correlation key
+    rc, _n = capi.AMGX_service_drain(svc_h, 300)
+    assert rc == 0
+    # same id after completion (stable across the lifecycle)
+    rc, trace2 = capi.AMGX_ticket_trace(tkt)
+    assert rc == 0 and trace2 == trace
+    capi.AMGX_service_ticket_destroy(tkt)
+    capi.AMGX_service_destroy(svc_h)
+
+
+# ---------------------------------------------------------------------------
+# comms/shard telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_ring_comms_bytes_match_hand_computed_windows():
+    """The acceptance's exactness clause: on a 4-shard ring mesh the
+    modeled bytes counters equal the hand-computed halo window sizes.
+    poisson 5pt at 8x8 (n=64, n_local=16) has band reach 8, so each
+    boundary window is 8 elements; f64 => 8 els * 8 B * 3 sending
+    ranks = 192 bytes per direction per traced exchange site."""
+    import jax
+    from jax.sharding import Mesh
+    from amgx_tpu.distributed import DistributedSolver
+    mesh = Mesh(np.array(jax.devices()[:4]), ("p",))
+    A = gallery.poisson("5pt", 8, 8).init()
+    ds = DistributedSolver(Config.from_string(
+        "config_version=2, solver(s)=CG, s:max_iters=200,"
+        " s:tolerance=1e-8, s:monitor_residual=1"), mesh)
+    ds.setup(A)
+    f0 = metrics.get("dist.comms.bytes_fwd")
+    b0 = metrics.get("dist.comms.bytes_bwd")
+    c0 = metrics.get("dist.exchange.calls")
+    res = ds.solve(np.ones(64))
+    assert res.converged
+    tbl = res.report.distributed["comms"]
+    assert tbl and all(e["mode"] == "ring" for e in tbl)
+    for e in tbl:
+        assert e["elems_fwd"] == 8 and e["elems_bwd"] == 8
+        assert e["itemsize"] == 8 and e["n_ranks"] == 4
+        assert e["bytes_fwd"] == 8 * 8 * 3 == 192
+        assert e["bytes_bwd"] == 192
+    # the counters advanced by exactly the table's totals
+    assert metrics.get("dist.comms.bytes_fwd") - f0 == \
+        sum(e["bytes_fwd"] for e in tbl)
+    assert metrics.get("dist.comms.bytes_bwd") - b0 == \
+        sum(e["bytes_bwd"] for e in tbl)
+    assert metrics.get("dist.exchange.calls") - c0 == len(tbl)
+    # per-shard tallies + imbalance gauges + one track per shard
+    sh = res.report.distributed["shards"]
+    assert sh["rows"] == [16, 16, 16, 16]
+    assert sum(sh["nnz"]) == 288          # 5pt nnz at 8x8
+    assert sh["rows_imbalance"] == 1.0
+    assert metrics.get("dist.shard.nnz_imbalance") == \
+        sh["nnz_imbalance"]
+    shard_tracks = {r["tid"] for r in spans.records()
+                    if r["name"] == "shard.solve"
+                    and r.get("args", {}).get("rows") == 16}
+    assert len(shard_tracks) == 4         # one synthetic track each
+    # the report block still validates against the schema
+    from amgx_tpu.telemetry import validate_report
+    assert validate_report(res.report.to_dict()) == []
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flightrec_rotation_and_load(tmp_path):
+    rec = FlightRecorder(str(tmp_path), rotate_events=5)
+    for i in range(12):
+        rec.record("test.ev", n=i)
+    rec.close()
+    evs = FlightRecorder.load(str(tmp_path))
+    # generation discipline: after 12 writes at rotate=5, 6..10 live
+    # in flight.log.1, 11..12 in flight.log — bounded, ordered
+    assert [e["n"] for e in evs] == list(range(5, 12))
+    assert os.path.exists(tmp_path / "flight.log.1")
+
+
+def test_flightrec_corrupt_line_dropped(tmp_path):
+    rec = FlightRecorder(str(tmp_path))
+    rec.record("test.ev", n=1)
+    rec.close()
+    with open(tmp_path / "flight.log", "a") as f:
+        f.write('{"torn": tr')      # the crash's torn final write
+    d0 = metrics.get("flightrec.dropped")
+    evs = FlightRecorder.load(str(tmp_path))
+    assert [e["n"] for e in evs] == [1]
+    assert metrics.get("flightrec.dropped") - d0 == 1
+
+
+def test_flightrec_disk_mirror_survives_reopen(tmp_path):
+    rec = FlightRecorder(str(tmp_path))
+    rec.record("test.ev", n=1)
+    rec.close()
+    rec2 = FlightRecorder(str(tmp_path))     # the successor process
+    rec2.record("test.ev", n=2)
+    rec2.close()
+    assert [e["n"] for e in FlightRecorder.load(str(tmp_path))] \
+        == [1, 2]
+
+
+def test_breakdown_dumps_recent_events(poisson16):
+    """On a BREAKDOWN completion the last-N flight events go through
+    output.py's callback — the injected build crash must be in the
+    dump, naming its own cause."""
+    from amgx_tpu import output
+    lines = []
+    output.register_print_callback(lambda msg, _n: lines.append(msg))
+    try:
+        svc = SolveService(_svc_cfg())     # default BUILD_FAILED>reject
+        with faultinject.inject("build_crash", fires=1):
+            t = svc.submit(poisson16, _rhs(poisson16, 8))
+            svc.drain(timeout_s=300)
+        assert t.result.status == "breakdown"
+    finally:
+        output.register_print_callback(None)
+    text = "".join(lines)
+    assert "flight recorder" in text
+    assert "build_crash" in text
+    assert "ticket.breakdown" in text
+
+
+def test_quarantine_and_requeue_events(poisson16):
+    seq0 = flightrec.last_seq()
+    svc = SolveService(_svc_cfg(
+        extra="serving_chunk_iters=1, s:tolerance=1e-12"))
+    t = svc.submit(poisson16, _rhs(poisson16, 9))
+    svc.step()
+    with faultinject.inject("step_crash", fires=1):
+        svc.step()
+    svc.drain(timeout_s=300)
+    assert t.result.converged
+    kinds = [e["kind"] for e in flightrec.events(since_seq=seq0)]
+    assert "bucket.quarantine" in kinds
+    assert "slot.requeue" in kinds
+    req = flightrec.events(kind="slot.requeue", since_seq=seq0)[-1]
+    assert req["trace"] == t.trace_id     # stamped with the request
+
+
+def test_resetup_routing_events(poisson16):
+    seq0 = flightrec.last_seq()
+    slv = amgx.create_solver(Config.from_string(
+        "config_version=2, solver(s)=PCG, s:max_iters=60,"
+        " s:tolerance=1e-8, s:monitor_residual=1,"
+        " s:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION,"
+        " amg:selector=SIZE_2, amg:smoother=JACOBI_L1,"
+        " amg:structure_reuse_levels=-1,"
+        " amg:coarse_solver=DENSE_LU_SOLVER, amg:min_coarse_rows=16"))
+    slv.setup(poisson16)
+    routes = [e["route"] for e in
+              flightrec.events(kind="resetup.route", since_seq=seq0)]
+    assert routes[0] == "full"
+    seq1 = flightrec.last_seq()
+    vals = np.asarray(poisson16.values).copy() * 1.5
+    slv.resetup(poisson16.with_values(vals))
+    routes = [e["route"] for e in
+              flightrec.events(kind="resetup.route", since_seq=seq1)]
+    assert routes and routes[0] in ("value", "structure")
+
+
+def test_fallback_hop_event(poisson16):
+    seq0 = flightrec.last_seq()
+    rs = amgx.create_solver(Config.from_string(
+        "solver=CG, max_iters=200, monitor_residual=1,"
+        " tolerance=1e-8, convergence=RELATIVE_INI,"
+        " fallback_policy=NAN_DETECTED>retry,"
+        " max_fallback_attempts=2"))
+    rs.setup(poisson16)
+    with faultinject.inject("spmv_nan", iteration=2, fires=1):
+        res = rs.solve(np.ones(poisson16.num_rows))
+    assert res.converged
+    hops = flightrec.events(kind="fallback.hop", since_seq=seq0)
+    assert hops and hops[0]["action"] == "retry"
+    assert hops[0]["from_status"] == "NAN_DETECTED"
+    # the chaos injection itself is on the trail too
+    chaos = flightrec.events(kind="chaos", since_seq=seq0)
+    assert any(e.get("fault") == "spmv_nan" for e in chaos)
+
+
+# ---------------------------------------------------------------------------
+# satellites: replica label + dead-metric lint
+# ---------------------------------------------------------------------------
+
+
+def test_replica_label_on_every_openmetrics_sample(poisson16):
+    try:
+        svc = SolveService(_svc_cfg(extra="serving_replica_id=r7"))
+        t = svc.submit(poisson16, _rhs(poisson16, 10),
+                       tenant="acme")
+        svc.drain(timeout_s=300)
+        assert t.result.converged
+        om = metrics.to_openmetrics()
+        samples = [ln for ln in om.splitlines()
+                   if ln and not ln.startswith("#")]
+        assert samples
+        assert all('replica="r7"' in ln for ln in samples)
+        # label-set samples keep their own labels alongside
+        assert any('replica="r7"' in ln and 'tenant="acme"' in ln
+                   for ln in samples)
+    finally:
+        metrics.set_replica_label(None)
+    # cleared: back to unlabeled samples
+    om = metrics.to_openmetrics()
+    assert 'replica="r7"' not in om
+
+
+def test_replica_label_env_default(poisson16, monkeypatch):
+    import amgx_tpu.telemetry.metrics as M
+    monkeypatch.setenv("AMGX_REPLICA_ID", "env-3")
+    monkeypatch.setattr(M, "_replica", None)
+    monkeypatch.setattr(M, "_replica_env_checked", False)
+    try:
+        assert M.replica_label() == "env-3"
+        assert 'replica="env-3"' in metrics.to_openmetrics()
+    finally:
+        M.set_replica_label(None)
+
+
+def _load_check_spans():
+    path = os.path.join(REPO, "tools", "check_spans.py")
+    spec = importlib.util.spec_from_file_location("check_spans_t", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_dead_metric_lint_catches_catalog_rot():
+    mod = _load_check_spans()
+    assert mod.check() == []           # the real package is clean
+    from amgx_tpu.telemetry import metrics as M
+    M.declare_counter("zz.dead.counter", "never incremented anywhere")
+    try:
+        errs = mod.check()
+        assert any("dead metric" in e and "zz.dead.counter" in e
+                   for e in errs)
+    finally:
+        del M.COUNTERS["zz.dead.counter"]
+    assert mod.check() == []
+
+
+def test_flow_chain_valid_in_exported_file(poisson16, tmp_path):
+    """End-to-end artifact check: the exported trace file is valid
+    JSON whose flow events reference slices present in the file."""
+    svc = SolveService(_svc_cfg())
+    t = svc.submit(poisson16, _rhs(poisson16, 11))
+    svc.drain(timeout_s=300)
+    path = tmp_path / "trace.json"
+    n = spans.export_chrome_trace(str(path))
+    doc = json.loads(path.read_text())
+    assert len(doc["traceEvents"]) == n
+    flows = [e for e in doc["traceEvents"]
+             if e.get("cat") == "trace.flow"
+             and e["args"].get("trace") == t.trace_id]
+    assert flows and flows[0]["ph"] == "s"
+    # BINDABILITY: every flow anchor (including the terminal 'f',
+    # bp='e') needs an ENCLOSING 'X' slice on its pid/tid — instant
+    # marks alone cannot bind, which is why trace-tagged marks export
+    # as 1us slices
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    for f in flows:
+        assert any(e["pid"] == f["pid"] and e["tid"] == f["tid"]
+                   and e["ts"] <= f["ts"] <= e["ts"] + e["dur"]
+                   for e in xs), f"unbindable flow anchor: {f}"

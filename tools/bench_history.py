@@ -104,6 +104,10 @@ SERIES: Tuple[Tuple[str, str, float, str], ...] = (
     ("obs_overhead_pct", "lower_abs", 3.0,
      "telemetry-instrumented per-iteration overhead (abs pct gate, "
      "not relative-to-prior: the target is 0)"),
+    ("serving_trace_overhead_pct", "lower_abs", 3.0,
+     "request-path tracing (serving_tracing=1 vs 0) paired-median "
+     "per-request overhead (abs pct gate; host dict appends only, "
+     "the target is 0)"),
     ("serving_solves_per_s", "higher", 0.40,
      "serving sustained throughput under the open-loop bench load"),
     ("serving_p99_ms", "lower", 0.60,

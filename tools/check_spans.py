@@ -27,6 +27,17 @@ checkable without running anything:
    Non-literal names (the serving cache's configurable counter map)
    are skipped: the runtime check owns those.
 
+4. NO DEAD METRICS — the REVERSE of 3: every name in the DECLARED
+   catalogs must have at least one recording site in the package — a
+   literal receiver call, an f-string receiver call whose wildcard
+   pattern covers it (`_tm.inc(f"resilience.fallback.{action}")`
+   keeps the whole family alive), or a plain string constant equal to
+   the name (the indirected counter maps the serving cache threads
+   through). Docstrings don't count. Catches catalog rot: a metric
+   whose last increment site was refactored away would otherwise keep
+   being exported as an eternally-zero series that LOOKS like
+   instrumentation.
+
 f-string placeholders (`{expr}`) are normalized to `*`, so
 `f"amg.L{k}.galerkin"` checks as `amg.L*.galerkin`. Calls whose name is
 not a literal cannot be checked statically and are reported (there are
@@ -55,7 +66,12 @@ _EXEMPT = (
     os.path.join("amgx_tpu", "telemetry", "spans.py"),
 )
 
-_CALL_NAMES = {"trace_region", "span"}
+# _tspan/_tmark are the serving layer's knob-gated wrappers; their
+# call sites carry the literal lifecycle names (the wrappers' own
+# forwarding bodies use the checker-invisible _raw aliases, like the
+# engine in the exempt spans.py)
+_CALL_NAMES = {"trace_region", "span", "mark", "record_span",
+               "_tspan", "_tmark"}
 
 # metric-recording surface: attribute calls on the package's
 # conventional registry receivers (`_tm.inc(...)`, `metrics.observe`).
@@ -127,7 +143,21 @@ def extract_metric_literals(root: str = PKG):
     through the registry's conventional receivers. Dynamic names
     (variables threaded through a config map) are skipped — the
     runtime registry's did-you-mean raise owns those."""
-    out = []
+    return _extract_metric_calls(root)[0]
+
+
+# the RECORDING half of the receiver surface (quantile is a read —
+# contract 3 checks its name, contract 4 must not count it as a site)
+_WRITE_ATTRS = {"inc", "set_gauge", "max_gauge", "observe"}
+
+
+def _extract_metric_calls(root: str = PKG):
+    """(literals, patterns, writes): literal receiver-call names as
+    before; the f-string WRITE calls normalized to wildcard patterns
+    (`f"resilience.fallback.{action}"` -> 'resilience.fallback.*');
+    and the (kind, name) literal WRITE sites — contract 4's evidence
+    that a metric (family) has a live recording site."""
+    literals, patterns, writes = [], [], []
     for dirpath, _dirs, files in os.walk(root):
         if "__pycache__" in dirpath:
             continue
@@ -152,8 +182,54 @@ def extract_metric_literals(root: str = PKG):
                 arg = node.args[0]
                 if isinstance(arg, ast.Constant) \
                         and isinstance(arg.value, str):
-                    out.append((path, node.lineno,
-                                _METRIC_KINDS[f_.attr], arg.value))
+                    literals.append((path, node.lineno,
+                                     _METRIC_KINDS[f_.attr], arg.value))
+                    if f_.attr in _WRITE_ATTRS:
+                        writes.append((_METRIC_KINDS[f_.attr],
+                                       arg.value))
+                elif isinstance(arg, ast.JoinedStr) \
+                        and f_.attr in _WRITE_ATTRS:
+                    pat = _normalize(arg)
+                    if pat is not None:
+                        patterns.append((path, node.lineno,
+                                         _METRIC_KINDS[f_.attr], pat))
+    return literals, patterns, writes
+
+
+def extract_string_constants(root: str = PKG):
+    """Every non-docstring string constant in the package — contract
+    4's fallback evidence for metric names threaded through
+    indirection (the serving cache's counter map). Exact-equality
+    matching only, so a name mentioned inside a prose sentence never
+    counts."""
+    out = set()
+    for dirpath, _dirs, files in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, _ROOT)
+            if rel in _METRIC_EXEMPT:
+                continue
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+            docstrings = set()
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.Module, ast.ClassDef,
+                                     ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    body = getattr(node, "body", [])
+                    if body and isinstance(body[0], ast.Expr) \
+                            and isinstance(body[0].value, ast.Constant) \
+                            and isinstance(body[0].value.value, str):
+                        docstrings.add(id(body[0].value))
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and id(node) not in docstrings:
+                    out.add(node.value)
     return out
 
 
@@ -222,13 +298,44 @@ def check():
     from amgx_tpu.telemetry import metrics as M
     catalogs = {"counter": M.COUNTERS, "gauge": M.GAUGES,
                 "histogram": M.HISTOGRAMS}
-    for path, line, kind, name in extract_metric_literals():
+    literals, patterns, writes = _extract_metric_calls()
+    for path, line, kind, name in literals:
         rel = os.path.relpath(path, _ROOT)
         if name not in catalogs[kind]:
             errors.append(
                 f"{rel}:{line}: {kind} {name!r} is not declared in "
                 f"telemetry/metrics.py "
                 f"({'COUNTERS' if kind == 'counter' else 'GAUGES' if kind == 'gauge' else 'HISTOGRAMS'})")
+
+    # 4. no dead metrics: every declared name needs a recording site —
+    # a literal call of the right WRITE kind, an f-string call whose
+    # wildcard covers it, or (indirection fallback) an exact string
+    # constant anywhere outside a docstring. `quantile` is a read, not
+    # a recording site.
+    write_kinds = {"counter", "gauge", "histogram"}
+    lit_by_kind = {k: set() for k in write_kinds}
+    for kind, name in writes:
+        lit_by_kind[kind].add(name)
+    pat_by_kind = {k: set() for k in write_kinds}
+    for path, line, kind, pat in patterns:
+        pat_by_kind[kind].add(pat)
+    constants = None      # lazily built: most names resolve earlier
+    for kind, catalog in catalogs.items():
+        for name in catalog:
+            if name in lit_by_kind[kind]:
+                continue
+            if any(fnmatch.fnmatchcase(name, p)
+                   for p in pat_by_kind[kind]):
+                continue
+            if constants is None:
+                constants = extract_string_constants()
+            if name in constants:
+                continue
+            errors.append(
+                f"dead metric: declared {kind} {name!r} has no "
+                f"increment/observe site in the package (catalog rot "
+                f"— remove the declaration or restore the "
+                f"instrumentation)")
     return errors
 
 
@@ -240,7 +347,7 @@ def main() -> int:
         print(f"check_spans: {len(errors)} violation(s)")
         return 1
     print("check_spans: OK (span-registry coverage + accounted-leaf "
-          "disjointness + metric-name coverage)")
+          "disjointness + metric-name coverage + no dead metrics)")
     return 0
 
 

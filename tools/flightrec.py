@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Flight-recorder postmortem reader.
+
+Pretty-prints a crash-surviving flight-recorder log
+(telemetry/flightrec.py: the `flightrec_dir` knob /
+AMGX_TPU_FLIGHTREC_DIR env) and, given the dead service's journal
+directory, correlates the event trail with the journaled requests —
+the two halves of a postmortem: the journal says WHAT was in flight,
+the flight recorder says WHY the process was doing what it was doing
+when it died.
+
+Usage:
+    python tools/flightrec.py LOGDIR [--journal DIR] [--last N]
+                              [--trace ID] [--kind PREFIX]
+
+Reads are corruption-tolerant (torn final lines are dropped and
+counted), so this works on the log of a process that died mid-write —
+that is the point.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+sys.path.insert(0, _ROOT)
+
+from amgx_tpu.telemetry.flightrec import FlightRecorder, format_event  # noqa: E402
+
+
+def load_journal_index(jdir: str) -> List[Dict[str, Any]]:
+    """The journal's meta records (req-*.json), corrupt ones skipped —
+    the same tolerance discipline as the journal's own open."""
+    recs = []
+    try:
+        names = sorted(os.listdir(jdir))
+    except OSError:
+        return recs
+    for name in names:
+        if not (name.startswith("req-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(jdir, name)) as f:
+                meta = json.load(f)
+            recs.append(meta)
+        except Exception:
+            continue
+    return recs
+
+
+def correlate(events: List[Dict[str, Any]],
+              journal: List[Dict[str, Any]]) -> List[str]:
+    """Per journaled request: its status + every flight event stamped
+    with its trace id (the trace id is the join key — the journal
+    persists it exactly so a postmortem can do this)."""
+    by_trace: Dict[str, List[Dict[str, Any]]] = {}
+    for e in events:
+        tr = e.get("trace")
+        if tr:
+            by_trace.setdefault(str(tr), []).append(e)
+    lines = []
+    for meta in sorted(journal, key=lambda m: int(m.get("seq", 0))):
+        tr = meta.get("trace")
+        lines.append(
+            f"request {meta.get('id')} [{meta.get('status')}] "
+            f"tenant={meta.get('tenant')} "
+            f"fingerprint={str(meta.get('fingerprint'))[:24]} "
+            f"trace={tr or '-'}")
+        for e in by_trace.get(str(tr), []) if tr else []:
+            lines.append("    " + format_event(e))
+    orphans = [e for e in events
+               if e.get("trace")
+               and not any(str(m.get("trace")) == str(e["trace"])
+                           for m in journal)]
+    if orphans:
+        lines.append(f"({len(orphans)} trace-stamped events match no "
+                     f"journal record — completed+pruned or "
+                     f"pre-journal requests)")
+    return lines
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("logdir", help="flight-recorder directory")
+    ap.add_argument("--journal", help="solve-journal directory to "
+                                      "correlate against")
+    ap.add_argument("--last", type=int, default=None,
+                    help="only the last N events")
+    ap.add_argument("--trace", default=None,
+                    help="only events stamped with this trace id")
+    ap.add_argument("--kind", default=None,
+                    help="only events whose kind starts with this")
+    args = ap.parse_args(argv)
+    events = FlightRecorder.load(args.logdir)
+    if args.trace:
+        events = [e for e in events if e.get("trace") == args.trace]
+    if args.kind:
+        events = [e for e in events
+                  if str(e.get("kind", "")).startswith(args.kind)]
+    if args.last:
+        events = events[-args.last:]
+    print(f"flight recorder @ {args.logdir}: {len(events)} event(s)")
+    for e in events:
+        print("  " + format_event(e))
+    if args.journal:
+        journal = load_journal_index(args.journal)
+        print(f"\njournal correlation @ {args.journal}: "
+              f"{len(journal)} record(s)")
+        for line in correlate(events, journal):
+            print("  " + line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
