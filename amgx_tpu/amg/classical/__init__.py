@@ -194,8 +194,13 @@ class ClassicalAMGLevel(AMGLevel):
                 and getattr(self, "P", None) is not None \
                 and getattr(self, "R", None) is not None \
                 and self.coarse_size:
+            # weight slabs emit in the hierarchy's effective precision
+            # (precision.py) so the solve-data cast never materializes
+            # a full-precision twin of the cwt/pwt payloads
+            from ...precision import resolve_precision
+            dt = resolve_precision(self.cfg, self.scope).cast_dtype
             slabs = fused.build_csr_transfer_slabs(self.A, self.P,
-                                                   self.R)
+                                                   self.R, dtype=dt)
         self._xfer_memo = (slabs,)
         return slabs
 

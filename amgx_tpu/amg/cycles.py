@@ -107,6 +107,15 @@ def apply_coarse_solver(cs, data, bc, xc, coarsest_sweeps: int):
 
 
 def _coarse_solve(amg, data, bc, xc):
+    if bc.dtype == jnp.bfloat16:
+        # the coarse tail stays f32+ (precision.py policy keeps the
+        # coarse-solver payload at f32): a bf16 cycle upcasts the
+        # coarse rhs around the solve and rounds the correction back
+        out = apply_coarse_solver(
+            amg.coarse_solver, data["coarse"],
+            bc.astype(jnp.float32), xc.astype(jnp.float32),
+            amg.coarsest_sweeps)
+        return out.astype(bc.dtype)
     return apply_coarse_solver(amg.coarse_solver, data["coarse"], bc, xc,
                                amg.coarsest_sweeps)
 
@@ -187,6 +196,12 @@ def _kcycle(amg, data, lvl: int, b, x, flex: bool):
 
     def Ac_mv(v):
         if Ac_data_lvl == len(levels):
+            if v.dtype == jnp.bfloat16:
+                # the coarsest operator stays f32+ under a bf16 cycle
+                # (precision policy) — upcast the matvec and round
+                # back so the K-cycle recurrence keeps one dtype
+                return spmv_coarsest(
+                    amg, data, v.astype(jnp.float32)).astype(v.dtype)
             return spmv_coarsest(amg, data, v)
         return spmv(data["levels"][Ac_data_lvl]["A"], v)
 

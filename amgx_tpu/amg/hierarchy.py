@@ -176,7 +176,12 @@ class AMG:
         self.cycle_fusion = bool(int(cfg.get("cycle_fusion", scope)))
         self.cycle_fusion_tail_rows = int(
             cfg.get("cycle_fusion_tail_rows", scope))
-        self.precision = str(cfg.get("amg_precision", scope))
+        # effective hierarchy/cycle precision: the shared policy
+        # resolves amg_precision / solve_precision / tpu_dtype into one
+        # answer (precision.py) and rejects contradictory combinations
+        from ..precision import resolve_precision
+        self.precision_policy = resolve_precision(cfg, scope)
+        self.precision = self.precision_policy.name
         self.print_grid_stats = bool(cfg.get("print_grid_stats", scope))
         self.intensive_smoothing = bool(cfg.get("intensive_smoothing", scope))
         self.host_setup = str(cfg.get("amg_host_setup", scope))
@@ -668,6 +673,11 @@ class AMG:
         name = self._guard_known_faults(name)
         level.smoother = make_solver(name, self.cfg, scope)
         level.smoother._owns_scaling = False
+        # fused operand slabs emit directly in the hierarchy's
+        # effective precision (ops/smooth.solver_fused_slabs): the
+        # solve-data cast then finds them already narrow — no
+        # full-precision twin ever materializes
+        level.smoother._slab_dtype = self._PRECISIONS[self.precision]
         if getattr(level.smoother, "needs_cf_map", False) and \
                 getattr(level, "cf_map", None) is not None:
             level.smoother.set_cf_map(level.cf_map)
@@ -708,11 +718,15 @@ class AMG:
     # -- solve-phase data -------------------------------------------------
     _PRECISIONS = {"double": None, "float": "float32", "bfloat16": "bfloat16"}
 
-    def _cast_leaf(self, leaf):
-        """amg_precision cast of one solve-data leaf (identity for
-        structure arrays and full-precision mode)."""
+    def _cast_leaf(self, leaf, dt=False):
+        """Precision cast of one solve-data leaf (identity for
+        structure arrays and full-precision mode). `dt` overrides the
+        target dtype name — the coarse-solver subtree casts to the
+        policy's f32+ coarse dtype while the levels take the full
+        reduced precision."""
         import jax.numpy as jnp
-        dt = self._PRECISIONS[self.precision]
+        if dt is False:
+            dt = self._PRECISIONS[self.precision]
         if dt is not None and hasattr(leaf, "dtype") and \
                 jnp.issubdtype(leaf.dtype, jnp.inexact):
             return leaf.astype(dt)
@@ -827,20 +841,39 @@ class AMG:
             # include/amgx_config.h:102-131): the whole stored hierarchy
             # and cycle run in reduced precision inside an f64 flexible
             # Krylov outer loop — on TPU this halves (or quarters) HBM
-            # traffic and turns on the f32 Pallas SpMV kernels
+            # traffic and turns on the f32/bf16 Pallas kernel suite.
+            # The COARSE-solver subtree casts to the policy's f32+
+            # coarse dtype (precision.py): the dense factorization,
+            # back-substitution and the K-cycle coarse matvec never
+            # run below f32 even when the levels stream bf16
             memo = {}
             pre = getattr(self, "_resetup_precast", None) or {}
+            cdt = self.precision_policy.coarse_dtype
 
-            def cast(leaf):
-                key = id(leaf)
-                if key not in memo:
-                    # the one-dispatch value-resetup emits the reduced-
-                    # precision twins inside its own program; reuse them
-                    # instead of dispatching a fresh astype per leaf
-                    memo[key] = (leaf, pre[key] if key in pre
-                                 else self._cast_leaf(leaf))
-                return memo[key][1]
-            data = jax.tree.map(cast, data)
+            import jax.numpy as jnp
+
+            def mk(target):
+                tgt = jnp.dtype(target)
+
+                def cast(leaf):
+                    key = (id(leaf), target)
+                    if key not in memo:
+                        # the one-dispatch value-resetup emits the
+                        # reduced-precision twins inside its own
+                        # program; reuse a twin only when its dtype
+                        # matches THIS subtree's target (the coarse
+                        # subtree's f32+ target can differ from the
+                        # level target under bf16)
+                        tw = pre.get(id(leaf))
+                        if tw is not None and tw.dtype == tgt:
+                            out = tw
+                        else:
+                            out = self._cast_leaf(leaf, target)
+                        memo[key] = (leaf, out)
+                    return memo[key][1]
+                return cast
+            data = {"levels": jax.tree.map(mk(dt), data["levels"]),
+                    "coarse": jax.tree.map(mk(cdt), data["coarse"])}
         return data
 
     def _sweeps(self, level_index: int, pre: bool) -> int:

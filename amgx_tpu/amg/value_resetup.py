@@ -130,6 +130,9 @@ def build_plan(amg):
     cz_cols = jnp.asarray(Az.col_indices)
     nz = Az.num_rows
     dt_cast = amg._PRECISIONS[amg.precision]
+    # the coarse-solver payload (QR factors) casts to the policy's
+    # f32+ coarse dtype, matching the solve_data split cast
+    dt_coarse = amg.precision_policy.coarse_dtype
     l0_dtype = chain[0].A.dtype
     cheb_tabs = {o: jnp.asarray(np.asarray(chebyshev_poly_coeffs(o)),
                                 l0_dtype)
@@ -181,8 +184,8 @@ def build_plan(amg):
                     "dia": [d.astype(dt_cast) for d in outs["dia"]],
                     "taus": [None if t is None else t.astype(dt_cast)
                              for t in outs["taus"]],
-                    "qt": outs["qt"].astype(dt_cast),
-                    "r": outs["r"].astype(dt_cast)}
+                    "qt": outs["qt"].astype(dt_coarse),
+                    "r": outs["r"].astype(dt_coarse)}
             outs["cast"] = cast
         outs["wrapped"] = wrapped
         return outs

@@ -213,7 +213,10 @@ def _register_default_parameters():
       "auto", ("auto", "0", "1"))
     R("amg_precision", str, "precision of the stored hierarchy + cycle "
       "(TPU-native mixed-precision preconditioning, the dDFI-mode analog: "
-      "a float32/bfloat16 cycle inside an f64 flexible Krylov solver)",
+      "a float32/bfloat16 cycle inside an f64 flexible Krylov solver). "
+      "Resolved through the shared precision policy (precision.py) with "
+      "solve_precision/tpu_dtype: contradictory combinations are "
+      "rejected at configuration time",
       "double", ("double", "float", "bfloat16"))
     R("error_scaling", int, "coarse-correction scaling mode", 0, (0, 2, 3))
     R("reuse_scale", int, "reuse correction scale for next N iters", 0)
@@ -323,7 +326,23 @@ def _register_default_parameters():
     R("eig_convergence_check_freq", int, "convergence check frequency", 1)
     # TPU-specific additions (new surface; no reference analog)
     R("spmv_impl", str, "SpMV implementation <AUTO|CSR_SEGSUM|ELL|PALLAS>", "AUTO")
-    R("tpu_dtype", str, "override compute dtype <float32|float64|bfloat16>", "")
+    R("tpu_dtype", str, "legacy compute-dtype override, resolved as an "
+      "alias of the shared precision policy (precision.py: float64 -> "
+      "double, float32 -> float, bfloat16 -> bfloat16); prefer "
+      "solve_precision, and contradictory combinations of the three "
+      "precision knobs are rejected", "",
+      ("", "float32", "float64", "bfloat16"))
+    R("solve_precision", str, "solve-phase precision of the inner "
+      "multigrid cycle (precision.py policy; owns amg_precision/"
+      "tpu_dtype when set): float = f32 operand slabs, bfloat16 = bf16 "
+      "operand slabs streamed by the fused Pallas kernels with f32 "
+      "in-kernel accumulation — roughly half the HBM bytes per sweep — "
+      "while reductions, convergence checks and the DENSE_LU coarse "
+      "tail stay f32+, and the REFINEMENT defect-correction shell "
+      "(when configured) restores f64-grade answers and records "
+      "per-precision iteration counts in SolveReport.precision. "
+      "Unset ('') is bitwise-off: jaxpr-identical to a pre-knob build",
+      "", ("", "double", "float", "bfloat16"))
     R("fused_smoother", int, "fuse damped-relaxation smoother sweeps "
       "and the trailing cycle residual into single-pass Pallas kernels "
       "on DIA/SWELL levels (ops/smooth.py); 0 restores the unfused "

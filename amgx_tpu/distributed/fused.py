@@ -276,11 +276,15 @@ def dist_fused_smooth(fd: DistFusedSlabs, b, x, taus, dinv,
     if fd.vals_q.dtype != x.dtype:
         return None
     from ..ops import smooth as fsm
+    # bf16 shards ride the same kernel (per-block upcast, f32
+    # accumulation) AND halve the packed edge-window exchange bytes —
+    # the comms site below models the narrower itemsize automatically
     use_kernel = (
-        x.dtype == jnp.float32
+        jnp.dtype(x.dtype).name in _ps.SMOOTH_DTYPES
         and fsm.fused_runtime_on()
-        and _ps.dia_smooth_plan(offsets, k, nl, n_steps,
-                                with_residual) is not None)
+        and _ps.dia_smooth_plan(
+            offsets, k, nl, n_steps, with_residual,
+            itemsize=jnp.dtype(x.dtype).itemsize) is not None)
 
     # 1. edge-window exchange (the only collective of the fused call)
     fx, bx = n_app * m, n_app * M

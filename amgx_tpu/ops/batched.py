@@ -24,6 +24,14 @@ import jax.numpy as jnp
 from ..matrix import CsrMatrix
 
 
+def _cdt(dtype):
+    """Accumulation dtype of the slab forms: sub-f32 (bf16) slabs
+    upcast and accumulate in f32, mirroring the fused Pallas kernels
+    (identity for f32/f64 — the casts fold away)."""
+    from .pallas_spmv import compute_dtype
+    return compute_dtype(dtype)
+
+
 def spmv_dia_multi(A: CsrMatrix, X: jax.Array) -> jax.Array:
     """Y = A @ X for DIA-layout A and X of shape (B, n): one shifted
     dense multiply-add per stored diagonal, batch axis untouched."""
@@ -81,15 +89,21 @@ def smooth_dia_multi(A: CsrMatrix, B: jax.Array, X: jax.Array, taus,
     take when only the vectors carry the batch axis (solve_many's
     shared-matrix shape), so a vmapped cycle's presmooth+residual pair
     streams A's values once per slab pass instead of once per system.
-    The update order matches the Pallas kernel: (tau * residual) * dinv."""
+    The update order matches the Pallas kernel: (tau * residual) * dinv.
+    bf16 slabs accumulate in f32 like the kernels (only the values
+    stream stays narrow; outputs round back to the input dtype)."""
+    dt = X.dtype
+    cdt = _cdt(dt)
+    X = X.astype(cdt)
+    B = B.astype(cdt)
     for t in range(taus.shape[0]):
-        upd = taus[t] * (B - spmv_dia_multi(A, X))
+        upd = taus[t].astype(cdt) * (B - spmv_dia_multi(A, X))
         if dinv is not None:
-            upd = upd * dinv[None, :]
+            upd = upd * dinv[None, :].astype(cdt)
         X = X + upd
     if with_residual:
-        return X, B - spmv_dia_multi(A, X)
-    return X
+        return X.astype(dt), (B - spmv_dia_multi(A, X)).astype(dt)
+    return X.astype(dt)
 
 
 def affine_window_sweeps(offsets, vals_w, b_w, x_w, taus, dinv_w,
@@ -116,24 +130,31 @@ def affine_window_sweeps(offsets, vals_w, b_w, x_w, taus, dinv_w,
     This is the distributed fused path's workhorse (boundary-strip
     completion next to the per-shard kernel, and the whole-shard f64 /
     non-Pallas route — distributed/fused.py) and the parity reference
-    the kernel tests compare against."""
+    the kernel tests compare against. bf16 windows upcast and the
+    sweeps accumulate in f32, exactly like the kernel's per-block
+    upcast — so the spliced boundary strips and the kernel interior
+    share one arithmetic."""
     n_steps = int(taus.shape[0])
     n_app = n_steps + (1 if with_residual else 0)
     m = max(0, -min(offsets))
     M = max(0, max(offsets))
     Wv = W + (n_app - 1) * (m + M)
-    dt = x_w.dtype
+    out_dt = x_w.dtype
+    dt = _cdt(out_dt)
+    x_w = x_w.astype(dt)
+    b_w = b_w.astype(dt)
+    dinv_w = None if dinv_w is None else dinv_w.astype(dt)
 
     def apply_a(s):
         acc = jnp.zeros((Wv,), dt)
         for i, d in enumerate(offsets):
-            acc = acc + vals_w[i] * jax.lax.slice_in_dim(
+            acc = acc + vals_w[i].astype(dt) * jax.lax.slice_in_dim(
                 s, m + d, m + d + Wv, 1, 0)
         return acc
 
     s = x_w
     for t in range(n_steps):
-        corr = taus[t] * (b_w - apply_a(s))
+        corr = taus[t].astype(dt) * (b_w - apply_a(s))
         if dinv_w is not None:
             corr = corr * dinv_w
         mid = jax.lax.slice_in_dim(s, m, m + Wv, 1, 0) + corr
@@ -143,12 +164,14 @@ def affine_window_sweeps(offsets, vals_w, b_w, x_w, taus, dinv_w,
         if M:
             pieces.append(jnp.zeros((M,), dt))
         s = jnp.concatenate(pieces) if len(pieces) > 1 else mid
-    y = jax.lax.slice_in_dim(s, n_app * m, n_app * m + W, 1, 0)
+    y = jax.lax.slice_in_dim(s, n_app * m, n_app * m + W,
+                             1, 0).astype(out_dt)
     if not with_residual:
         return y
     r = b_w - apply_a(s)
     return y, jax.lax.slice_in_dim(r, (n_app - 1) * m,
-                                   (n_app - 1) * m + W, 1, 0)
+                                   (n_app - 1) * m + W, 1, 0
+                                   ).astype(out_dt)
 
 
 # ---------------------------------------------------------------------------
@@ -204,17 +227,26 @@ def prolong_corr_multi(A: CsrMatrix, X: jax.Array, XC: jax.Array,
 def smooth_restrict_dia_multi(A: CsrMatrix, B: jax.Array, X: jax.Array,
                               taus, dinv, xfer):
     """Multi-RHS form of the fused presmooth + restriction epilogue:
-    (X', BC) with BC = R (B - A X')."""
-    X, R = smooth_dia_multi(A, B, X, taus, dinv, True)
-    return X, restrict_multi(R, xfer)
+    (X', BC) with BC = R (B - A X'). bf16 inputs run the whole chain
+    at f32 (the kernel's restriction partial sums are f32 too) and
+    round the outputs back."""
+    dt = X.dtype
+    cdt = _cdt(dt)
+    X, R = smooth_dia_multi(A, B.astype(cdt), X.astype(cdt), taus,
+                            dinv, True)
+    return X.astype(dt), restrict_multi(R, xfer).astype(dt)
 
 
 def corr_smooth_dia_multi(A: CsrMatrix, B: jax.Array, X: jax.Array,
                           XC: jax.Array, taus, dinv, xfer):
     """Multi-RHS form of the fused prolongation prologue + postsmooth:
-    X' = smooth(B, X + P XC)."""
-    X = prolong_corr_multi(A, X, XC, xfer)
-    return smooth_dia_multi(A, B, X, taus, dinv, False)
+    X' = smooth(B, X + P XC). bf16 inputs accumulate the correction
+    gather in f32 and round back (kernel-mirroring)."""
+    dt = X.dtype
+    cdt = _cdt(dt)
+    X = prolong_corr_multi(A, X.astype(cdt), XC.astype(cdt), xfer)
+    return smooth_dia_multi(A, B.astype(cdt), X, taus,
+                            dinv, False).astype(dt)
 
 
 def tail_cycle_multi(arrs, B: jax.Array, X: jax.Array, spec):
@@ -233,6 +265,8 @@ def tail_cycle_multi(arrs, B: jax.Array, X: jax.Array, spec):
         x2 = jax.lax.dynamic_update_slice(x2, x, (0,))
         out = _tail_compute(arrs, b2.reshape(l0.qc, LANES),
                             x2.reshape(l0.qc, LANES), spec)
-        return out.reshape(-1)[: l0.n]
+        # _tail_compute returns the f32+ accumulation dtype; round
+        # back so the vmapped cycle's state dtype is stable
+        return out.reshape(-1)[: l0.n].astype(b.dtype)
 
     return jax.vmap(single)(B, X)

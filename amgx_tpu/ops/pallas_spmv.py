@@ -247,6 +247,35 @@ _SMOOTH_VMEM_BUDGET = 11 * 1024 * 1024
 SMOOTH_MAX_APPS = 8          # sweeps + residual cap for one fused call
 _BR_CAP = 2048               # largest candidate block size
 
+# Fused-kernel operand-dtype whitelist. bf16 slabs stream at half the
+# HBM bytes of f32 (the kernels are bandwidth-bound, so ~2x per sweep)
+# and halve the VMEM the DMA windows occupy (bigger blocks fit under
+# the budget — a second, compounding win); the kernels upcast each
+# block in VMEM and accumulate every sweep + the trailing residual in
+# f32, so only the OPERAND stream is narrow, never the arithmetic.
+SMOOTH_DTYPES = ("float32", "bfloat16")
+
+
+def compute_dtype(dtype):
+    """In-kernel accumulation dtype for an operand stream: sub-f32
+    operands (bf16) upcast per block and accumulate in f32; f32/f64
+    pass through unchanged (identity casts fold away, keeping the f32
+    jaxprs bit-identical to the pre-mixed-precision build)."""
+    return jnp.float32 if jnp.dtype(dtype).itemsize < 4 else \
+        jnp.dtype(dtype)
+
+
+def smooth_dtype_ok(A, x_dtype) -> bool:
+    """Operand-dtype gate shared by every fused-smoother-suite entry:
+    the matrix slab dtype and the vector dtype must agree and sit on
+    the kernel whitelist. Callers that find a fused payload but fail
+    THIS gate count `fusion.declined_dtype` (ops/smooth.py) so a
+    config that falls off the fused path is visible, not silent."""
+    if getattr(A, "dia_vals", None) is None:
+        return False
+    dt = jnp.dtype(A.dia_vals.dtype)
+    return dt == jnp.dtype(x_dtype) and dt.name in SMOOTH_DTYPES
+
 
 def smooth_halo_rows(offsets):
     """(mr0, Mr0): per-application dependence growth in 128-lane rows."""
@@ -288,19 +317,23 @@ def smooth_quota_rows(offsets, num_rows: int):
 
 
 def dia_smooth_plan(offsets, k: int, num_rows: int, n_steps: int,
-                    with_residual: bool):
+                    with_residual: bool, itemsize: int = 4):
     """Block plan for the fused smoother or None when it does not pay.
 
     Returns (br, n_app, mr0, Mr0, win_x, win_v, n_blocks). The block
     size is the largest that fits the double-buffered windows in the
     VMEM budget; the plan is rejected when the halo recompute would
     cost more HBM traffic than the unfused n_app passes it replaces
-    (callers then chain shorter fused calls instead)."""
+    (callers then chain shorter fused calls instead). `itemsize` is
+    the operand-slab byte width: bf16 slabs (2) halve the DMA-window
+    footprint so larger blocks fit, at the cost of the f32 upcast
+    working set the budget accounts below."""
     if not offsets:
         return None
     n_app = int(n_steps) + (1 if with_residual else 0)
     if n_app < 1 or n_app > SMOOTH_MAX_APPS:
         return None
+    ib = int(itemsize)
     mr0, Mr0 = smooth_halo_rows(offsets)
     H = mr0 + Mr0
     rows128 = max(1, -(-num_rows // LANES))
@@ -311,7 +344,11 @@ def dia_smooth_plan(offsets, k: int, num_rows: int, n_steps: int,
         vmem = (2 * k * win_v            # values, double-buffered
                 + 2 * (2 * win_v + win_x)   # b/dinv/x windows, 2 slots
                 + 2 * n_out * br         # pipelined output blocks
-                ) * LANES * 4
+                ) * LANES * ib
+        if ib < 4:
+            # sub-f32 operands: the f32 state + per-application upcast
+            # temporaries ride on top of the narrow DMA buffers
+            vmem += (win_x + 3 * win_v) * LANES * 4
         if vmem > _SMOOTH_VMEM_BUDGET:
             continue
         # traffic guard: the fused windows (k+2 streams of win_v plus
@@ -330,14 +367,15 @@ def dia_smooth_supported(A, x_dtype, n_steps: int,
     """Trace-time gate for the fused smoother Pallas path."""
     if jax.default_backend() != "tpu" and not _FORCE_INTERPRET:
         return False
-    if A.dia_vals is None or A.dia_vals.dtype != jnp.float32 \
-            or x_dtype != jnp.float32:
+    if not smooth_dtype_ok(A, x_dtype):
         return False
     if A.num_rows != A.num_cols or A.has_external_diag:
         return False
     k = A.dia_vals.shape[0]
     return dia_smooth_plan(A.dia_offsets, k, A.num_rows, n_steps,
-                           with_residual) is not None
+                           with_residual,
+                           itemsize=jnp.dtype(x_dtype).itemsize) \
+        is not None
 
 
 def _dia_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x, win_v,
@@ -348,9 +386,13 @@ def _dia_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x, win_v,
     i*br - (n_app-1)*mr0 + j' (so an application's output row j'
     aligns with operand-window row j' directly). `slab_shift` is the
     static extra front padding of the quota-padded vals/dinv slabs
-    beyond this plan's (n_app-1)*mr0 need."""
+    beyond this plan's (n_app-1)*mr0 need. Sub-f32 operand dtypes
+    (bf16) stream/DMA narrow and upcast per block in VMEM; the state
+    and every accumulation run in `cdt` (f32+), and only the final
+    stores round back to the operand dtype."""
     ro = [mr0 + (o - (o % LANES)) // LANES for o in offsets]
     rl = [o % LANES for o in offsets]
+    cdt = compute_dtype(dtype)
 
     def kernel(*refs):
         # refs: xp, vals_q, bp, [dinv_q], taus, out_x, [out_r],
@@ -403,13 +445,13 @@ def _dia_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x, win_v,
             d.wait()
 
         col = jax.lax.broadcasted_iota(jnp.int32, (win_v, LANES), 1)
-        vals = vbuf[slot]               # (k, win_v, 128)
-        bw = bbuf[slot]                 # (win_v, 128)
-        dw = dbuf[slot] if has_dinv else None
+        vals = vbuf[slot]               # (k, win_v, 128) operand dtype
+        bw = bbuf[slot].astype(cdt)     # (win_v, 128)
+        dw = dbuf[slot].astype(cdt) if has_dinv else None
 
         def apply_A(s):
             """A @ state on the compute region (win_v rows)."""
-            acc = jnp.zeros((win_v, LANES), dtype)
+            acc = jnp.zeros((win_v, LANES), cdt)
             for t, _ in enumerate(offsets):
                 a = jax.lax.slice_in_dim(s, ro[t], ro[t] + win_v, 1, 0)
                 if rl[t] == 0:
@@ -421,26 +463,27 @@ def _dia_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x, win_v,
                     wa = pltpu.roll(a, jnp.int32(shift), 1)
                     wb = pltpu.roll(b2, jnp.int32(shift), 1)
                     w = jnp.where(col < shift, wa, wb)
-                acc = acc + vals[t] * w
+                acc = acc + vals[t].astype(cdt) * w
             return acc
 
-        s = xbuf[slot]                  # (win_x, 128) state
+        s = xbuf[slot].astype(cdt)      # (win_x, 128) state, f32+
         for t in range(n_steps):
             tau = taus_ref[t]
             mid = jax.lax.slice_in_dim(s, mr0, mr0 + win_v, 1, 0)
             corr = tau * (bw - apply_A(s))
             if has_dinv:
                 corr = corr * dw
-            pieces = [mid + corr, jnp.zeros((Mr0, LANES), dtype)]
+            pieces = [mid + corr, jnp.zeros((Mr0, LANES), cdt)]
             if mr0:
-                pieces.insert(0, jnp.zeros((mr0, LANES), dtype))
+                pieces.insert(0, jnp.zeros((mr0, LANES), cdt))
             s = jnp.concatenate(pieces, axis=0)
         y_ref[...] = jax.lax.slice_in_dim(
-            s, n_app * mr0, n_app * mr0 + br, 1, 0)
+            s, n_app * mr0, n_app * mr0 + br, 1, 0).astype(dtype)
         if with_residual:
             r = bw - apply_A(s)
             r_ref[...] = jax.lax.slice_in_dim(
-                r, (n_app - 1) * mr0, (n_app - 1) * mr0 + br, 1, 0)
+                r, (n_app - 1) * mr0, (n_app - 1) * mr0 + br, 1, 0
+            ).astype(dtype)
 
     return kernel
 
@@ -458,7 +501,9 @@ def _dia_smooth_call(vals_q, dinv_q, taus, b, x, offsets, num_rows,
     n_steps = taus.shape[0]
     has_dinv = dinv_q is not None
     dtype = vals_q.dtype
-    plan = dia_smooth_plan(offsets, k, num_rows, n_steps, with_residual)
+    ib = jnp.dtype(dtype).itemsize
+    plan = dia_smooth_plan(offsets, k, num_rows, n_steps, with_residual,
+                           itemsize=ib)
     br, n_app, mr0, Mr0, win_x, win_v, nb = plan
     qf, qc, qb = smooth_quota_rows(offsets, num_rows)
     assert vals_q.shape[1] == qf + qc + qb, \
@@ -495,7 +540,10 @@ def _dia_smooth_call(vals_q, dinv_q, taus, b, x, offsets, num_rows,
         operands.append(dinv_q)
     in_specs.append(pl.BlockSpec((n_steps,), lambda i: (jnp.int32(0),),
                                  memory_space=pltpu.SMEM))
-    operands.append(taus.astype(dtype))
+    # taus stay at the ACCUMULATION dtype: a bf16-rounded damping
+    # factor would throw away Chebyshev coefficient precision the f32
+    # arithmetic can keep (identity for f32/f64 operands)
+    operands.append(taus.astype(compute_dtype(dtype)))
     out_block = pl.BlockSpec((br, LANES), lambda i: (i, jnp.int32(0)),
                              memory_space=pltpu.VMEM)
     out_shape = jax.ShapeDtypeStruct((nb * br, LANES), dtype)
@@ -520,7 +568,7 @@ def _dia_smooth_call(vals_q, dinv_q, taus, b, x, offsets, num_rows,
         cost_estimate=pl.CostEstimate(
             flops=2 * n_app * k * nb * br * LANES,
             bytes_accessed=((k + 2) * win_v + win_x + n_out * br)
-            * nb * LANES * 4,
+            * nb * LANES * ib,
             transcendentals=0,
         ),
         # NOTE: `interpret` must be resolved by the (un-jitted) caller —
@@ -662,20 +710,23 @@ class TransferSlabs:
 
 def dia_restrict_plan(offsets, k: int, num_rows: int, n_steps: int,
                       m: int, windows, weighted: bool = False,
-                      wavg=None):
+                      wavg=None, itemsize: int = 4):
     """Block plan for the smoother+restriction-epilogue kernel, or
     None. Mirrors dia_smooth_plan(with_residual=True) plus the epilogue
     buffers: m double-buffered child-index windows (and, `weighted`,
     the matching weight windows of the general-CSR form) and the
     pipelined partial-coarse output block. `wavg` (weighted only) is
     the ceil-average R row length — the honest per-window cost of the
-    unfused SWELL restriction the fusion replaces."""
+    unfused SWELL restriction the fusion replaces. `itemsize` is the
+    operand byte width (value/vector/weight streams; the index tables
+    are always int32)."""
     cap = CSR_TRANSFER_MAX_CHILD if weighted else TRANSFER_MAX_CHILD
     if not offsets or m < 1 or m > cap:
         return None
     n_app = int(n_steps) + 1
     if n_steps < 1 or n_app > SMOOTH_MAX_APPS:
         return None
+    ib = int(itemsize)
     wavg = m if wavg is None else wavg
     tabs = 2 if weighted else 1          # index (+ weight) tables
     wmap = {w[0]: w[1] for w in windows}
@@ -690,9 +741,14 @@ def dia_restrict_plan(offsets, k: int, num_rows: int, n_steps: int,
         win_x = win_v + H
         vmem = (2 * k * win_v + 2 * (2 * win_v + win_x)
                 + 2 * br                 # x output pipeline
-                + 2 * tabs * m * cw      # child windows (int32 [+ f32])
                 + 2 * cw                 # partial-coarse output pipeline
-                ) * LANES * 4
+                ) * LANES * ib \
+            + 2 * m * cw * LANES * 4     # child-index windows (int32)
+        if weighted:
+            vmem += 2 * m * cw * LANES * ib   # weight windows
+        if ib < 4:
+            # f32 state + upcast temporaries + f32 partial sums
+            vmem += (win_x + 3 * win_v + cw) * LANES * 4
         if vmem > _SMOOTH_VMEM_BUDGET:
             continue
         # traffic guard vs the unfused compose: n_app passes over A
@@ -711,17 +767,19 @@ def dia_restrict_plan(offsets, k: int, num_rows: int, n_steps: int,
 
 def dia_prolong_plan(offsets, k: int, num_rows: int, n_steps: int,
                      windows, mp: int = 1, weighted: bool = False,
-                     pavg=None):
+                     pavg=None, itemsize: int = 4):
     """Block plan for the prolongation-prologue+smoother kernel, or
     None. with_residual is never true here (the correction folds into
     the POST-smoother); the prologue adds the aggregate-id window (or,
     general CSR, mp index+weight window pairs) and the coarse-vector
-    window to the budget."""
+    window to the budget. `itemsize` is the operand byte width (the
+    id tables stay int32)."""
     if not offsets or mp < 1 or mp > TRANSFER_MAX_CHILD:
         return None
     n_app = int(n_steps)
     if n_app < 1 or n_app > SMOOTH_MAX_APPS:
         return None
+    ib = int(itemsize)
     pavg = mp if pavg is None else pavg
     tabs = 2 if weighted else 1
     wmap = {w[0]: w[2] for w in windows}
@@ -736,9 +794,13 @@ def dia_prolong_plan(offsets, k: int, num_rows: int, n_steps: int,
         win_x = win_v + H
         vmem = (2 * k * win_v + 2 * (2 * win_v + win_x)
                 + 2 * br                 # x output pipeline
-                + 2 * tabs * mp * win_x  # id (+ weight) windows
                 + 2 * pcw                # coarse-vector windows
-                ) * LANES * 4
+                ) * LANES * ib \
+            + 2 * mp * win_x * LANES * 4      # id windows (int32)
+        if weighted:
+            vmem += 2 * mp * win_x * LANES * ib   # weight windows
+        if ib < 4:
+            vmem += (win_x + 3 * win_v + pcw) * LANES * 4
         if vmem > _SMOOTH_VMEM_BUDGET:
             continue
         # guard vs unfused: n_app passes plus the correction pass
@@ -757,8 +819,7 @@ def dia_prolong_plan(offsets, k: int, num_rows: int, n_steps: int,
 def _transfer_gate(A, x_dtype) -> bool:
     if jax.default_backend() != "tpu" and not _FORCE_INTERPRET:
         return False
-    if A.dia_vals is None or A.dia_vals.dtype != jnp.float32 \
-            or x_dtype != jnp.float32:
+    if not smooth_dtype_ok(A, x_dtype):
         return False
     return A.num_rows == A.num_cols and not A.has_external_diag
 
@@ -770,7 +831,9 @@ def dia_restrict_supported(A, x_dtype, n_steps: int, xfer) -> bool:
     return dia_restrict_plan(A.dia_offsets, k, A.num_rows, n_steps,
                              xfer.m, xfer.windows,
                              weighted=xfer.cwt is not None,
-                             wavg=xfer.wavg) is not None
+                             wavg=xfer.wavg,
+                             itemsize=jnp.dtype(x_dtype).itemsize) \
+        is not None
 
 
 def dia_prolong_supported(A, x_dtype, n_steps: int, xfer) -> bool:
@@ -780,7 +843,9 @@ def dia_prolong_supported(A, x_dtype, n_steps: int, xfer) -> bool:
     return dia_prolong_plan(A.dia_offsets, k, A.num_rows, n_steps,
                             xfer.windows, mp=xfer.mp,
                             weighted=xfer.ptab is not None,
-                            pavg=xfer.pavg) is not None
+                            pavg=xfer.pavg,
+                            itemsize=jnp.dtype(x_dtype).itemsize) \
+        is not None
 
 
 def _dia_smooth_restrict_kernel(offsets, br, n_app, mr0, Mr0, win_x,
@@ -793,9 +858,12 @@ def _dia_smooth_restrict_kernel(offsets, br, n_app, mr0, Mr0, win_x,
     never written to HBM. `has_w` (general-CSR / classical form)
     gathers a weight window next to each child-index window and the
     partial sums become weighted: bc[c] = sum_j w[j][c] * r[ct[j][c]]
-    (the aggregation form is the unit-weight special case)."""
+    (the aggregation form is the unit-weight special case). Sub-f32
+    operands upcast per block and every partial sum accumulates in
+    `cdt` (f32+) — see _dia_smooth_kernel."""
     ro = [mr0 + (o - (o % LANES)) // LANES for o in offsets]
     rl = [o % LANES for o in offsets]
+    cdt = compute_dtype(dtype)
 
     def kernel(*refs):
         # refs: xp, vals_q, bp, [dinv_q], ctab, [cwt], cb, taus,
@@ -875,11 +943,11 @@ def _dia_smooth_restrict_kernel(offsets, br, n_app, mr0, Mr0, win_x,
 
         col = jax.lax.broadcasted_iota(jnp.int32, (win_v, LANES), 1)
         vals = vbuf[slot]
-        bw = bbuf[slot]
-        dw = dbuf[slot] if has_dinv else None
+        bw = bbuf[slot].astype(cdt)
+        dw = dbuf[slot].astype(cdt) if has_dinv else None
 
         def apply_A(s):
-            acc = jnp.zeros((win_v, LANES), dtype)
+            acc = jnp.zeros((win_v, LANES), cdt)
             for t, _ in enumerate(offsets):
                 a = jax.lax.slice_in_dim(s, ro[t], ro[t] + win_v, 1, 0)
                 if rl[t] == 0:
@@ -891,37 +959,37 @@ def _dia_smooth_restrict_kernel(offsets, br, n_app, mr0, Mr0, win_x,
                     wa = pltpu.roll(a, jnp.int32(shift), 1)
                     wb = pltpu.roll(b2, jnp.int32(shift), 1)
                     w = jnp.where(col < shift, wa, wb)
-                acc = acc + vals[t] * w
+                acc = acc + vals[t].astype(cdt) * w
             return acc
 
-        s = xbuf[slot]
+        s = xbuf[slot].astype(cdt)
         for t in range(n_steps):
             tau = taus_ref[t]
             mid = jax.lax.slice_in_dim(s, mr0, mr0 + win_v, 1, 0)
             corr = tau * (bw - apply_A(s))
             if has_dinv:
                 corr = corr * dw
-            pieces = [mid + corr, jnp.zeros((Mr0, LANES), dtype)]
+            pieces = [mid + corr, jnp.zeros((Mr0, LANES), cdt)]
             if mr0:
-                pieces.insert(0, jnp.zeros((mr0, LANES), dtype))
+                pieces.insert(0, jnp.zeros((mr0, LANES), cdt))
             s = jnp.concatenate(pieces, axis=0)
         y_ref[...] = jax.lax.slice_in_dim(
-            s, n_app * mr0, n_app * mr0 + br, 1, 0)
+            s, n_app * mr0, n_app * mr0 + br, 1, 0).astype(dtype)
         r = bw - apply_A(s)
         rblk = jax.lax.slice_in_dim(
             r, (n_app - 1) * mr0, (n_app - 1) * mr0 + br, 1, 0)
         rflat = rblk.reshape(br * LANES)
         base = i * jnp.int32(br * LANES)
-        part = jnp.zeros((cw, LANES), dtype)
+        part = jnp.zeros((cw, LANES), cdt)
         for j in range(m):
             idxj = cbuf[slot, j]                       # (cw, 128) int32
             rel = idxj - base
             valid = (idxj >= 0) & (rel >= 0) & (rel < br * LANES)
             g = jnp.take(rflat, jnp.where(valid, rel, 0))
             if has_w:
-                g = g * wbuf[slot, j]
-            part = part + jnp.where(valid, g, jnp.zeros((), dtype))
-        bc_ref[...] = part
+                g = g * wbuf[slot, j].astype(cdt)
+            part = part + jnp.where(valid, g, jnp.zeros((), cdt))
+        bc_ref[...] = part.astype(dtype)
 
     return kernel
 
@@ -939,9 +1007,10 @@ def _dia_smooth_restrict_call(vals_q, dinv_q, taus, b, x, xfer,
     has_dinv = dinv_q is not None
     has_w = xfer.cwt is not None
     dtype = vals_q.dtype
+    ib = jnp.dtype(dtype).itemsize
     plan = dia_restrict_plan(offsets, k, num_rows, n_steps, xfer.m,
                              xfer.windows, weighted=has_w,
-                             wavg=xfer.wavg)
+                             wavg=xfer.wavg, itemsize=ib)
     br, n_app, mr0, Mr0, win_x, win_v, nb, cw = plan
     qf, qc, qb = smooth_quota_rows(offsets, num_rows)
     assert vals_q.shape[1] == qf + qc + qb
@@ -983,7 +1052,7 @@ def _dia_smooth_restrict_call(vals_q, dinv_q, taus, b, x, xfer,
     operands.append(cb.astype(jnp.int32))
     in_specs.append(pl.BlockSpec((n_steps,), lambda i: (jnp.int32(0),),
                                  memory_space=pltpu.SMEM))
-    operands.append(taus.astype(dtype))
+    operands.append(taus.astype(compute_dtype(dtype)))
     out_specs = (
         pl.BlockSpec((br, LANES), lambda i: (i, jnp.int32(0)),
                      memory_space=pltpu.VMEM),
@@ -1016,7 +1085,7 @@ def _dia_smooth_restrict_call(vals_q, dinv_q, taus, b, x, xfer,
             flops=2 * n_app * k * nb * br * LANES,
             bytes_accessed=((k + 2) * win_v + win_x
                             + (xfer.m * (2 if has_w else 1) + 1) * cw
-                            + br) * nb * LANES * 4,
+                            + br) * nb * LANES * ib,
             transcendentals=0,
         ),
         interpret=interpret,
@@ -1052,9 +1121,12 @@ def _dia_prolong_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x,
     slab. The general-CSR (classical) form — `has_w` — gathers mp
     (coarse id, weight) window pairs per fine slot and accumulates
     x += sum_j w[j] * xc[id[j]]; the aggregation form (mp=1, no
-    weights, 2-D atab) is unchanged."""
+    weights, 2-D atab) is unchanged. Sub-f32 operands upcast per
+    block; state/accumulation in `cdt` (f32+) — see
+    _dia_smooth_kernel."""
     ro = [mr0 + (o - (o % LANES)) // LANES for o in offsets]
     rl = [o % LANES for o in offsets]
+    cdt = compute_dtype(dtype)
 
     def kernel(*refs):
         # refs: xp, vals_q, bp, [dinv_q], xcp, atab|ptab, [pwt], pcb,
@@ -1141,11 +1213,11 @@ def _dia_prolong_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x,
 
         col = jax.lax.broadcasted_iota(jnp.int32, (win_v, LANES), 1)
         vals = vbuf[slot]
-        bw = bbuf[slot]
-        dw = dbuf[slot] if has_dinv else None
+        bw = bbuf[slot].astype(cdt)
+        dw = dbuf[slot].astype(cdt) if has_dinv else None
 
         def apply_A(s):
-            acc = jnp.zeros((win_v, LANES), dtype)
+            acc = jnp.zeros((win_v, LANES), cdt)
             for t, _ in enumerate(offsets):
                 a = jax.lax.slice_in_dim(s, ro[t], ro[t] + win_v, 1, 0)
                 if rl[t] == 0:
@@ -1157,39 +1229,39 @@ def _dia_prolong_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x,
                     wa = pltpu.roll(a, jnp.int32(shift), 1)
                     wb = pltpu.roll(b2, jnp.int32(shift), 1)
                     w = jnp.where(col < shift, wa, wb)
-                acc = acc + vals[t] * w
+                acc = acc + vals[t].astype(cdt) * w
             return acc
 
         # prologue: s = x + P xc over the WHOLE x window (the sweeps
         # consume halo rows, which need the corrected state too)
-        s = xbuf[slot]
-        xcw = xcbuf[slot].reshape(pcw * LANES)
+        s = xbuf[slot].astype(cdt)
+        xcw = xcbuf[slot].reshape(pcw * LANES).astype(cdt)
         if has_w:
             for j in range(mp):
                 aw = abuf[slot, j]                     # (win_x, 128)
                 rel = aw - pcb_ref[i] * jnp.int32(LANES)
                 valid = (aw >= 0) & (rel >= 0) & (rel < pcw * LANES)
                 g = jnp.take(xcw, jnp.where(valid, rel, 0))
-                g = g * wbuf[slot, j]
-                s = s + jnp.where(valid, g, jnp.zeros((), dtype))
+                g = g * wbuf[slot, j].astype(cdt)
+                s = s + jnp.where(valid, g, jnp.zeros((), cdt))
         else:
             aw = abuf[slot]                            # (win_x, 128)
             rel = aw - pcb_ref[i] * jnp.int32(LANES)
             valid = (aw >= 0) & (rel >= 0) & (rel < pcw * LANES)
             corr0 = jnp.take(xcw, jnp.where(valid, rel, 0))
-            s = s + jnp.where(valid, corr0, jnp.zeros((), dtype))
+            s = s + jnp.where(valid, corr0, jnp.zeros((), cdt))
         for t in range(n_steps):
             tau = taus_ref[t]
             mid = jax.lax.slice_in_dim(s, mr0, mr0 + win_v, 1, 0)
             corr = tau * (bw - apply_A(s))
             if has_dinv:
                 corr = corr * dw
-            pieces = [mid + corr, jnp.zeros((Mr0, LANES), dtype)]
+            pieces = [mid + corr, jnp.zeros((Mr0, LANES), cdt)]
             if mr0:
-                pieces.insert(0, jnp.zeros((mr0, LANES), dtype))
+                pieces.insert(0, jnp.zeros((mr0, LANES), cdt))
             s = jnp.concatenate(pieces, axis=0)
         y_ref[...] = jax.lax.slice_in_dim(
-            s, n_app * mr0, n_app * mr0 + br, 1, 0)
+            s, n_app * mr0, n_app * mr0 + br, 1, 0).astype(dtype)
 
     return kernel
 
@@ -1206,8 +1278,10 @@ def _dia_prolong_smooth_call(vals_q, dinv_q, taus, b, x, xc, xfer,
     has_dinv = dinv_q is not None
     has_w = xfer.ptab is not None
     dtype = vals_q.dtype
+    ib = jnp.dtype(dtype).itemsize
     plan = dia_prolong_plan(offsets, k, num_rows, n_steps, xfer.windows,
-                            mp=xfer.mp, weighted=has_w, pavg=xfer.pavg)
+                            mp=xfer.mp, weighted=has_w, pavg=xfer.pavg,
+                            itemsize=ib)
     br, n_app, mr0, Mr0, win_x, win_v, nb, pcw = plan
     qf, qc, qb = smooth_quota_rows(offsets, num_rows)
     assert vals_q.shape[1] == qf + qc + qb
@@ -1259,7 +1333,7 @@ def _dia_prolong_smooth_call(vals_q, dinv_q, taus, b, x, xc, xfer,
     operands.append(pcb.astype(jnp.int32))
     in_specs.append(pl.BlockSpec((n_steps,), lambda i: (jnp.int32(0),),
                                  memory_space=pltpu.SMEM))
-    operands.append(taus.astype(dtype))
+    operands.append(taus.astype(compute_dtype(dtype)))
     out_specs = pl.BlockSpec((br, LANES), lambda i: (i, jnp.int32(0)),
                              memory_space=pltpu.VMEM)
     out_shape = jax.ShapeDtypeStruct((nb * br, LANES), dtype)
@@ -1289,7 +1363,7 @@ def _dia_prolong_smooth_call(vals_q, dinv_q, taus, b, x, xc, xfer,
             flops=2 * n_app * k * nb * br * LANES,
             bytes_accessed=((k + 2) * win_v + win_x + pcw + br
                             + (2 * xfer.mp if has_w else 1) * win_x)
-            * nb * LANES * 4,
+            * nb * LANES * ib,
             transcendentals=0,
         ),
         interpret=interpret,
@@ -1335,14 +1409,20 @@ def _tail_compute(arrs, b, x, spec):
     NOSOLVER) at the coarsest. SINGLE SOURCE OF TRUTH: the Pallas
     kernel body runs this on loaded refs and the XLA fallback
     (ops/batched.py tail_cycle_multi, the f64 / vmapped route) runs it
-    on plain arrays — they cannot drift apart."""
+    on plain arrays — they cannot drift apart. Sub-f32 vectors/slabs
+    (bf16) upcast at entry/use and the WHOLE sub-cycle accumulates in
+    f32 (the coarse inverse stays f32 by the precision policy); the
+    caller rounds the returned state back to its vector dtype."""
     levels = spec.levels
+    cdt = compute_dtype(b.dtype)
+    b = b.astype(cdt)
+    x = x.astype(cdt)
 
     def apply_dia(ls, ar, s):
         mr0, Mr0 = smooth_halo_rows(ls.offsets)
         sp = jnp.pad(s, ((mr0, Mr0), (0, 0)))
         col = jax.lax.broadcasted_iota(jnp.int32, (ls.qc, LANES), 1)
-        acc = jnp.zeros((ls.qc, LANES), s.dtype)
+        acc = jnp.zeros((ls.qc, LANES), cdt)
         for t, o in enumerate(ls.offsets):
             ro = mr0 + (o - (o % LANES)) // LANES
             a = jax.lax.slice_in_dim(sp, ro, ro + ls.qc, 1, 0)
@@ -1355,14 +1435,14 @@ def _tail_compute(arrs, b, x, spec):
                 shift = LANES - rl
                 w = jnp.where(col < shift, jnp.roll(a, shift, 1),
                               jnp.roll(b2, shift, 1))
-            acc = acc + ar["vals"][t] * w
+            acc = acc + ar["vals"][t].astype(cdt) * w
         return acc
 
     def sweeps(ls, ar, bc, s, taus, n_taus):
         for t in range(n_taus):
-            corr = taus[t] * (bc - apply_dia(ls, ar, s))
+            corr = taus[t].astype(cdt) * (bc - apply_dia(ls, ar, s))
             if ls.has_dinv:
-                corr = corr * ar["dinv"]
+                corr = corr * ar["dinv"].astype(cdt)
             s = s + corr
         return s
 
@@ -1371,13 +1451,13 @@ def _tail_compute(arrs, b, x, spec):
         s = sweeps(ls, ar, bc, s, ar["taus_pre"], ls.n_pre)
         r = bc - apply_dia(ls, ar, s)
         rflat = r.reshape(-1)
-        coarse_b = jnp.zeros((ls.ncr, LANES), s.dtype)
+        coarse_b = jnp.zeros((ls.ncr, LANES), cdt)
         for j in range(ls.m):
             idxj = ar["ctab"][j]
             valid = idxj >= 0
             g = jnp.take(rflat, jnp.where(valid, idxj, 0))
             coarse_b = coarse_b + jnp.where(valid, g,
-                                            jnp.zeros((), s.dtype))
+                                            jnp.zeros((), cdt))
         if i + 1 < len(levels):
             bq = _rows_to(coarse_b, levels[i + 1].qc)
             xc = run(shape, i + 1, bq, jnp.zeros_like(bq))
@@ -1391,28 +1471,30 @@ def _tail_compute(arrs, b, x, spec):
             bz = _rows_to(coarse_b, ncrz)
             if kind == "inv":
                 F = ncrz * LANES
-                xcf = jnp.dot(bz.reshape(1, F), arrs[-1]["invT"],
-                              preferred_element_type=s.dtype)
+                xcf = jnp.dot(bz.reshape(1, F),
+                              arrs[-1]["invT"].astype(cdt),
+                              preferred_element_type=cdt)
                 xc = _rows_to(xcf.reshape(ncrz, LANES), ls.ncr)
             else:               # NOSOLVER: no coarse correction
-                xc = jnp.zeros((ls.ncr, LANES), s.dtype)
+                xc = jnp.zeros((ls.ncr, LANES), cdt)
         xcflat = xc.reshape(-1)
         aw = ar["atab_c"]
         valid = aw >= 0
         corr = jnp.take(xcflat, jnp.where(valid, aw, 0))
-        s = s + jnp.where(valid, corr, jnp.zeros((), s.dtype))
+        s = s + jnp.where(valid, corr, jnp.zeros((), cdt))
         s = sweeps(ls, ar, bc, s, ar["taus_post"], ls.n_post)
         return s
 
     return run(spec.shape, 0, b, x)
 
 
-def _dia_tail_kernel(spec, treedef, n_leaves):
+def _dia_tail_kernel(spec, treedef, n_leaves, dtype):
     def kernel(*refs):
         arrs = jax.tree_util.tree_unflatten(
             treedef, [r[...] for r in refs[:n_leaves]])
         b, x = refs[n_leaves][...], refs[n_leaves + 1][...]
-        refs[n_leaves + 2][...] = _tail_compute(arrs, b, x, spec)
+        refs[n_leaves + 2][...] = _tail_compute(arrs, b, x,
+                                                spec).astype(dtype)
     return kernel
 
 
@@ -1429,7 +1511,7 @@ def _dia_coarse_tail_call(arrs, b, x, spec, interpret=False):
     x2 = jnp.zeros((l0.qc * LANES,), dtype)
     x2 = jax.lax.dynamic_update_slice(x2, x, (0,)).reshape(l0.qc, LANES)
     leaves, treedef = jax.tree_util.tree_flatten(arrs)
-    kernel = _dia_tail_kernel(spec, treedef, len(leaves))
+    kernel = _dia_tail_kernel(spec, treedef, len(leaves), dtype)
 
     def _spec_of(v):
         nd = len(v.shape)

@@ -163,21 +163,36 @@ def swell_vals_host(ro, vals, num_rows, kpad):
     return vals4.reshape(nb, SUBS, kpad, LANES)
 
 
-def swell_spmv_supported(A, x_dtype) -> bool:
-    """Trace-time gate for the Pallas path."""
+def _swell_runtime_payload_ok(A) -> bool:
+    """Backend + payload-presence checks shared by the SWELL gates."""
     from .pallas_spmv import _FORCE_INTERPRET
     if jax.default_backend() != "tpu" and not _FORCE_INTERPRET:
         return False
-    if A.swell_cols is None or A.swell_vals is None:
-        return False
-    if A.swell_vals.dtype != jnp.float32 or x_dtype != jnp.float32:
-        return False
+    return A.swell_cols is not None and A.swell_vals is not None
+
+
+def _swell_budget_ok(A, val_itemsize: int, out_blocks: int) -> bool:
+    """One VMEM budget formula for both SWELL gates: the x window,
+    the double-buffered cols(int32)+vals entry slabs (`val_itemsize`
+    narrows for bf16 values), and `out_blocks` double-buffered
+    (SUBS, 128) pipeline blocks (1 = SpMV's y; 4 = the fused sweep's
+    x/b/dinv/out)."""
     w128 = A.swell_w128
     kpad = A.swell_vals.shape[2]
     win_bytes = 2 * w128 * LANES * 4
-    ent_bytes = 2 * SUBS * kpad * LANES * (4 + 4)
-    out_bytes = 2 * SUBS * LANES * 4          # double-buffered y blocks
+    ent_bytes = 2 * SUBS * kpad * LANES * (4 + val_itemsize)
+    out_bytes = 2 * out_blocks * SUBS * LANES * 4
     return win_bytes + ent_bytes + out_bytes <= _VMEM_BUDGET
+
+
+def swell_spmv_supported(A, x_dtype) -> bool:
+    """Trace-time gate for the Pallas path (f32 only: the plain SpMV's
+    output dtype is the caller's vector-dtype contract)."""
+    if not _swell_runtime_payload_ok(A):
+        return False
+    if A.swell_vals.dtype != jnp.float32 or x_dtype != jnp.float32:
+        return False
+    return _swell_budget_ok(A, 4, 1)
 
 
 def _swell_kernel(w128, kpad, n_blocks):
@@ -315,18 +330,22 @@ def swell_spmv(A, x, interpret=False):
 
 
 def swell_smooth_supported(A, x_dtype) -> bool:
-    """Trace-time gate for the fused-sweep SWELL path."""
-    if not swell_spmv_supported(A, x_dtype):
+    """Trace-time gate for the fused-sweep SWELL path. Unlike the
+    plain-SpMV gate (f32-only: its output dtype is the caller's vector
+    dtype contract), the fused sweep also accepts bf16 value slabs —
+    the kernel already upcasts the gathered x window to f32 and the
+    value multiply promotes, so only the value stream narrows; the
+    wrapper rounds x' back to the vector dtype."""
+    from .pallas_spmv import SMOOTH_DTYPES
+    if not _swell_runtime_payload_ok(A):
+        return False
+    dt = jnp.dtype(A.swell_vals.dtype)
+    if dt != jnp.dtype(x_dtype) or dt.name not in SMOOTH_DTYPES:
         return False
     if A.has_external_diag or A.num_rows != A.num_cols:
         return False
     # three extra (SUBS, 128) double-buffered blocks ride the pipeline
-    w128 = A.swell_w128
-    kpad = A.swell_vals.shape[2]
-    win_bytes = 2 * w128 * LANES * 4
-    ent_bytes = 2 * SUBS * kpad * LANES * (4 + 4)
-    out_bytes = 2 * 4 * SUBS * LANES * 4
-    return win_bytes + ent_bytes + out_bytes <= _VMEM_BUDGET
+    return _swell_budget_ok(A, dt.itemsize, 4)
 
 
 def _swell_smooth_kernel(w128, kpad, n_blocks, has_dinv):
@@ -460,12 +479,15 @@ def _swell_smooth_call(cols4, vals4, c0row, nchunk, x, b, dinv, tau,
 
 def swell_smooth_step(A, b, x, tau, dinv=None, interpret=False):
     """One fused damped sweep x' = x + tau * dinv . (b - A x); caller
-    must have checked swell_smooth_supported."""
+    must have checked swell_smooth_supported. The kernel computes in
+    f32 (bf16 value slabs promote at the multiply); the result rounds
+    back to the vector dtype so the cycle's state dtype is stable."""
     from .pallas_spmv import _FORCE_INTERPRET
-    return _swell_smooth_call(
+    y = _swell_smooth_call(
         A.swell_cols, A.swell_vals, A.swell_c0row, A.swell_nchunk,
         x, b, dinv, tau, A.swell_w128, A.num_rows,
         dinv is not None, interpret=interpret or _FORCE_INTERPRET)
+    return y.astype(x.dtype)
 
 
 def swell_spmv_xla(A, x):

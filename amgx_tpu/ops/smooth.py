@@ -65,20 +65,27 @@ def _slab_eligible(A) -> bool:
             and A.num_rows == A.num_cols)
 
 
-def build_fused_slabs(A, dinv=None):
+def build_fused_slabs(A, dinv=None, dtype=None):
     """Quota-padded DIA operand slabs {vals_q[, dinv_q]} for the fused
     smoother kernel (eager device ops; see smooth_quota_rows for the
-    layout). Returns None when A has no eligible DIA layout."""
+    layout). `dtype` emits the slabs in the hierarchy's EFFECTIVE
+    precision (precision.py policy — e.g. bf16 slabs at half the HBM
+    bytes) instead of A's native dtype, so the solve-data cast later
+    finds them already narrow and never materializes a second copy.
+    Returns None when A has no eligible DIA layout."""
     if not _slab_eligible(A):
         return None
     qf, qc, qb = _ps.smooth_quota_rows(A.dia_offsets, A.num_rows)
     k, rows_pad, _ = A.dia_vals.shape
     src = A.dia_vals[:, :qc] if rows_pad >= qc else jnp.pad(
         A.dia_vals, ((0, 0), (0, qc - rows_pad), (0, 0)))
+    if dtype is not None:
+        src = src.astype(dtype)
     out = {"vals_q": jnp.pad(src, ((0, 0), (qf, qb), (0, 0)))}
     if dinv is not None:
-        d = jnp.zeros((qc * _ps.LANES,), dinv.dtype)
-        d = jax.lax.dynamic_update_slice(d, dinv, (0,))
+        dt = dinv.dtype if dtype is None else dtype
+        d = jnp.zeros((qc * _ps.LANES,), dt)
+        d = jax.lax.dynamic_update_slice(d, dinv.astype(dt), (0,))
         out["dinv_q"] = jnp.pad(d.reshape(qc, _ps.LANES),
                                 ((qf, qb), (0, 0)))
     return out
@@ -91,18 +98,38 @@ def solver_fused_slabs(solver, A, dinv=None):
     is the identity of the value-carrying arrays, so a resetup (full or
     value-only splice) that swaps in new coefficients rebuilds the
     slabs and the solve-data contract (fresh leaves after a value
-    change) holds."""
+    change) holds. `solver._slab_dtype` (set by the hierarchy from the
+    precision policy when the smoother attaches to a level) emits the
+    slabs directly in the effective precision."""
     if not fused_runtime_on() or not _slab_eligible(A):
         return None
+    dtype = getattr(solver, "_slab_dtype", None)
     memo = getattr(solver, "_fused_slab_memo", None)
     # the memo RETAINS the source arrays and compares by `is`: a key of
     # bare id()s could alias a freed-then-reallocated array address and
     # silently serve slabs built from the previous coefficients
-    if memo is not None and memo[0] is A.dia_vals and memo[1] is dinv:
-        return memo[2]
-    slabs = build_fused_slabs(A, dinv)
-    solver._fused_slab_memo = (A.dia_vals, dinv, slabs)
+    if memo is not None and memo[0] is A.dia_vals and memo[1] is dinv \
+            and memo[2] == dtype:
+        return memo[3]
+    slabs = build_fused_slabs(A, dinv, dtype=dtype)
+    solver._fused_slab_memo = (A.dia_vals, dinv, dtype, slabs)
     return slabs
+
+
+def _fused_dtype_ok(A, x_dtype) -> bool:
+    """Dtype gate that COUNTS its declines: a level carrying a fused
+    payload whose effective dtype is off the kernel whitelist is the
+    exact silent reroute that used to drop `amg_precision=bfloat16`
+    configs back to the unfused composition with no trace. Returns
+    True when the dtype is fine; False — after counting
+    `fusion.declined_dtype` (trace-time host work only) — when the
+    caller must fall back. SolveReport's kernel-activity table
+    surfaces the same routing per level."""
+    if _ps.smooth_dtype_ok(A, x_dtype):
+        return True
+    from ..telemetry import metrics as _tm
+    _tm.inc("fusion.declined_dtype")
+    return False
 
 
 # ---------------------------------------------------------------------------
@@ -218,6 +245,8 @@ def dia_fused_smooth(A, fused, b, x, taus, dinv=None,
     n_steps = int(taus.shape[0])
     if n_steps < 1:
         return None
+    if not _fused_dtype_ok(A, x.dtype):
+        return None
     sup = functools.partial(_ps.dia_smooth_supported, A, x.dtype)
     if sup(n_steps, with_residual):
         return _dia_call(A, fused, taus, b, x, dinv, with_residual)
@@ -265,7 +294,9 @@ def _fused_swell_fn(has_dinv: bool):
         upd = tau * (b - swell_spmv_xla(A, x))
         if dinv is not None:
             upd = upd * dinv
-        return x + upd
+        # round back to the vector dtype: bf16 states with f32 taus
+        # would otherwise drift the state dtype across sweeps
+        return (x + upd).astype(x.dtype)
 
     if has_dinv:
         @jax.custom_batching.custom_vmap
@@ -338,14 +369,17 @@ def fused_smooth(data, b, x, taus, dinv=None, with_residual=True):
     per shard instead of a full halo exchange per sweep."""
     A = data["A"]
     from ..matrix import CsrMatrix
+    # taus carry at the ACCUMULATION dtype (f32 for bf16 operands):
+    # a bf16-rounded damping schedule would waste precision the f32
+    # in-kernel arithmetic keeps; identity for f32/f64 vectors
+    taus = jnp.asarray(taus, _ps.compute_dtype(x.dtype))
     if not isinstance(A, CsrMatrix) or A.is_block:
         fd = data.get("dist_fused")
         if fd is not None:
             from ..distributed.fused import dist_fused_smooth
-            return dist_fused_smooth(fd, b, x, jnp.asarray(taus, x.dtype),
-                                     dinv, with_residual)
+            return dist_fused_smooth(fd, b, x, taus, dinv,
+                                     with_residual)
         return None
-    taus = jnp.asarray(taus, x.dtype)
     out = dia_fused_smooth(A, data.get("fused"), b, x, taus, dinv,
                            with_residual)
     if out is not None:
@@ -455,11 +489,13 @@ def build_transfer_slabs(A, agg, nc: int):
                              bases, int(nc), ncr, m, windows)
 
 
-def build_csr_transfer_slabs(A, P, R):
+def build_csr_transfer_slabs(A, P, R, dtype=None):
     """WEIGHTED row-segment transfer payloads for the fused
     grid-transfer kernels over general CSR interpolation (classical
-    Ruge-Stuben levels; host numpy build, one device upload). The
-    aggregation slabs generalize entrywise:
+    Ruge-Stuben levels; host numpy build, one device upload). `dtype`
+    emits the weight slabs (cwt/pwt) in the hierarchy's effective
+    precision (precision.py) — the index tables stay int32 either way.
+    The aggregation slabs generalize entrywise:
 
     - restriction (R = P^T, nc x n): ctab[j][c] = fine slot of R row
       c's j-th entry (-1 absent), cwt[j][c] = its weight — the kernel
@@ -546,6 +582,11 @@ def build_csr_transfer_slabs(A, P, R):
         return None
     wavg = max(1, -(-int(rlen.sum()) // max(nc, 1)))
     pavg = max(1, -(-int(plen.sum()) // max(n, 1)))
+    if dtype is not None:
+        # numpy-side cast (ml_dtypes covers bfloat16): the weight
+        # slabs upload already-narrow, no full-precision twin
+        cwt = cwt.astype(jnp.dtype(dtype))
+        pwt = pwt.astype(jnp.dtype(dtype))
     return _ps.TransferSlabs(
         jnp.asarray(ctab), None, bases, int(nc), ncr, m, windows,
         cwt=jnp.asarray(cwt), ptab=jnp.asarray(ptab),
@@ -737,9 +778,11 @@ def fused_smooth_restrict(data, b, x, taus, xfer, dinv=None):
     if ready is None:
         return None
     A, fused = ready
-    taus = jnp.asarray(taus, x.dtype)
+    taus = jnp.asarray(taus, _ps.compute_dtype(x.dtype))
     n_steps = int(taus.shape[0])
     if n_steps < 1:
+        return None
+    if not _fused_dtype_ok(A, x.dtype):
         return None
     sup_r = functools.partial(_ps.dia_restrict_supported, A, x.dtype,
                               xfer=xfer)
@@ -768,9 +811,11 @@ def fused_corr_smooth(data, b, x, xc, taus, xfer, dinv=None):
     if ready is None:
         return None
     A, fused = ready
-    taus = jnp.asarray(taus, x.dtype)
+    taus = jnp.asarray(taus, _ps.compute_dtype(x.dtype))
     n_steps = int(taus.shape[0])
     if n_steps < 1:
+        return None
+    if not _fused_dtype_ok(A, x.dtype):
         return None
     sup_p = functools.partial(_ps.dia_prolong_supported, A, x.dtype,
                               xfer=xfer)
@@ -848,7 +893,7 @@ def coarse_tail_cycle(amg, shape: str, data, lvl: int, b, x):
     together."""
     if shape not in ("V", "W", "F") or not fused_runtime_on():
         return None
-    if x.dtype != jnp.float32:
+    if jnp.dtype(x.dtype).name not in _ps.SMOOTH_DTYPES:
         return None
     levels = amg.levels
     nlv = len(levels)
@@ -875,18 +920,18 @@ def coarse_tail_cycle(amg, shape: str, data, lvl: int, b, x):
             return None
         fused = smd.get("fused")
         A = ld["A"]
-        if fused is None or getattr(A, "dia_vals", None) is None \
-                or A.dia_vals.dtype != jnp.float32:
+        if fused is None or not _ps.smooth_dtype_ok(A, x.dtype):
             return None
         spec_fn = getattr(lv.smoother, "fused_tail_spec", None)
         if spec_fn is None:
             return None
-        pre = spec_fn(smd, amg._sweeps(i, pre=True), x.dtype)
-        post = spec_fn(smd, amg._sweeps(i, pre=False), x.dtype)
+        cdt = _ps.compute_dtype(x.dtype)
+        pre = spec_fn(smd, amg._sweeps(i, pre=True), cdt)
+        post = spec_fn(smd, amg._sweeps(i, pre=False), cdt)
         if pre is None or post is None:
             return None
-        taus_pre, n_pre = _tail_taus(pre[0], x.dtype)
-        taus_post, n_post = _tail_taus(post[0], x.dtype)
+        taus_pre, n_pre = _tail_taus(pre[0], cdt)
+        taus_post, n_post = _tail_taus(post[0], cdt)
         dinv = pre[1]
         offsets = A.dia_offsets
         qf, qc, _ = _ps.smooth_quota_rows(offsets, A.num_rows)

@@ -1,0 +1,142 @@
+"""Shared precision policy: one resolver for every precision knob.
+
+The reference is precision-mode templated end to end
+(`TemplateConfig<MemSpace, VecPrec, MatPrec, IndPrec>`, PAPER.md §1)
+with mixed modes as first-class products; this port grew three knobs
+that used to guess about each other:
+
+- ``solve_precision`` (NEW, default unset ``""``) — the user-facing
+  solve-phase knob: the precision the inner multigrid cycle streams
+  its operands at (``double`` = native/full, ``float`` = f32,
+  ``bfloat16`` = bf16 slabs with f32 in-kernel accumulation). Setting
+  it also turns on per-precision iteration accounting in the
+  REFINEMENT defect-correction shell (``SolveReport.precision``).
+  Unset is bitwise-off: the emitted jaxpr is identical to a build
+  without the knob.
+- ``amg_precision`` — the hierarchy-level spelling of the same
+  quantity (precision of the stored AMG operators + cycle). Still
+  works standalone; when ``solve_precision`` is also set the two must
+  agree or configuration fails up front.
+- ``tpu_dtype`` — legacy compute-dtype override (``float32`` /
+  ``float64`` / ``bfloat16``); previously registered but read by
+  nothing. It now resolves through this policy as an alias
+  (``float64`` -> ``double``, ``float32`` -> ``float``) and
+  contradictions with the other two knobs are rejected.
+
+Ownership matrix (highest priority first):
+
+    solve_precision   solve-phase effective precision + REFINEMENT
+                      per-precision accounting
+    tpu_dtype         legacy alias for the same effective precision
+    amg_precision     hierarchy/cycle precision when the above are
+                      unset
+
+Invariants the policy enforces regardless of knob:
+
+- reductions, convergence checks and the Krylov outer loops stay f32+
+  (the monitor computes norms in the caller's dtype, never bf16);
+- the DENSE_LU coarse tail stays f32+: a ``bfloat16`` hierarchy keeps
+  its coarse-solver payload (QR factors, dense inverse) at f32 and
+  the cycle upcasts the coarse rhs around the coarse solve
+  (``amg/cycles.py _coarse_solve``);
+- REFINEMENT's inner Krylov operator stays f32 (flexible Krylov
+  tolerates a reduced-precision preconditioner; a bf16 Krylov basis
+  would not converge) — ``bfloat16`` applies to the AMG cycle below
+  it, with the f64 outer defect-correction loop restoring full
+  accuracy.
+
+Known gap: the host-built-and-shipped hierarchy path (remote
+accelerators with ``amg_host_setup``) casts every shipped leaf to the
+hierarchy precision, coarse payload included — the f32+ coarse rule
+applies to the device-resident setup paths benchmarks and serving use.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .errors import BadConfigurationError
+
+# knob value -> solve-data cast dtype name (None = no cast / native)
+PRECISION_DTYPES = {"double": None, "float": "float32",
+                    "bfloat16": "bfloat16"}
+
+# legacy tpu_dtype spellings -> precision names
+_TPU_DTYPE_ALIASES = {"float64": "double", "float32": "float",
+                      "bfloat16": "bfloat16"}
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """Resolved precision decision for one solver/hierarchy scope."""
+
+    name: str               # effective precision: double|float|bfloat16
+    source: str             # knob that decided: solve_precision|
+    #                         tpu_dtype|amg_precision|default
+    solve_precision: str    # the raw solve_precision knob ("" = unset)
+
+    @property
+    def active(self) -> bool:
+        """Was the solve_precision knob set at all? Gates everything
+        that must be bitwise-off by default (REFINEMENT's in-state
+        inner-iteration accounting)."""
+        return self.solve_precision != ""
+
+    @property
+    def cast_dtype(self) -> Optional[str]:
+        """Solve-data cast dtype for hierarchy LEVELS (operand slabs,
+        transfer weights, smoother payloads); None = leave native."""
+        return PRECISION_DTYPES[self.name]
+
+    @property
+    def coarse_dtype(self) -> Optional[str]:
+        """Solve-data cast dtype for the COARSE-solver subtree:
+        f32+ always — the dense factorization/back-substitution and
+        the K-cycle coarse matvec never run below f32."""
+        c = self.cast_dtype
+        return "float32" if c == "bfloat16" else c
+
+
+def _explicit(cfg, name: str, scope: str):
+    """The explicitly-set value of a knob (scoped lookup, no registered
+    default), or None when the config never set it."""
+    for s in (scope, "default"):
+        if (s, name) in cfg.values:
+            return cfg.values[(s, name)]
+    return None
+
+
+def resolve_precision(cfg, scope: str = "default") -> PrecisionPolicy:
+    """Resolve the three precision knobs into one PrecisionPolicy.
+
+    Raises BadConfigurationError when two explicitly-set knobs name
+    different precisions — a config that says both is guessing, and
+    the old behavior (each consumer reading its own knob) silently
+    honored whichever one the code path happened to read.
+    """
+    sp = str(cfg.get("solve_precision", scope))
+    td_raw = _explicit(cfg, "tpu_dtype", scope)
+    ap_raw = _explicit(cfg, "amg_precision", scope)
+
+    claims = []
+    if sp:
+        claims.append(("solve_precision", sp))
+    if td_raw:
+        claims.append(("tpu_dtype", _TPU_DTYPE_ALIASES[str(td_raw)]))
+    if ap_raw is not None:
+        claims.append(("amg_precision", str(ap_raw)))
+
+    names = {c[1] for c in claims}
+    if len(names) > 1:
+        detail = ", ".join(f"{k}={v!r}" for k, v in claims)
+        raise BadConfigurationError(
+            f"contradictory precision knobs: {detail}. One precision "
+            f"owns the solve: set solve_precision alone (it implies "
+            f"the hierarchy precision), or make the knobs agree — "
+            f"see the README precision-modes knob matrix")
+    if claims:
+        source, name = claims[0]
+    else:
+        # nothing explicit: the registered amg_precision default
+        source, name = "default", str(cfg.get("amg_precision", scope))
+    return PrecisionPolicy(name=name, source=source, solve_precision=sp)

@@ -109,6 +109,11 @@ class SolveResult:
     # by the solve paths when the `telemetry` knob is on; built
     # host-side from the stats already transferred, zero added syncs
     report: Optional[Any] = None
+    # solver-specific scalar stats packed onto the stats vector
+    # (Solver._extra_stats_spec; e.g. REFINEMENT's accumulated inner
+    # iteration count under an active solve_precision policy). None
+    # when the solver declared none — the packed layout is unchanged
+    extra_stats: Optional[Dict[str, float]] = None
 
     def __post_init__(self):
         if self.converged:
@@ -185,6 +190,12 @@ class Solver:
         # solves through Solver::solve which re-scales per level —
         # consistent but redundant; here the scaled system is built once.)
         self._owns_scaling = True
+        # shared precision policy (precision.py): resolves
+        # solve_precision/amg_precision/tpu_dtype and rejects
+        # contradictory combinations at construction time. Unset is
+        # bitwise-off — nothing below reads it unless .active
+        from ..precision import resolve_precision
+        self._precision_policy = resolve_precision(cfg, scope)
         conv_name = str(cfg.get("convergence", scope))
         self.convergence: Convergence = registry.convergence.create(
             conv_name, cfg, scope)
@@ -451,6 +462,36 @@ class Solver:
             s = s.preconditioner
         return None
 
+    def _extra_stats_spec(self) -> tuple:
+        """Names of solver-specific SCALARS appended to the packed
+        stats vector, in order (after res_hist, before the diagnostics
+        probe tail). Default empty: the packed layout — and therefore
+        every traced solve program — is unchanged. REFINEMENT declares
+        ("inner_iters",) when the solve_precision policy is active so
+        per-precision iteration counts reach SolveReport with zero
+        extra device->host transfers (they ride the stats buffer)."""
+        return ()
+
+    def _extra_stats(self, final_state) -> tuple:
+        """The scalar values matching _extra_stats_spec, read from the
+        final while_loop state."""
+        return ()
+
+    def _precision_block(self, res) -> Optional[Dict[str, Any]]:
+        """SolveReport.precision payload, or None when the
+        solve_precision policy is inactive (the bitwise-off default).
+        Subclasses with per-precision accounting (REFINEMENT) extend
+        the base block with inner-loop counts."""
+        pol = getattr(self, "_precision_policy", None)
+        if pol is None or not pol.active:
+            return None
+        return {
+            "solve_precision": pol.name,
+            "cycle_dtype": pol.cast_dtype or "native",
+            "outer_dtype": None if self.A is None else str(self.A.dtype),
+            "outer_iterations": int(res.iterations),
+        }
+
     def computes_residual(self) -> bool:
         """True when solve_iteration maintains state['r'] itself; else the
         driver recomputes r = b - Ax for monitoring."""
@@ -628,6 +669,17 @@ class Solver:
                 jnp.ravel(jnp.asarray(norm0)),
                 jnp.ravel(jnp.asarray(final["res_norm"])),
                 jnp.ravel(jnp.asarray(final["res_hist"]))]
+            # solver-declared extra scalars (e.g. REFINEMENT's inner
+            # iteration count under an active solve_precision policy)
+            # ride the same packed buffer — zero added transfers; the
+            # spec is empty by default so the layout is unchanged.
+            # Gated on `diag` exactly like the probe tail: the batched
+            # / distributed / inner-fn consumers (diag=False) unpack
+            # the BARE stats layout
+            if diag:
+                for v in self._extra_stats(final):
+                    pieces.append(jnp.reshape(
+                        jnp.asarray(v).astype(rdt), (1,)))
             if diag_spec is not None:
                 # diagnostics probe: one instrumented cycle on the
                 # residual equation A d = r_final, appended INSIDE the
@@ -860,6 +912,14 @@ class Solver:
             if dlen:
                 diag_raw = stats[stats.size - dlen:]
                 stats = stats[:stats.size - dlen]
+        # solver-declared extras sit just before the diagnostics tail;
+        # strip by the same spec the trace packed them with
+        extra_names = self._extra_stats_spec()
+        extras = None
+        if extra_names:
+            raw = stats[stats.size - len(extra_names):]
+            stats = stats[:stats.size - len(extra_names)]
+            extras = {k: float(v) for k, v in zip(extra_names, raw)}
         iters_i, converged, status, norm0, res_norm, hist = \
             self.unpack_stats(stats, self.max_iters + 1)
         res = SolveResult(
@@ -868,7 +928,7 @@ class Solver:
             res_history=np.asarray(hist)
             if self.store_res_history else None,
             setup_time=self.setup_time, solve_time=solve_time,
-            status_code=status)
+            status_code=status, extra_stats=extras)
         if self.telemetry:
             # structured report (telemetry/report.py): built from the
             # stats numpy already unpacked above + static hierarchy
@@ -882,7 +942,8 @@ class Solver:
                     diag_raw, len(diag_spec[0].levels),
                     res_hist=np.asarray(hist))
             res.report = build_report(self, res, hist=np.asarray(hist),
-                                      diagnostics=diag_struct)
+                                      diagnostics=diag_struct,
+                                      precision=self._precision_block(res))
             _tm.max_gauge("memory.solve_peak_bytes", peak_bytes())
         if self.print_solve_stats:
             self._print_stats(res, np.asarray(hist))

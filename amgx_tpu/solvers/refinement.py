@@ -25,6 +25,19 @@ solver, src/core.cu:381-388):
 
     solver=REFINEMENT, tolerance=1e-10, preconditioner(in)=FGMRES,
     in:tolerance=1e-6, in:preconditioner(amg)=AMG, ...
+
+With `solve_precision=bfloat16` this loop is the f64-RESTORING outer
+shell of the mixed-precision fused path: the AMG cycle below streams
+bf16 operand slabs (f32 in-kernel accumulation, ops/pallas_spmv.py),
+the inner Krylov stays f32 (a bf16 Krylov basis would not converge —
+flexible Krylov tolerates the reduced-precision preconditioner), and
+the outer f64 defect still drives convergence to the requested
+tolerance. When the policy is active the driver also accumulates the
+INNER iteration count in the while_loop state and packs it onto the
+stats vector (zero extra transfers), so `SolveReport.precision`
+records per-precision iteration counts — the accuracy/work trade is
+measured, not folklore. Unset solve_precision is bitwise-off: no
+extra state leaf, jaxpr-identical to the pre-knob build.
 """
 from __future__ import annotations
 
@@ -74,13 +87,48 @@ class RefinementSolver(Solver):
     def computes_residual(self):
         return True
 
+    def solve_init(self, data, b, x0, r0):
+        st = super().solve_init(data, b, x0, r0)
+        if self._precision_policy.active:
+            # per-precision accounting: the accumulated inner-Krylov
+            # iteration count rides the state (and, via _extra_stats,
+            # the packed stats vector). Keyed on the policy so the
+            # default build carries no extra leaf (bitwise-off)
+            st["inner_iters"] = jnp.zeros((), jnp.float32)
+        return st
+
     def solve_iteration(self, data, b, st):
         x = st["x"]
         r = st["r"]        # f64 defect (maintained by the previous step)
         r32 = r.astype(self.inner_dtype)
-        d32, _ = self._inner_fn(data["inner"], r32, jnp.zeros_like(r32))
+        d32, istats = self._inner_fn(data["inner"], r32,
+                                     jnp.zeros_like(r32))
         x = x + d32.astype(x.dtype)
         out = dict(st)
         out["x"] = x
         out["r"] = residual(data["A"], x, b)             # true f64 residual
+        if "inner_iters" in st:
+            # istats[0] is the inner fn's iteration count (the packed
+            # stats layout _build_solve_fn emits)
+            out["inner_iters"] = st["inner_iters"] + \
+                istats[0].astype(jnp.float32)
         return out
+
+    # -- per-precision accounting (solve_precision policy) --------------
+    def _extra_stats_spec(self):
+        return ("inner_iters",) if self._precision_policy.active else ()
+
+    def _extra_stats(self, final_state):
+        if "inner_iters" not in final_state:
+            return ()
+        return (final_state["inner_iters"],)
+
+    def _precision_block(self, res):
+        block = super()._precision_block(res)
+        if block is None:
+            return None
+        block["inner_dtype"] = str(jnp.dtype(self.inner_dtype).name)
+        if res.extra_stats is not None:
+            block["inner_iterations"] = int(round(
+                res.extra_stats.get("inner_iters", 0.0)))
+        return block

@@ -405,6 +405,16 @@ declare_counter("amg.selector.device_sweep",
                 "independent-set sweep instead of the host-serial "
                 "bucket queue (selector_device_sweep routing)")
 
+# fused-kernel routing (ops/smooth.py): a level that CARRIES a fused
+# payload but falls off the fused path is a silent 2x HBM regression —
+# the decline is counted at trace time and SolveReport's kernel-
+# activity table records the per-level routing + effective dtype
+declare_counter("fusion.declined_dtype",
+                "fused-kernel dispatches declined because the operand "
+                "dtype is off the kernel whitelist (ops/pallas_spmv.py "
+                "SMOOTH_DTYPES) — the config fell back to the unfused "
+                "composition; see SolveReport levels[].fused_routing")
+
 # GEO Galerkin CSR-structure device cache (amg/aggregation/galerkin.py):
 # a miss at 256^3 re-uploads ~1 GB of structure arrays per warm setup
 declare_counter("amg.geo_struct_cache.hit",

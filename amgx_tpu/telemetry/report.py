@@ -81,6 +81,10 @@ class SolveReport:
     # effectiveness, bottleneck-level attribution, asymptotic
     # convergence factor
     diagnostics: Optional[Dict[str, Any]] = None
+    # per-precision accounting (precision.py solve_precision policy):
+    # effective cycle dtype + outer/inner iteration counts — present
+    # only when the solve_precision knob is set (None = knob unset)
+    precision: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -141,9 +145,27 @@ def _nnz_of(A) -> Optional[int]:
     return int(np.shape(v)[0]) if v is not None else None
 
 
+def _effective_dtype(amg, A) -> Optional[str]:
+    """The dtype this level's operands STREAM at during the solve:
+    the hierarchy's precision-policy cast when one applies, else the
+    matrix's native dtype. Host metadata only."""
+    eff = amg._PRECISIONS.get(getattr(amg, "precision", "double"))
+    if eff is not None:
+        return eff
+    v = getattr(A, "values", None)
+    if v is not None:
+        return str(v.dtype)
+    dv = getattr(A, "dia_vals", None)
+    return str(dv.dtype) if dv is not None else None
+
+
 def _level_table(amg):
     """Per-level static activity table: rows/nnz/layout plus which
-    kernel form the cycle runs this level through. Everything reads
+    kernel form the cycle runs this level through — including the
+    EFFECTIVE operand dtype and the fused-vs-unfused routing verdict
+    (`fused_routing`), so a config that falls off the fused path
+    (e.g. a dtype the kernel whitelist declines) is visible in one
+    report read instead of silently rerouting. Everything reads
     object metadata and payloads memoized at setup — no device work.
     A hierarchy in an unexpected state (sharded build, partially
     stripped) degrades to the bare rows/layout columns.
@@ -152,6 +174,7 @@ def _level_table(amg):
     changes only when the level list is rebuilt (setup / structure
     resetup — a NEW list object) or the tail boundary is first
     recorded; per-solve report construction then costs a list copy."""
+    from ..ops.pallas_spmv import SMOOTH_DTYPES
     levels = getattr(amg, "levels", None) or []
     tail0 = getattr(amg, "_tail_entry_level", None)
     key = (id(levels), len(levels), tail0)
@@ -177,11 +200,24 @@ def _level_table(amg):
         fused_xf = bool(isinstance(ld, dict) and "xfer" in ld)
         row["fused_smoother"] = fused_sm
         row["fused_transfers"] = fused_xf
+        edt = _effective_dtype(amg, A)
+        row["dtype"] = edt
+        dtype_ok = edt in SMOOTH_DTYPES
+        if not fused_sm:
+            row["fused_routing"] = "unfused"
+        elif dtype_ok:
+            row["fused_routing"] = "fused"
+        else:
+            # payload built but the kernel dtype gate declines: the
+            # cycle composes unfused (counted fusion.declined_dtype
+            # at trace time by ops/smooth.py)
+            row["fused_routing"] = "declined_dtype"
         # a fully fused aggregation/DIA level does its whole per-visit
         # cycle work (presmooth+restrict, prolong+postsmooth) in
         # exactly two pallas_calls (PR 5); levels inside the VMEM
         # coarse tail run in the tail's single kernel instead
-        row["kernels_per_visit"] = 2 if (fused_sm and fused_xf) else None
+        row["kernels_per_visit"] = 2 if (fused_sm and fused_xf
+                                         and dtype_ok) else None
         rows.append(row)
     coarsest = getattr(amg, "coarsest_A", None)
     if coarsest is not None and levels:
@@ -215,7 +251,8 @@ def _scalar(v):
 
 def build_report(solver, result, hist=None,
                  distributed: Optional[Dict[str, Any]] = None,
-                 diagnostics: Optional[Dict[str, Any]] = None
+                 diagnostics: Optional[Dict[str, Any]] = None,
+                 precision: Optional[Dict[str, Any]] = None
                  ) -> SolveReport:
     """Assemble a SolveReport from a finished SolveResult-shaped record
     and the solver tree's static metadata. `hist` overrides the
@@ -260,6 +297,7 @@ def build_report(solver, result, hist=None,
         distributed=distributed,
         hierarchy=hierarchy,
         diagnostics=diagnostics,
+        precision=precision,
     )
 
 

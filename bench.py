@@ -553,6 +553,61 @@ def bench_flagship(n: int = 128, tolerance: str = "1e-8", reps: int = 3,
     }
 
 
+def bench_precision(n: int = 128, reps: int = 3):
+    """Mixed-precision phase (`python bench.py precision`): the
+    flagship replayed PAIRED at solve_precision=float vs bfloat16 on
+    the same system — same REFINEMENT(f64) outer shell, same FGMRES
+    inner, only the AMG cycle's operand-slab precision differs (bf16
+    slabs stream half the HBM bytes through the fused kernels with
+    f32 in-kernel accumulation). Records the per-precision walls, the
+    `mixed_precision_speedup` ratio, per-precision iteration counts
+    (SolveReport.precision: f64 outer + f32-Krylov inner), and the
+    matched-final-residual gate — the bf16 run must still reach the
+    f64 relative tolerance, or the speedup is not comparable."""
+    # the gate below must track the preset's tolerance (same drift
+    # guard as bench_flagship's replace-target assert)
+    assert "tolerance=1e-8" in FLAGSHIP, \
+        "FLAGSHIP tolerance literal drifted; update bench_precision's " \
+        "matched-residual gate"
+    tol = 1e-8
+    A = amgx.gallery.poisson("7pt", n, n, n).init()
+    b = jnp.ones(A.num_rows)
+    out = {}
+    walls = {}
+    for prec in ("float", "bfloat16"):
+        slv = amgx.create_solver(Config.from_string(
+            FLAGSHIP + f", solve_precision={prec}"))
+        slv.setup(A)
+        res = slv.solve(b)                     # compile
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            res = slv.solve(b)
+            times.append(time.perf_counter() - t0)
+        wall = sorted(times)[len(times) // 2]
+        walls[prec] = wall
+        rel = float(np.max(np.asarray(res.res_norm))
+                    / max(np.max(np.asarray(res.norm0)), 1e-300))
+        pb = (res.report.precision if res.report is not None
+              else None) or {}
+        tag = "bf16" if prec == "bfloat16" else prec
+        out[f"solve_{tag}_s"] = round(wall, 4)
+        out[f"outer_iters_{tag}"] = int(res.iterations)
+        out[f"inner_iters_{tag}"] = pb.get("inner_iterations")
+        out[f"true_rel_residual_{tag}"] = rel
+        out[f"converged_{tag}"] = bool(res.converged)
+        out[f"precision_report_{tag}"] = pb
+        del slv
+    out["mixed_precision_speedup"] = round(
+        walls["float"] / max(walls["bfloat16"], 1e-9), 3)
+    # matched-residual gate: both precisions reach the flagship's
+    # relative tolerance, so the speedup compares equal-quality answers
+    out["matched_residuals_ok"] = bool(
+        out["converged_float"] and out["converged_bf16"]
+        and out["true_rel_residual_bf16"] <= tol)
+    return out
+
+
 def bench_setup(grids=(64, 128)):
     """Setup-only CI phase (`python bench.py setup`): warm hierarchy
     build of the flagship configuration per grid, reporting throughput
@@ -1686,6 +1741,32 @@ def main():
     _checkpoint(metric=metric, value=value, unit=unit,
                 error="incomplete: north-star phase still pending")
 
+    # mixed-precision phase: the flagship paired-replayed at
+    # solve_precision=float vs bfloat16 (ROADMAP item 5: bf16 operand
+    # slabs through the fused kernels inside the f64 refinement
+    # shell); sentinel-tracked via flagship_128^3_solve_bf16_s +
+    # mixed_precision_speedup
+    try:
+        old = signal.signal(signal.SIGALRM, _on_alarm)
+        signal.alarm(420)
+        try:
+            mp = bench_precision(reps=3)
+            extra["precision"] = mp
+            extra["flagship_128^3_solve_bf16_s"] = mp["solve_bf16_s"]
+            extra["mixed_precision_speedup"] = \
+                mp["mixed_precision_speedup"]
+            extra["mixed_precision_matched_residuals"] = \
+                mp["matched_residuals_ok"]
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)
+    except _Budget:  # pragma: no cover - timing dependent
+        extra["precision_error"] = "wall-clock budget exceeded"
+    except Exception as e:  # pragma: no cover - bench robustness
+        extra["precision_error"] = str(e)[:200]
+    _checkpoint()
+    gc.collect()
+
     # the 256^3 north star (BASELINE.md headline). Solo phase cost with
     # a cold compile cache is ~500 s (gallery + one cold setup + the
     # fused-resetup trace); warm-cache runs are far cheaper. light mode
@@ -1781,6 +1862,22 @@ if __name__ == "__main__":
             "value": headline,
             "unit": "x",
             "vs_baseline": res.get("dia", {}).get("vs_ceiling", 0.0),
+            "extra": res,
+        }), flush=True)
+    elif sys.argv[1:2] == ["precision"]:
+        # standalone mixed-precision phase: `python bench.py precision`
+        # (optionally `--smoke` at 32^3 for a fast functional check) —
+        # flagship paired replay at solve_precision=float vs bfloat16
+        amgx.initialize()
+        smoke = "--smoke" in sys.argv[2:]
+        res = bench_precision(n=32 if smoke else 128,
+                              reps=3 if smoke else 5)
+        print(json.dumps({
+            "metric": "flagship solve_precision float/bfloat16 "
+                      "paired-replay speedup",
+            "value": res.get("mixed_precision_speedup", -1.0),
+            "unit": "x",
+            "vs_baseline": 0.0,
             "extra": res,
         }), flush=True)
     elif sys.argv[1:] == ["obs"]:

@@ -93,6 +93,16 @@ SERIES: Tuple[Tuple[str, str, float, str], ...] = (
     ("classical_128^3_solve_s", "lower", 0.40,
      "classical 128^3 solve wall (s), fused-classical era — the "
      "24x-gap tentpole's solve target (< 2 s)"),
+    # ISSUE 14 mixed-precision headline: recorded from r06 on (the
+    # bf16 fused path lands between r05 and r06). ROADMAP item 5's TPU
+    # targets live here: flagship bf16 solve <= 0.18 s, northstar 256^3
+    # solve <= 1.9 s at matched final residuals
+    ("flagship_128^3_solve_bf16_s", "lower", 0.35,
+     "flagship 128^3 solve wall at solve_precision=bfloat16 (s) — "
+     "mixed-precision era; target <= 0.18 s on TPU"),
+    ("mixed_precision_speedup", "higher", 0.25,
+     "flagship solve wall ratio float/bfloat16, paired replay on one "
+     "system at matched final residuals (x)"),
     ("spmv_vs_ceiling", "higher", 0.50,
      "DIA SpMV achieved bandwidth vs the rig's streaming ceiling "
      "(tunnel bandwidth swings ~2x run to run — r02-r04 recorded "
