@@ -83,20 +83,50 @@ class AggregationAMGLevel(AMGLevel):
         return geo_shapes(self.geo_fine_shape, self.geo_axes)
 
     def create_coarse_matrix(self) -> CsrMatrix:
+        from ...ops import spgemm
         from ...profiling import trace_region
         k = self.level_index
+        planned = spgemm.plan_enabled(self.cfg, self.scope)
         if self.geo_axes is not None:
-            from .galerkin import geo_assemble_dia, geo_coarse_values
-            with trace_region(f"amg.L{k}.galerkin"):
-                pre = geo_coarse_values(self.A, self.geo_fine_shape,
+            if planned:
+                # planned GEO route: the memoized GeoRapPlan skips
+                # every symbolic step; the numeric phase is one jitted
+                # program feeding geo_assemble_dia's output shape next
+                # to the device-structure cache
+                from .galerkin import get_geo_plan
+                with trace_region(f"amg.L{k}.rap_plan"):
+                    plan = get_geo_plan(self.A, self.geo_fine_shape,
                                         self.geo_axes,
                                         self.geo_coarse_shape)
-            if pre is not None:     # structured sort-free Galerkin
-                # the DIA pack is the coarse operator's LAYOUT build —
-                # timed as such, not hidden inside the galerkin bucket
-                with trace_region(f"amg.L{k}.layout"):
-                    return geo_assemble_dia(pre[0], pre[1],
+                if plan is not None:
+                    with trace_region(f"amg.L{k}.rap_values"):
+                        Ac = plan.coarse_matrix(self.A)
+                    if Ac is not None:
+                        self._geo_plan_memo = (plan,)
+                        return Ac
+            else:
+                from .galerkin import (geo_assemble_dia,
+                                       geo_coarse_values)
+                with trace_region(f"amg.L{k}.galerkin"):
+                    pre = geo_coarse_values(self.A,
+                                            self.geo_fine_shape,
+                                            self.geo_axes,
                                             self.geo_coarse_shape)
+                if pre is not None:     # structured sort-free Galerkin
+                    # the DIA pack is the coarse operator's LAYOUT
+                    # build — timed as such, not hidden inside the
+                    # galerkin bucket
+                    with trace_region(f"amg.L{k}.layout"):
+                        return geo_assemble_dia(pre[0], pre[1],
+                                                self.geo_coarse_shape)
+        if planned and not self.A.is_block \
+                and self.aggregates is not None:
+            Ac = self._relabel_planned(k)
+            if Ac is not None:
+                if self.geo_coarse_shape is not None:
+                    Ac = dataclasses.replace(
+                        Ac, grid_shape=self.geo_coarse_shape)
+                return Ac
         with trace_region(f"amg.L{k}.galerkin"):
             Ac = coarse_a_from_aggregates(self.A, self.aggregates,
                                           self.coarse_size)
@@ -104,14 +134,52 @@ class AggregationAMGLevel(AMGLevel):
             Ac = dataclasses.replace(Ac, grid_shape=self.geo_coarse_shape)
         return Ac
 
+    def _relabel_planned(self, k: int):
+        """Plan-split relabel Galerkin: structure memoized on the level
+        (carried across structure resetups — the aggregates map is the
+        pattern) with the digest cache catching warm full setups of
+        the same pattern; value phase through ops/spgemm.rap_values."""
+        from ...ops import spgemm
+        from ...profiling import trace_region
+        plan = None
+        # pattern proven by IDENTITY of A's structure arrays (retained
+        # in the memo) — a same-nnz permuted pattern misses and takes
+        # the content-keyed digest cache instead (see the classical
+        # twin for the full rationale)
+        memo = getattr(self, "_rap_plan_memo", None)
+        if memo is not None and memo[0] is self.aggregates \
+                and memo[1] is self.A.row_offsets \
+                and memo[2] is self.A.col_indices \
+                and memo[3] == self.A.has_external_diag:
+            plan = memo[4]
+        if plan is None:
+            with trace_region(f"amg.L{k}.rap_plan"):
+                plan = spgemm.get_agg_plan(self.A, self.aggregates,
+                                           self.coarse_size)
+            if plan is not None:
+                self._rap_plan_memo = (
+                    self.aggregates, self.A.row_offsets,
+                    self.A.col_indices, self.A.has_external_diag,
+                    plan)
+        if plan is None:
+            return None
+        with trace_region(f"amg.L{k}.rap_values"):
+            return spgemm.plan_coarse_matrix(plan, self.A)
+
     def reuse_structure(self, old):
         """structure_reuse_levels: keep the aggregates map; the Galerkin
-        relabel-sum then runs against the new coefficients."""
+        relabel-sum then runs against the new coefficients. The RAP
+        plans ride along (same aggregates object = same pattern), so a
+        structure resetup does zero symbolic RAP work."""
         self.aggregates = old.aggregates
         self.coarse_size = old.coarse_size
         self.geo_axes = old.geo_axes
         self.geo_fine_shape = old.geo_fine_shape
         self.geo_coarse_shape = old.geo_coarse_shape
+        for attr in ("_rap_plan_memo", "_geo_plan_memo"):
+            memo = getattr(old, attr, None)
+            if memo is not None:
+                setattr(self, attr, memo)
 
     def structure_snapshot(self):
         if self.coarse_size is None:

@@ -437,6 +437,135 @@ def geo_assemble_dia(cvals, coffsets, coarse_shape) -> CsrMatrix:
 
 
 
+# ---------------------------------------------------------------------------
+# planned GEO route (the structured fast path's RapPlan analog)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("coffsets", "contribs",
+                                             "fine_shape", "axes",
+                                             "nc"))
+def _geo_value_phase(vals, off_e, row_e, coffsets, contribs,
+                     fine_shape, axes, nc: int):
+    """The WHOLE structured-Galerkin numeric phase as one jitted
+    program: parity-masked accumulation + pair-sums (_geo_compute's
+    math), the CSR entry gather, and the tile-aligned DIA pack —
+    `geo_assemble_dia` feeds straight from this output. Shared by the
+    planned setup route AND the value-resetup plan (value_resetup.py),
+    so the first resetup hits the setup's own compile cache."""
+    from ...ops.pallas_spmv import LANES, dia_padded_rows
+    cvals = _geo_compute(vals, coffsets, contribs, fine_shape, axes)
+    values_c = cvals[off_e, row_e]
+    kc = len(coffsets)
+    rows_pad = dia_padded_rows(kc, nc)
+    dia_c = jnp.zeros((kc, rows_pad * LANES), cvals.dtype
+                      ).at[:, :nc].set(cvals).reshape(kc, rows_pad,
+                                                      LANES)
+    return values_c, dia_c
+
+
+class GeoRapPlan:
+    """Static recipe of one structured (GEO) Galerkin product: the
+    offset decomposition, contribution table and coarse CSR/DIA
+    structure, memoized once per (offsets, shapes, axes) pattern so a
+    warm setup or value resetup re-derives NOTHING — the numeric phase
+    is the one jitted `_geo_value_phase` program feeding the assembled
+    coarse operator next to the existing device-structure cache
+    (`_geo_csr_structure_device`). The plan object itself is
+    device-free; the structure arrays resolve through the bounded
+    device cache at use, so device changes can never serve stale
+    uploads."""
+
+    def __init__(self, dia_offsets, shifts, fine_shape, axes,
+                 coarse_shape):
+        self.dia_offsets = dia_offsets
+        self.shifts = shifts
+        self.fine_shape = fine_shape
+        self.axes = axes
+        self.coarse_shape = coarse_shape
+        self.coffsets, self.contribs = _geo_contrib_table(
+            dia_offsets, shifts, axes, coarse_shape)
+        self.kc = len(self.coffsets)
+        self.nc = int(np.prod(coarse_shape))
+
+    def structure(self):
+        """(row_offsets, off_e, row_e, col_e, diag_idx) device arrays
+        through the bounded GEO structure cache."""
+        return _geo_csr_structure_device(self.coffsets,
+                                         self.coarse_shape)
+
+    def values(self, vals2d):
+        """(values_c, dia_c) from the current fine DIA slab — one
+        jitted dispatch, zero symbolic work."""
+        (_ro, off_e, row_e, _col_e, _diag) = self.structure()
+        return _geo_value_phase(vals2d, off_e, row_e, self.coffsets,
+                                self.contribs, self.fine_shape,
+                                self.axes, self.nc)
+
+    def assemble(self, values_c, dia_c) -> CsrMatrix:
+        (row_offsets, _off_e, row_e, col_e, diag_idx) = self.structure()
+        return CsrMatrix(
+            row_offsets=row_offsets, col_indices=col_e,
+            values=values_c, diag=None, row_ids=row_e,
+            diag_idx=diag_idx, ell_cols=None, ell_vals=None,
+            dia_offsets=tuple(int(k[0]) for k in self.coffsets),
+            dia_vals=dia_c, num_rows=self.nc, num_cols=self.nc,
+            block_dimx=1, block_dimy=1, initialized=True,
+            grid_shape=tuple(self.coarse_shape))
+
+    def coarse_matrix(self, A: CsrMatrix):
+        """Planned numeric phase with the same wrap-check discipline
+        as `geo_coarse_values`: deferred inside a hierarchy build
+        (batched single fetch), blocking standalone. None when the
+        values violate the geometric invariant (standalone mode) —
+        the caller falls back to the relabel Galerkin."""
+        n = A.num_rows
+        vals = A.dia_vals.reshape(len(A.dia_offsets), -1)[:, :n]
+        wrapped = _any_wrapped(vals, self.shifts, self.fine_shape)
+        if _deferred.items is not None:
+            _deferred.items.append(wrapped)
+        elif bool(wrapped):
+            return None
+        values_c, dia_c = self.values(vals)
+        return self.assemble(values_c, dia_c)
+
+
+_GEO_PLAN_CACHE = {}
+_GEO_PLAN_CACHE_MAX = 256
+
+
+def get_geo_plan(A: CsrMatrix, fine_shape, axes, coarse_shape):
+    """Memoized GeoRapPlan for A's offset pattern, or None when the
+    structured fast path does not apply (non-stencil offsets, blocks,
+    a disabled fast path after a failed wrap check). Eligibility
+    mirrors `geo_coarse_values`; the wrap check — which depends on the
+    VALUES — stays in `GeoRapPlan.coarse_matrix`."""
+    from ...telemetry import metrics as _tm
+    nx, ny, nz = fine_shape
+    if A.dia_offsets is None or A.grid_shape != tuple(fine_shape) \
+            or A.is_block or _deferred.disable_fast:
+        return None
+    shifts = []
+    for d in A.dia_offsets:
+        g = _decompose(int(d), nx, ny, nz)
+        if g is None:
+            return None
+        shifts.append(g)
+    key = (tuple(int(d) for d in A.dia_offsets), tuple(fine_shape),
+           tuple(axes), tuple(coarse_shape))
+    plan = _GEO_PLAN_CACHE.get(key)
+    if plan is not None:
+        _tm.inc("amg.spgemm.plan_hit")
+        return plan
+    _tm.inc("amg.spgemm.plan_build")
+    plan = GeoRapPlan(key[0], tuple(shifts), key[1], tuple(axes),
+                      key[3])
+    _GEO_PLAN_CACHE[key] = plan
+    while len(_GEO_PLAN_CACHE) > _GEO_PLAN_CACHE_MAX:
+        del _GEO_PLAN_CACHE[next(iter(_GEO_PLAN_CACHE))]
+    return plan
+
+
 def restrict_vector(agg, nc: int, r, block_dim: int = 1):
     """b_c = R r with piecewise-constant restriction = segment-sum over
     aggregates (restrictResidualKernel analog,

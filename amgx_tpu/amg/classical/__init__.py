@@ -73,9 +73,9 @@ class ClassicalAMGLevel(AMGLevel):
         from ...profiling import trace_region
         if getattr(self, "_reused", False):
             # structure reuse: transfer operators kept, only the
-            # Galerkin product sees the new coefficients
-            with trace_region(f"amg.L{self.level_index}.rap"):
-                return galerkin_rap(self.R, self.A, self.P)
+            # Galerkin product sees the new coefficients (the RAP plan
+            # rides the reuse — zero symbolic work, value phase only)
+            return self._galerkin_rap()
         cfg, scope = self.cfg, self.scope
         interp_name = str(cfg.get(self.interpolator_param, scope))
         if self._aggressive:
@@ -105,6 +105,54 @@ class ClassicalAMGLevel(AMGLevel):
         # slab assembly and the host-ship pipeline can prefetch them
         with trace_region(f"amg.L{k}.xfer_slabs"):
             self._transfer_slabs()
+        return self._galerkin_rap()
+
+    def _galerkin_rap(self) -> CsrMatrix:
+        """RAP through the plan split (ops/spgemm.py): the structure
+        phase is memoized on the level (structure resetups carry it —
+        P/R survive with their values) and in the digest-keyed cache
+        (warm full setups of the same pattern hit it), so only the
+        VALUE phase runs per setup — through the fused kernel / slab /
+        host-reduceat route regardless of backend forcing. The plan
+        lookup precedes the host-native dispatch on purpose: a warm
+        host setup used to rebuild the whole product from numpy even
+        when the pattern was already planned. spgemm_plan=0 (or
+        ineligible operands) short-circuits to the eager
+        `galerkin_rap` composition, bit-for-bit."""
+        from ...ops import spgemm
+        from ...profiling import trace_region
+        k = self.level_index
+        if spgemm.plan_enabled(self.cfg, self.scope) \
+                and not self.A.is_block:
+            plan = None
+            # the memo shortcut must prove the PATTERN unchanged, not
+            # just the sizes: A's structure arrays are compared by
+            # identity (retained in the memo — id() alone could alias
+            # a freed array). A value-splice resetup keeps the objects
+            # (and a planned product's output structure arrays are the
+            # plan's own cached uploads, identical across resetups);
+            # anything else falls through to the digest cache, which
+            # keys on content — a same-nnz permuted pattern can never
+            # be served a stale plan.
+            memo = getattr(self, "_rap_plan_memo", None)
+            if memo is not None and memo[0] is self.P \
+                    and memo[1] is self.R \
+                    and memo[2] is self.A.row_offsets \
+                    and memo[3] is self.A.col_indices \
+                    and memo[4] == self.A.has_external_diag:
+                plan = memo[5]
+            if plan is None:
+                with trace_region(f"amg.L{k}.rap_plan"):
+                    plan = spgemm.get_rap_plan(self.R, self.A, self.P)
+                if plan is not None:
+                    self._rap_plan_memo = (
+                        self.P, self.R, self.A.row_offsets,
+                        self.A.col_indices, self.A.has_external_diag,
+                        plan)
+            if plan is not None:
+                with trace_region(f"amg.L{k}.rap_values"):
+                    return spgemm.plan_coarse_matrix(plan, self.A,
+                                                     self.R, self.P)
         with trace_region(f"amg.L{k}.rap"):
             return galerkin_rap(self.R, self.A, self.P)
 
@@ -125,6 +173,11 @@ class ClassicalAMGLevel(AMGLevel):
         if memo is not None and getattr(self.A, "dia_offsets", None) \
                 == getattr(old.A, "dia_offsets", None):
             self._xfer_memo = memo
+        # the RAP plan is a function of (A pattern, P, R) — all kept by
+        # structure reuse — so a resetup's Galerkin is value-phase only
+        memo = getattr(old, "_rap_plan_memo", None)
+        if memo is not None:
+            self._rap_plan_memo = memo
         self._reused = True
 
     def structure_snapshot(self):

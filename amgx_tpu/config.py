@@ -280,6 +280,16 @@ def _register_default_parameters():
     R("late_rejection", int, "late rejection in min-max-2ring", 0)
     R("geometric_dim", int, "uniform coloring dimension", 2)
     # spgemm knobs (accepted; the TPU SpGEMM is sort-based)
+    R("spgemm_plan", str, "plan-split Galerkin RAP (ops/spgemm.py "
+      "RapPlan): the structure phase (expansion gathers, lexsorted "
+      "coalesce order, output CSR pattern) runs once per sparsity "
+      "pattern and is memoized on the level + a digest-keyed cache, "
+      "so warm setups and value resetups do ZERO symbolic work — the "
+      "value phase is one fused Pallas kernel per level on TPU "
+      "(ops/pallas_spgemm.py) and a sort-free gather/segment-sum (or "
+      "host reduceat) program elsewhere. auto/1 = plan split on; 0 = "
+      "the eager sort/expand composition, bit-for-bit (no plan "
+      "machinery runs at all)", "auto", ("auto", "0", "1"))
     R("spmm_gmem_size", int, "deprecated", 1024)
     R("spmm_no_sort", int, "deprecated", 1)
     R("spmm_verbose", int, "verbose SpGEMM", 0)

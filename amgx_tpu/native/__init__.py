@@ -304,6 +304,61 @@ def rap_native(nc, n, ncp, r_ptr, r_col, r_val, a_ptr, a_col, a_val,
     return c_ptr, c_col, c_val
 
 
+def rap_plan_values_native(stage1, sr, st, starts2, n_u, a_val, p_val,
+                           r_val):
+    """Values-only Galerkin RAP sweep through a RapPlan's precomputed
+    indices (src/rap_values.cpp): two flat FMA passes, no structure
+    discovery. `stage1` is the plan's stage-1 dict or None (the
+    aggregation relabel form); `sr`/`r_val` / `p_val` may be None.
+    Returns the (n_u,) float64 value vector or None when the native
+    library is unavailable (callers fall back to the numpy reduceat
+    route — same sums, same order)."""
+    import numpy as np
+    L = lib()
+    if L is None:
+        return None
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    fn = L.amgx_rap_plan_values
+    fn.restype = ctypes.c_int32
+    av = np.ascontiguousarray(a_val, np.float64)
+    keep = []        # retain converted temporaries across the call
+
+    def ip32(x):
+        x = np.ascontiguousarray(x, np.int32)
+        keep.append(x)
+        return x.ctypes.data_as(i32p)
+
+    null32 = ctypes.cast(None, i32p)
+    null64f = ctypes.cast(None, f64p)
+    if stage1 is not None:
+        pv = np.ascontiguousarray(p_val, np.float64)
+        args1 = (ctypes.c_int64(int(stage1["nT"])), ip32(stage1["sa"]),
+                 ip32(stage1["sp"]), ip32(stage1["starts1"]),)
+        pvp = pv.ctypes.data_as(f64p)
+    else:
+        pv = None
+        args1 = (ctypes.c_int64(0), null32, null32, null32)
+        pvp = null64f
+    if sr is not None:
+        rv = np.ascontiguousarray(r_val, np.float64)
+        rvp = rv.ctypes.data_as(f64p)
+        srp = ip32(sr)
+    else:
+        rv = None
+        rvp = null64f
+        srp = null32
+    out = np.empty(int(n_u), np.float64)
+    rc = fn(*args1, ctypes.c_int64(int(n_u)), srp, ip32(st),
+            ip32(starts2), av.ctypes.data_as(f64p), pvp, rvp,
+            ctypes.c_int32(1 if stage1 is not None else 0),
+            ctypes.c_int32(1 if sr is not None else 0),
+            out.ctypes.data_as(f64p))
+    if rc != 0:
+        return None
+    return out
+
+
 def swell_build_native(ro, ci, vals, num_rows):
     """Native SWELL layout build (ops/pallas_swell.py layout contract).
     Returns (cols4, vals4, c0row, nchunk, w128) with cols4/vals4 shaped

@@ -249,6 +249,37 @@ def corr_smooth_dia_multi(A: CsrMatrix, B: jax.Array, X: jax.Array,
                             dinv, False).astype(dt)
 
 
+def rap_values_multi(sarrs, AF: jax.Array, r_vals, p_vals, nT: int,
+                     nU: int, has1: bool, has_r: bool,
+                     r_batched: bool = False, p_batched: bool = False):
+    """Multi-coefficient form of the plan-split RAP value phase
+    (ops/spgemm.py RapPlan / ops/pallas_spgemm.py kernel): the batch
+    axis rides the candidate gathers and sorted segment-sums with the
+    plan's index slabs shared across systems. This is both the
+    `custom_vmap` route of the fused value kernel (a vmapped
+    coefficient stream over one pattern never re-streams the index
+    slabs per system) and the f64 parity reference the kernel tests
+    compare against — like `affine_window_sweeps` for the smoother
+    suite. Zero sort/argsort/unique primitives by construction."""
+    if has1:
+        PV = p_vals[:, sarrs["sp"]] if p_batched else \
+            p_vals[sarrs["sp"]][None]
+        cand1 = AF[:, sarrs["sa"]] * PV
+        base = jax.ops.segment_sum(
+            cand1.T, sarrs["seg1"], num_segments=nT,
+            indices_are_sorted=True).T
+    else:
+        base = AF
+    cand2 = base[:, sarrs["st"]]
+    if has_r:
+        RV = r_vals[:, sarrs["sr"]] if r_batched else \
+            r_vals[sarrs["sr"]][None]
+        cand2 = RV * cand2
+    return jax.ops.segment_sum(cand2.T, sarrs["seg2"],
+                               num_segments=nU,
+                               indices_are_sorted=True).T
+
+
 def tail_cycle_multi(arrs, B: jax.Array, X: jax.Array, spec):
     """Multi-RHS form of the VMEM-resident coarse-tail sub-cycle: the
     SAME _tail_compute the Pallas kernel body runs, vmapped over the

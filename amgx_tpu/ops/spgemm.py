@@ -15,11 +15,30 @@ This is a *setup-time* operation (Galerkin products happen once per
 hierarchy build); it runs eagerly with concrete shapes so the output nnz
 can be data-dependent, every step dispatching XLA sort/gather/segment
 kernels on device.
+
+PLAN SPLIT (device-SpGEMM strategies, arXiv:1606.00545; SParSH-AMG's
+symbolic/numeric setup split, arXiv:2007.00056): the sparsity pattern of
+a Galerkin product is identical across every warm setup and resetup of
+the same problem, yet the eager formulation re-dispatches the whole
+sort/gather/segment chain each time. `RapPlan` separates the two
+phases: the STRUCTURE phase runs once per pattern (host numpy: the
+(A·P) expansion gather indices, the lexsorted coalesce order, segment
+boundaries, and the output CSR pattern, memoized in a digest-keyed
+cache) and the VALUE phase recomputes all numerics from the current
+coefficients through those static indices — one fused Pallas kernel on
+TPU (ops/pallas_spgemm.py), a sort-free gather/segment-sum program on
+XLA rigs, or a reduceat sweep on host numpy hierarchies. `spgemm_plan=0`
+short-circuits before any plan machinery runs, restoring the eager
+composition bit-for-bit.
 """
 from __future__ import annotations
 
+import functools
+import hashlib
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..matrix import CsrMatrix, lexsort_rc
 
@@ -165,3 +184,446 @@ def galerkin_rap(R: CsrMatrix, A: CsrMatrix, P: CsrMatrix) -> CsrMatrix:
                 values=cv.astype(np.asarray(A.values).dtype, copy=False),
                 num_rows=R.num_rows, num_cols=P.num_cols)
     return csr_multiply(csr_multiply(R, A), P)
+
+
+# ---------------------------------------------------------------------------
+# plan-split RAP: the structure phase (RapPlan) + value-phase dispatch
+# ---------------------------------------------------------------------------
+
+
+def plan_enabled(cfg, scope) -> bool:
+    """`spgemm_plan` knob gate: '0' restores the eager composition
+    (no plan machinery runs at all); 'auto'/'1' take the plan split."""
+    return str(cfg.get("spgemm_plan", scope)) != "0"
+
+
+class RapPlan:
+    """Static recipe for one Galerkin product's numerics.
+
+    Built once per sparsity pattern from the operand STRUCTURES only
+    (host numpy); the value phase then reads the current coefficients
+    through precomputed gather indices and sorted-segment boundaries —
+    no sort, argsort, unique, or data-dependent shape anywhere.
+
+    Two forms share the class:
+
+    - kind="agg" (piecewise-constant P): the product collapses to
+      relabeling A's entries by aggregate id. `st` is the lexsorted
+      candidate permutation into the (diag-folded) value vector and
+      `seg2`/`starts2` the coalesce segments. `sr` is None (unit
+      weights); the output mirrors `_compact_coarse` (structure-
+      complete, initialized).
+    - kind="rap" (general CSR R/A/P): stage 1 expands T = A·P
+      (`sa`/`sp` candidate gathers + `seg1`), stage 2 expands
+      C = R·T (`sr`/`st` + `seg2`); the output mirrors the eager
+      `galerkin_rap` CSR (the caller init()s it).
+
+    Index arrays live as host numpy (the numpy reduceat route and the
+    kernel-chunk builder read them); `dev()` uploads device twins once
+    per plan (the slab/kernel routes), exactly like the GEO structure
+    cache — a warm setup re-uploads nothing."""
+
+    kind = "rap"
+
+    def __init__(self, kind, stage1, sr, st, seg2, starts2, nU,
+                 fold_diag, row_offsets, col_indices, row_ids,
+                 diag_idx, num_rows, num_cols):
+        self.kind = kind
+        self.stage1 = stage1      # None | dict(sa, sp, seg1, starts1, nT)
+        self.sr = sr
+        self.st = st
+        self.seg2 = seg2
+        self.starts2 = starts2
+        self.nU = int(nU)
+        self.fold_diag = bool(fold_diag)
+        self.row_offsets = row_offsets
+        self.col_indices = col_indices
+        self.row_ids = row_ids
+        self.diag_idx = diag_idx
+        self.num_rows = int(num_rows)
+        self.num_cols = int(num_cols)
+        self._dev = None
+        self._kernel = None       # None = unbuilt, False = declined
+
+    def nbytes(self) -> int:
+        total = 0
+        for a in (self.sr, self.st, self.seg2, self.starts2,
+                  self.row_offsets, self.col_indices, self.row_ids,
+                  self.diag_idx):
+            if a is not None:
+                total += int(a.nbytes)
+        if self.stage1 is not None:
+            for k in ("sa", "sp", "seg1", "starts1"):
+                total += int(self.stage1[k].nbytes)
+        return total
+
+    def dev(self):
+        """Device twins of the gather/segment arrays (uploaded once)."""
+        if self._dev is None:
+            d = {"st": jnp.asarray(self.st),
+                 "seg2": jnp.asarray(self.seg2)}
+            if self.sr is not None:
+                d["sr"] = jnp.asarray(self.sr)
+            if self.stage1 is not None:
+                d["sa"] = jnp.asarray(self.stage1["sa"])
+                d["sp"] = jnp.asarray(self.stage1["sp"])
+                d["seg1"] = jnp.asarray(self.stage1["seg1"])
+            self._dev = d
+        return self._dev
+
+    def dev_structure(self):
+        """Device twins of the output CSR structure (uploaded once)."""
+        d = self.dev()
+        if "row_offsets" not in d:
+            d["row_offsets"] = jnp.asarray(self.row_offsets)
+            d["col_indices"] = jnp.asarray(self.col_indices)
+            d["row_ids"] = jnp.asarray(self.row_ids)
+            d["diag_idx"] = jnp.asarray(self.diag_idx)
+        return d
+
+
+def _np_expand_pattern(a_ro, a_ci, b_ro, b_ci):
+    """Candidate COO triplets of A@B from patterns (numpy mirror of
+    `_expand`): (out_rows, out_cols, src_a, src_b), int64."""
+    a_rows = np.repeat(np.arange(a_ro.shape[0] - 1, dtype=np.int64),
+                       np.diff(a_ro))
+    counts = np.diff(b_ro)[a_ci]
+    total = int(counts.sum())
+    src_a = np.repeat(np.arange(a_ci.shape[0], dtype=np.int64), counts)
+    cum = np.concatenate([np.zeros(1, np.int64),
+                          np.cumsum(counts, dtype=np.int64)])
+    off = np.arange(total, dtype=np.int64) - cum[src_a]
+    src_b = b_ro[a_ci[src_a]].astype(np.int64) + off
+    return a_rows[src_a], b_ci[src_b].astype(np.int64), src_a, src_b
+
+
+def _np_coalesce(rows, cols):
+    """Lexsorted coalesce of candidate coordinates: (order, seg,
+    starts, rows_u, cols_u). `order` is the stable (row, col) sort of
+    the candidates, `seg` the segment id per sorted candidate, `starts`
+    the (nU+1,) segment boundaries."""
+    order = np.lexsort((cols, rows))
+    r_s, c_s = rows[order], cols[order]
+    if r_s.shape[0] == 0:
+        return (order, np.zeros(0, np.int32), np.zeros(1, np.int64),
+                r_s, c_s)
+    first = np.concatenate(
+        [np.ones(1, bool), (r_s[1:] != r_s[:-1]) | (c_s[1:] != c_s[:-1])])
+    seg = (np.cumsum(first) - 1).astype(np.int32)
+    # int32 boundaries: candidate totals are guarded < 2^31 by the
+    # builders, and halving these arrays matters — a 128^3 classical
+    # L0 plan is GB-scale
+    starts = np.concatenate([np.flatnonzero(first).astype(np.int32),
+                             np.asarray([r_s.shape[0]], np.int32)])
+    return order, seg, starts, r_s[first], c_s[first]
+
+
+def _np_csr_structure(rows_u, cols_u, num_rows):
+    """Output CSR structure of the coalesced entries (sorted by
+    (row, col)): row_offsets, col_indices, row_ids, diag_idx — the
+    same fields the eager `_compact_coarse` emits."""
+    counts = np.bincount(rows_u, minlength=num_rows)
+    row_offsets = np.zeros(num_rows + 1, np.int32)
+    row_offsets[1:] = np.cumsum(counts).astype(np.int32)
+    diag_idx = np.full(num_rows, -1, np.int32)
+    is_diag = cols_u == rows_u
+    diag_idx[rows_u[is_diag].astype(np.int64)] = \
+        np.flatnonzero(is_diag).astype(np.int32)
+    return (row_offsets, cols_u.astype(np.int32),
+            rows_u.astype(np.int32), diag_idx)
+
+
+def _host_pattern(*arrays):
+    """Host numpy views of pattern arrays regardless of backend
+    forcing (the plan is a host-side artifact; `host_arrays` respects
+    the device forcing, `np.asarray` is the fallback pull)."""
+    return [None if a is None else np.asarray(a) for a in arrays]
+
+
+def build_agg_plan(A: CsrMatrix, agg, nc: int):
+    """Structure phase of the aggregation relabel Galerkin: candidates
+    are A's (diag-folded) entries relabeled by aggregate id, in the
+    lexsorted coalesce order. Returns None for block matrices."""
+    if A.is_block:
+        return None
+    ro, ci, ri = _host_pattern(A.row_offsets, A.col_indices, A.row_ids)
+    aggv = np.asarray(agg).ravel().astype(np.int64)
+    if ri is not None and ri.shape[0] == ci.shape[0]:
+        rows = ri.astype(np.int64)
+    else:
+        rows = np.repeat(np.arange(A.num_rows, dtype=np.int64),
+                         np.diff(ro))
+    cols = ci.astype(np.int64)
+    r2 = aggv[rows]
+    c2 = aggv[cols]
+    fold = A.has_external_diag
+    if fold:
+        r2 = np.concatenate([r2, aggv])
+        c2 = np.concatenate([c2, aggv])
+    if r2.shape[0] >= np.iinfo(np.int32).max:
+        return None
+    order, seg, starts, rows_u, cols_u = _np_coalesce(r2, c2)
+    structure = _np_csr_structure(rows_u, cols_u, int(nc))
+    return RapPlan("agg", None, None, order.astype(np.int32), seg,
+                   starts, rows_u.shape[0], fold, *structure,
+                   num_rows=int(nc), num_cols=int(nc))
+
+
+def build_rap_plan(R: CsrMatrix, A: CsrMatrix, P: CsrMatrix):
+    """Structure phase of the general Galerkin triple product: stage 1
+    expands/coalesces T = A·P, stage 2 expands/coalesces C = R·T.
+    Returns None for block matrices or external diagonals on R/P (the
+    eager path handles those; A's external diagonal folds in)."""
+    if A.is_block or R.is_block or P.is_block or \
+            R.has_external_diag or P.has_external_diag:
+        return None
+    a_ro, a_ci = _host_pattern(A.row_offsets, A.col_indices)
+    p_ro, p_ci = _host_pattern(P.row_offsets, P.col_indices)
+    r_ro, r_ci = _host_pattern(R.row_offsets, R.col_indices)
+    fold = A.has_external_diag
+    if fold:
+        n = A.num_rows
+        rows = np.repeat(np.arange(n, dtype=np.int64), np.diff(a_ro))
+        cols = a_ci.astype(np.int64)
+        rows = np.concatenate([rows, np.arange(n, dtype=np.int64)])
+        cols = np.concatenate([cols, np.arange(n, dtype=np.int64)])
+        order = np.lexsort((cols, rows))
+        # folded pattern, sorted: entry e reads value vector slot
+        # fold_src[e] of concat(values, diag)
+        fold_src = order.astype(np.int64)
+        rows, cols = rows[order], cols[order]
+        counts = np.bincount(rows, minlength=n)
+        a_ro = np.zeros(n + 1, np.int64)
+        a_ro[1:] = np.cumsum(counts)
+        a_ci = cols
+    else:
+        fold_src = None
+    # stage 1: T = A @ P
+    t_rows_c, t_cols_c, s1a, s1p = _np_expand_pattern(
+        a_ro, a_ci, p_ro, p_ci)
+    if t_rows_c.shape[0] >= np.iinfo(np.int32).max:
+        return None
+    order1, seg1, starts1, t_rows, t_cols = _np_coalesce(
+        t_rows_c, t_cols_c)
+    sa = s1a[order1]
+    if fold_src is not None:
+        sa = fold_src[sa]
+    sp = s1p[order1]
+    nT = t_rows.shape[0]
+    t_counts = np.bincount(t_rows, minlength=A.num_rows)
+    t_ro = np.zeros(A.num_rows + 1, np.int64)
+    t_ro[1:] = np.cumsum(t_counts)
+    # stage 2: C = R @ T
+    c_rows_c, c_cols_c, s2r, s2t = _np_expand_pattern(
+        r_ro, r_ci, t_ro, t_cols)
+    if c_rows_c.shape[0] >= np.iinfo(np.int32).max:
+        return None
+    order2, seg2, starts2, c_rows, c_cols = _np_coalesce(
+        c_rows_c, c_cols_c)
+    sr = s2r[order2].astype(np.int32)
+    st = s2t[order2].astype(np.int32)
+    stage1 = {"sa": sa.astype(np.int32), "sp": sp.astype(np.int32),
+              "seg1": seg1, "starts1": starts1, "nT": int(nT)}
+    structure = _np_csr_structure(c_rows, c_cols, R.num_rows)
+    return RapPlan("rap", stage1, sr, st, seg2, starts2,
+                   c_rows.shape[0], fold, *structure,
+                   num_rows=R.num_rows, num_cols=P.num_cols)
+
+
+# -- plan cache (digest-keyed; survives level objects across warm
+#    setups of the same pattern) ---------------------------------------------
+
+_PLAN_CACHE = {}                        # digest -> RapPlan, LRU order
+# sized so one 128^3-grade classical hierarchy's plans (L0 alone is
+# GB-scale index arrays) co-reside with headroom; host RAM, not HBM
+_PLAN_CACHE_MAX_BYTES = 6 << 30
+
+
+def _pattern_digest(meta, *arrays) -> bytes:
+    h = hashlib.blake2b(repr(meta).encode(), digest_size=16)
+    for a in arrays:
+        if a is None:
+            h.update(b"<none>")
+            continue
+        a = np.ascontiguousarray(np.asarray(a))
+        h.update(str(a.dtype).encode() + str(a.shape).encode())
+        h.update(memoryview(a))
+    return h.digest()
+
+
+def _cache_get(key):
+    from ..telemetry import metrics as _tm
+    hit = _PLAN_CACHE.get(key)
+    if hit is not None:
+        _PLAN_CACHE[key] = _PLAN_CACHE.pop(key)       # LRU bump
+        _tm.inc("amg.spgemm.plan_hit")
+    return hit
+
+
+def _cache_put(key, plan):
+    from ..telemetry import metrics as _tm
+    _tm.inc("amg.spgemm.plan_build")
+    _PLAN_CACHE[key] = plan
+    total = 0
+    for k in reversed(list(_PLAN_CACHE)):
+        total += _PLAN_CACHE[k].nbytes()
+        if total > _PLAN_CACHE_MAX_BYTES and k != key:
+            del _PLAN_CACHE[k]
+
+
+def get_agg_plan(A: CsrMatrix, agg, nc: int):
+    """Digest-cached relabel plan for (A pattern, aggregates map)."""
+    key = _pattern_digest(
+        ("agg", A.num_rows, A.num_cols, int(nc), A.has_external_diag),
+        A.row_offsets, A.col_indices, np.asarray(agg))
+    plan = _cache_get(key)
+    if plan is None:
+        plan = build_agg_plan(A, agg, nc)
+        if plan is not None:
+            _cache_put(key, plan)
+    return plan
+
+
+def get_rap_plan(R: CsrMatrix, A: CsrMatrix, P: CsrMatrix):
+    """Digest-cached triple-product plan for (R, A, P) patterns."""
+    if A.is_block or R.is_block or P.is_block:
+        return None
+    key = _pattern_digest(
+        ("rap", R.num_rows, A.num_rows, P.num_cols,
+         A.has_external_diag),
+        R.row_offsets, R.col_indices, A.row_offsets, A.col_indices,
+        P.row_offsets, P.col_indices)
+    plan = _cache_get(key)
+    if plan is None:
+        plan = build_rap_plan(R, A, P)
+        if plan is not None:
+            _cache_put(key, plan)
+    return plan
+
+
+# -- value phase --------------------------------------------------------------
+
+
+def _np_reduce_segments(cand, starts):
+    if cand.shape[0] == 0:
+        return cand
+    return np.add.reduceat(cand, starts[:-1])
+
+
+def _rap_values_numpy(plan: RapPlan, af, r_vals, p_vals):
+    """Host value phase: the native flat-FMA sweep through the plan's
+    precomputed indices (native/src/rap_values.cpp — the route
+    host-built hierarchies take, keeping the result numpy-backed like
+    the native RAP it replaces), or two numpy reduceat passes when the
+    toolchain is unavailable. Both sum each segment strictly
+    left-to-right, so the routes agree to the last bit."""
+    if af.dtype == np.float64 \
+            and (r_vals is None or r_vals.dtype == np.float64) \
+            and (p_vals is None or p_vals.dtype == np.float64):
+        from .. import native
+        out = native.rap_plan_values_native(
+            plan.stage1, plan.sr, plan.st, plan.starts2, plan.nU,
+            af, p_vals, r_vals)
+        if out is not None:
+            return out
+    if plan.stage1 is not None:
+        s1 = plan.stage1
+        cand1 = af[s1["sa"]] * p_vals[s1["sp"]]
+        base = _np_reduce_segments(cand1, s1["starts1"])
+    else:
+        base = af
+    cand2 = base[plan.st]
+    if plan.sr is not None:
+        cand2 = r_vals[plan.sr] * cand2
+    return _np_reduce_segments(cand2, plan.starts2)
+
+
+@functools.partial(jax.jit, static_argnames=("nT", "nU", "has1",
+                                             "has_r"))
+def _rap_values_slab(af, r_vals, p_vals, sa, sp, seg1, sr, st, seg2,
+                     nT: int, nU: int, has1: bool, has_r: bool):
+    """XLA value phase (CPU meshes / f64 / kernel-declined): gathers +
+    sorted segment-sums through the static plan indices — zero sort /
+    argsort / unique primitives in the jaxpr (the acceptance contract
+    of the plan split's CPU route)."""
+    if has1:
+        cand1 = af[sa] * p_vals[sp]
+        base = jax.ops.segment_sum(cand1, seg1, num_segments=nT,
+                                   indices_are_sorted=True)
+    else:
+        base = af
+    cand2 = base[st]
+    if has_r:
+        cand2 = r_vals[sr] * cand2
+    return jax.ops.segment_sum(cand2, seg2, num_segments=nU,
+                               indices_are_sorted=True)
+
+
+def _fold_values(plan, A: CsrMatrix, np_route: bool):
+    vals = A.values
+    if not plan.fold_diag:
+        return np.asarray(vals) if np_route else vals
+    if np_route:
+        return np.concatenate([np.asarray(vals), np.asarray(A.diag)])
+    return jnp.concatenate([jnp.asarray(vals), jnp.asarray(A.diag)])
+
+
+def rap_values(plan: RapPlan, A: CsrMatrix, R=None, P=None):
+    """Value phase dispatch: recompute the product's numerics from the
+    CURRENT coefficients through the plan. Route order: host numpy
+    (host-resident operands outside a forced-device setup), the fused
+    Pallas kernel (TPU / interpret-forced, f32, within budget —
+    ops/pallas_spgemm.py), the XLA slab program otherwise."""
+    r_vals = None if R is None else R.values
+    p_vals = None if P is None else P.values
+    if _on_host(A) and (R is None or _on_host(R)) \
+            and (P is None or _on_host(P)):
+        af = _fold_values(plan, A, np_route=True)
+        return _rap_values_numpy(
+            plan, af,
+            None if r_vals is None else np.asarray(r_vals),
+            None if p_vals is None else np.asarray(p_vals))
+    af = _fold_values(plan, A, np_route=False)
+    from . import pallas_spgemm as _pk
+    if _pk.rap_kernel_ready(plan, af.dtype):
+        return _pk.rap_value_call(plan, af, r_vals, p_vals)
+    d = plan.dev()
+    s1 = plan.stage1
+    return _rap_values_slab(
+        af,
+        None if r_vals is None else jnp.asarray(r_vals),
+        None if p_vals is None else jnp.asarray(p_vals),
+        d.get("sa"), d.get("sp"), d.get("seg1"), d.get("sr"),
+        d["st"], d["seg2"],
+        0 if s1 is None else s1["nT"], plan.nU,
+        s1 is not None, plan.sr is not None)
+
+
+def plan_coarse_matrix(plan: RapPlan, A: CsrMatrix, R=None,
+                       P=None) -> CsrMatrix:
+    """Value phase + output assembly. kind="agg" emits the structure-
+    complete initialized CSR `_compact_coarse` emits (the hierarchy
+    builds the SpMV layout on top); kind="rap" emits the plain CSR the
+    eager `galerkin_rap` emits (the caller init()s it). The structure
+    arrays come from the plan (device twins uploaded once per plan on
+    jnp routes — only the VALUES are new work per setup)."""
+    vals = rap_values(plan, A, R, P)
+    target = A.values
+    if hasattr(vals, "dtype") and vals.dtype != target.dtype:
+        vals = vals.astype(target.dtype)
+    if isinstance(vals, np.ndarray):
+        ro, ci, ri, di = (plan.row_offsets, plan.col_indices,
+                          plan.row_ids, plan.diag_idx)
+    else:
+        d = plan.dev_structure()
+        ro, ci, ri, di = (d["row_offsets"], d["col_indices"],
+                          d["row_ids"], d["diag_idx"])
+    if plan.kind == "agg":
+        return CsrMatrix(
+            row_offsets=ro, col_indices=ci, values=vals, diag=None,
+            row_ids=ri, diag_idx=di, ell_cols=None, ell_vals=None,
+            dia_offsets=None, dia_vals=None, num_rows=plan.num_rows,
+            num_cols=plan.num_cols, block_dimx=1, block_dimy=1,
+            initialized=True)
+    return CsrMatrix(row_offsets=ro, col_indices=ci, values=vals,
+                     num_rows=plan.num_rows, num_cols=plan.num_cols)

@@ -423,6 +423,18 @@ declare_counter("amg.geo_struct_cache.miss",
                 "GEO coarse CSR-structure device-cache misses "
                 "(host build + device upload paid)")
 
+# plan-split Galerkin RAP (ops/spgemm.py RapPlan): a warm setup or
+# value resetup of a known pattern must HIT (zero symbolic work, one
+# fused value kernel per level); builds are the once-per-pattern
+# structure phase
+declare_counter("amg.spgemm.plan_build",
+                "RAP structure-phase plan builds (once per sparsity "
+                "pattern: expansion gathers + coalesce order + output "
+                "CSR, host numpy)")
+declare_counter("amg.spgemm.plan_hit",
+                "RAP plan-cache hits (warm setup / resetup of a known "
+                "pattern: value phase only, zero symbolic work)")
+
 # RequestBatcher (batch/queue.py)
 declare_counter("batch.requests", "solve requests submitted")
 declare_counter("batch.dispatches", "batched dispatches issued")

@@ -77,6 +77,11 @@ DECLARED_SPANS: Tuple[str, ...] = (
     # double-count the selector wall)
     "selector.device_sweep",
     "amg.L*.rap",
+    # plan-split RAP (ops/spgemm.py): structure-phase plan build/lookup
+    # and the fused value phase — disjoint siblings of amg.L*.rap (the
+    # eager route's span), never nested inside it
+    "amg.L*.rap_plan",
+    "amg.L*.rap_values",
     "amg.L*.galerkin",
     "amg.L*.layout",
     "amg.L*.smoother_setup",

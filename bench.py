@@ -536,11 +536,14 @@ def bench_flagship(n: int = 128, tolerance: str = "1e-8", reps: int = 3,
     rel = float(
         np.linalg.norm(np.asarray(amgx.ops.residual(A, res.x, b)))
         / np.linalg.norm(np.asarray(b)))
+    rap_s, rap_share = _rap_attr(breakdown, setup_s)
     return {
         "setup_cold_s": setup_cold_s,
         "setup_warm_s": setup_s,
         "setup_rows_per_s": A.num_rows / max(setup_s, 1e-9),
         "setup_accounted_fraction": accounted,
+        "rap_s": rap_s,
+        "rap_share": rap_share,
         "resetup_s": resetup_s,
         "resetup_first_s": resetup_first_s,
         "breakdown": breakdown,
@@ -632,15 +635,111 @@ def bench_setup(grids=(64, 128)):
         dt = time.perf_counter() - t0
         accounted = min(1.0, profiling.timers_total("amg.")
                         / max(dt, 1e-9))
+        breakdown = {k: round(v[1], 4)
+                     for k, v in profiling.timers().items()
+                     if k.startswith(("amg.", "ship."))}
+        rap_s, rap_share = _rap_attr(breakdown, dt)
         out[f"{n}^3"] = {
             "setup_warm_s": round(dt, 3),
             "setup_rows_per_s": round(A.num_rows / max(dt, 1e-9)),
             "setup_accounted_fraction": round(accounted, 3),
             "setup_attribution_ok": bool(accounted >= 0.9),
-            "breakdown": {k: round(v[1], 4)
-                          for k, v in profiling.timers().items()
-                          if k.startswith(("amg.", "ship."))},
+            "rap_s": rap_s,
+            "rap_share": rap_share,
+            "breakdown": breakdown,
         }
+    return out
+
+
+import re as _re  # noqa: E402
+
+_RAP_SPAN_RE = _re.compile(r"amg\.L\d+\.(?:rap|rap_plan|rap_values"
+                           r"|galerkin)$")
+
+
+def _rap_attr(breakdown: dict, wall: float):
+    """(rap_s, rap_share) of a warm-setup breakdown: the summed
+    per-level Galerkin RAP spans — the eager routes (amg.L*.rap /
+    amg.L*.galerkin) plus the plan split's structure/value spans
+    (amg.L*.rap_plan / amg.L*.rap_values) — over the setup wall. This
+    is the attribution field ROADMAP 2(b) asks for: when classical
+    setup is still the wall, this number says whether RAP is the
+    dominant span or the residue lives elsewhere."""
+    rap = sum(v for k, v in breakdown.items() if _RAP_SPAN_RE.match(k))
+    return round(rap, 4), round(rap / max(wall, 1e-9), 3)
+
+
+def bench_spgemm_plan(flagship_n: int = 128, classical_n: int = 64,
+                      reps: int = 2):
+    """Plan-split RAP phase (`python bench.py spgemm [--smoke]`):
+    paired plan-vs-eager WARM-setup replay on the flagship GEO shape
+    and the benched classical shape. Both twins run the identical
+    config except `spgemm_plan` (1 = structure phase memoized +
+    fused/sort-free value phase; 0 = today's eager expand/sort/segment
+    composition); each mode pays one cold setup first (compiles +
+    plan-cache prime), then the best-of-`reps` warm wall is the
+    headline — exactly what a production coefficient-replace cycle
+    sees. `spgemm_plan_speedup` (flagship) and
+    `spgemm_plan_speedup_classical` are sentinel-tracked."""
+    from amgx_tpu.telemetry import metrics as _tm
+
+    def _warm_setup(cfg, A):
+        cold = amgx.create_solver(cfg)
+        cold.setup(A)
+        jax.block_until_ready(cold.solve_data())
+        del cold
+        best = float("inf")
+        for _ in range(reps):
+            slv = amgx.create_solver(cfg)
+            t0 = time.perf_counter()
+            slv.setup(A)
+            jax.block_until_ready(slv.solve_data())
+            best = min(best, time.perf_counter() - t0)
+            del slv
+        return best
+
+    out = {}
+    cases = (
+        (f"flagship_{flagship_n}^3",
+         lambda m: Config.from_string(
+             FLAGSHIP + f", amg:spgemm_plan={m}"),
+         flagship_n),
+        (f"classical_{classical_n}^3",
+         lambda m: _classical_cfg(extra=f", amg:spgemm_plan={m}"),
+         classical_n),
+    )
+    for label, mk, n in cases:
+        A = amgx.gallery.poisson("7pt", n, n, n).init()
+        cfg1 = mk("1")
+        cold = amgx.create_solver(cfg1)
+        cold.setup(A)                  # builds + primes the plan cache
+        jax.block_until_ready(cold.solve_data())
+        del cold
+        # hits counted over the WARM window only (the cold setup
+        # builds; it can also hit patterns planned by earlier phases)
+        hits0 = int(_tm.get("amg.spgemm.plan_hit"))
+        best = float("inf")
+        for _ in range(reps):
+            slv = amgx.create_solver(cfg1)
+            t0 = time.perf_counter()
+            slv.setup(A)
+            jax.block_until_ready(slv.solve_data())
+            best = min(best, time.perf_counter() - t0)
+            del slv
+        plan_s = best
+        hits = int(_tm.get("amg.spgemm.plan_hit")) - hits0
+        eager_s = _warm_setup(mk("0"), A)
+        out[label] = {
+            "plan_warm_setup_s": round(plan_s, 3),
+            "eager_warm_setup_s": round(eager_s, 3),
+            "plan_hits_per_warm_setup": hits / max(reps, 1),
+            "speedup": round(eager_s / max(plan_s, 1e-9), 3),
+        }
+        del A
+    out["spgemm_plan_speedup"] = \
+        out[f"flagship_{flagship_n}^3"]["speedup"]
+    out["spgemm_plan_speedup_classical"] = \
+        out[f"classical_{classical_n}^3"]["speedup"]
     return out
 
 
@@ -712,10 +811,13 @@ def bench_classical(n: int = 64):
         / np.linalg.norm(np.asarray(b)))
     amg = slv2.preconditioner.amg
     effective = amg.levels[0].smoother.name if amg.levels else "?"
+    rap_s, rap_share = _rap_attr(breakdown, setup_s)
     return {
         "setup_warm_s": setup_s,
         "setup_rows_per_s": A.num_rows / max(setup_s, 1e-9),
         "setup_accounted_fraction": accounted,
+        "rap_s": rap_s,
+        "rap_share": rap_share,
         "breakdown": breakdown,
         "solve_s": solve_s,
         "iters": int(res.iterations),
@@ -1184,7 +1286,7 @@ def bench_resilience(n: int = 32, iters: int = 300, reps: int = 9):
     return out
 
 
-def _classical_cfg(smoother: str = "JACOBI_L1"):
+def _classical_cfg(smoother: str = "JACOBI_L1", extra: str = ""):
     """The benched classical configuration (bench_classical's literal),
     shared with the obs phase so both replay the SAME config. The
     128^3 TPU line requests MULTICOLOR_DILU (the reference's classical
@@ -1205,7 +1307,7 @@ def _classical_cfg(smoother: str = "JACOBI_L1"):
         " amg:coarse_solver=DENSE_LU_SOLVER, amg:min_coarse_rows=32,"
         " amg:max_levels=20, amg:strength_threshold=0.25,"
         " amg:interp_max_elements=4, amg:max_row_sum=0.9,"
-        " amg:amg_precision=float")
+        " amg:amg_precision=float" + extra)
 
 
 def bench_obs(n_flagship: int = 128, n_classical: int = 64,
@@ -1529,6 +1631,11 @@ def main():
                         round(cr["setup_warm_s"], 2)
                     extra["classical_128^3_solve_s"] = \
                         round(cr["solve_s"], 3)
+                    # plan-split RAP attribution (sentinel-tracked):
+                    # the summed per-level RAP spans of the warm setup
+                    extra["classical_128^3_rap_s"] = cr["rap_s"]
+                    extra["classical_128^3_rap_share"] = \
+                        cr["rap_share"]
             finally:
                 signal.alarm(0)
                 signal.signal(signal.SIGALRM, old)
@@ -1740,6 +1847,28 @@ def main():
             unit = "ms"
     _checkpoint(metric=metric, value=value, unit=unit,
                 error="incomplete: north-star phase still pending")
+
+    # plan-split RAP phase: paired plan-vs-eager warm-setup replay
+    # (flagship GEO + classical) — the spgemm_plan knob's measured win;
+    # sentinel-tracked via spgemm_plan_speedup
+    try:
+        old = signal.signal(signal.SIGALRM, _on_alarm)
+        signal.alarm(420)
+        try:
+            sg = bench_spgemm_plan()
+            extra["spgemm"] = sg
+            extra["spgemm_plan_speedup"] = sg["spgemm_plan_speedup"]
+            extra["spgemm_plan_speedup_classical"] = \
+                sg["spgemm_plan_speedup_classical"]
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)
+    except _Budget:  # pragma: no cover - timing dependent
+        extra["spgemm_error"] = "wall-clock budget exceeded"
+    except Exception as e:  # pragma: no cover - bench robustness
+        extra["spgemm_error"] = str(e)[:200]
+    _checkpoint()
+    gc.collect()
 
     # mixed-precision phase: the flagship paired-replayed at
     # solve_precision=float vs bfloat16 (ROADMAP item 5: bf16 operand
@@ -1955,6 +2084,36 @@ if __name__ == "__main__":
             "unit": "s",
             "vs_baseline": 0.0,
             "artifact": "BENCH_chaos.json",
+            "extra": {k: v for k, v in res.items()
+                      if not isinstance(v, (dict, list))},
+        }), flush=True)
+    elif sys.argv[1:2] == ["spgemm"]:
+        # standalone plan-split RAP phase: `python bench.py spgemm`
+        # (full: flagship 128^3 + classical 64^3 paired warm-setup
+        # replay) or `--smoke` (tiny grids, tier-1 functional check)
+        amgx.initialize()
+        smoke = "--smoke" in sys.argv[2:]
+        res = bench_spgemm_plan(
+            flagship_n=32 if smoke else 128,
+            classical_n=16 if smoke else 64,
+            reps=1 if smoke else 2)
+        try:
+            import os
+            art = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BENCH_spgemm.json")
+            with open(art, "w") as f:
+                json.dump(res, f, indent=1)
+                f.write("\n")
+        except Exception as e:  # pragma: no cover - bench robustness
+            res["artifact_error"] = str(e)[:120]
+        print(json.dumps({
+            "metric": "plan-split vs eager Galerkin RAP warm-setup "
+                      "speedup (paired replay, flagship)",
+            "value": res.get("spgemm_plan_speedup", -1.0),
+            "unit": "x",
+            "vs_baseline": 0.0,
+            "artifact": "BENCH_spgemm.json",
             "extra": {k: v for k, v in res.items()
                       if not isinstance(v, (dict, list))},
         }), flush=True)

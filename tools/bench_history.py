@@ -93,6 +93,15 @@ SERIES: Tuple[Tuple[str, str, float, str], ...] = (
     ("classical_128^3_solve_s", "lower", 0.40,
      "classical 128^3 solve wall (s), fused-classical era — the "
      "24x-gap tentpole's solve target (< 2 s)"),
+    # ISSUE 15 plan-split RAP: recorded from r06 on (the RapPlan
+    # structure/value split lands between r05 and r06); the CPU-rig
+    # measurement lives in BENCH_spgemm.json until then
+    ("spgemm_plan_speedup", "higher", 0.25,
+     "plan-split vs eager Galerkin RAP warm-setup speedup, paired "
+     "replay on the flagship 128^3 (x)"),
+    ("classical_128^3_rap_s", "lower", 0.40,
+     "classical 128^3 summed per-level RAP span wall in the warm "
+     "setup (s) — the plan-split tentpole's attribution target"),
     # ISSUE 14 mixed-precision headline: recorded from r06 on (the
     # bf16 fused path lands between r05 and r06). ROADMAP item 5's TPU
     # targets live here: flagship bf16 solve <= 0.18 s, northstar 256^3
