@@ -958,6 +958,107 @@ def AMGX_service_stats(svc_h):
 
 
 # ---------------------------------------------------------------------------
+# fleet API (amgx_tpu/serving/fleet.py): N service replicas behind one
+# fingerprint-affine submit/step/drain surface — the scale-out layer
+# over the service handles above. Tickets are plain service tickets
+# (AMGX_service_ticket_* applies) plus replica attribution.
+# ---------------------------------------------------------------------------
+
+
+class _CFleet:
+    def __init__(self, resources, mode, cfg: Config, n_replicas):
+        self.resources = resources
+        self.mode = mode
+        self.cfg = cfg
+        from .serving import FleetRouter
+        self.fleet = FleetRouter.build(cfg, n_replicas)
+
+
+@_api
+@_outputs(1)
+def AMGX_fleet_create(rsrc_h, mode: str, cfg_h, n_replicas=None):
+    """rc, fleet handle: `n_replicas` SolveService replicas (default:
+    the config's fleet_replicas) fronted by the fingerprint-affine
+    FleetRouter — rendezvous-hash affinity, least-loaded cold
+    placement, overload/quarantine spill, fleet-wide shed consults."""
+    rs = _get(rsrc_h, _CResources)
+    cfg = _get(cfg_h, Config)
+    from . import initialize
+    initialize()
+    return RC.OK, _new_handle(
+        _CFleet(rs, parse_mode(mode), cfg, n_replicas))
+
+
+@_api
+def AMGX_fleet_destroy(fleet_h):
+    fl = _handles.pop(fleet_h, None)
+    if fl is not None and isinstance(fl, _CFleet):
+        fl.fleet.stop()
+    return RC.OK
+
+
+@_api
+@_outputs(1)
+def AMGX_fleet_submit(fleet_h, mtx_h, rhs_h, tenant: str = "default",
+                      deadline_s=None, request_key=None):
+    """rc, ticket handle: route one system to its affine replica and
+    enqueue it there (AMGX_service_submit semantics otherwise —
+    deadline budget, idempotent request_key)."""
+    fl = _get(fleet_h, _CFleet)
+    m = _get(mtx_h, _CMatrix)
+    b = _get(rhs_h, _CVector)
+    if m.A is None or b.v is None:
+        raise AMGXError("matrix/rhs not uploaded", RC.BAD_PARAMETERS)
+    ticket = fl.fleet.submit(m.A, b.v, tenant=tenant,
+                             deadline_s=deadline_s,
+                             request_key=request_key)
+    return RC.OK, _new_handle(ticket)
+
+
+@_api
+@_outputs(1)
+def AMGX_fleet_step(fleet_h):
+    """rc, completed count: ONE scheduler cycle on every replica."""
+    fl = _get(fleet_h, _CFleet)
+    with fl.resources.res.device_context():
+        return RC.OK, len(fl.fleet.step())
+
+
+@_api
+@_outputs(1)
+def AMGX_fleet_drain(fleet_h, timeout_s=None):
+    """rc, completed count: step the fleet until every replica is
+    idle (or timeout)."""
+    fl = _get(fleet_h, _CFleet)
+    before = fl.fleet.completed_total
+    with fl.resources.res.device_context():
+        fl.fleet.drain(timeout_s=timeout_s)
+    return RC.OK, fl.fleet.completed_total - before
+
+
+@_api
+@_outputs(1)
+def AMGX_fleet_ticket_replica(tkt_h):
+    """rc, id of the replica that served this ticket (the trace
+    chain's attribution for cross-replica postmortems), or None for a
+    ticket submitted to a bare service."""
+    from .serving import ServiceTicket
+    t = _get(tkt_h, ServiceTicket)
+    return RC.OK, getattr(t, "replica", None)
+
+
+@_api
+@_outputs(1)
+def AMGX_fleet_stats(fleet_h):
+    """rc, stats dict: per-replica service stats plus the per-replica
+    warm|cold|spill route counters and placed-fingerprint count; the
+    merged fleet metrics view lives in metrics.merge_snapshots /
+    FleetRouter.fleet_snapshot."""
+    fl = _get(fleet_h, _CFleet)
+    return RC.OK, fl.fleet.stats()
+
+
+# ---------------------------------------------------------------------------
 # system IO API
 # ---------------------------------------------------------------------------
 

@@ -511,6 +511,30 @@ def _register_default_parameters():
       "scrapes don't collide — the fleet-router prerequisite. '' "
       "defers to the AMGX_REPLICA_ID env var; either is process-wide "
       "(one replica = one process)", "")
+    R("serving_bucket_ladder", str, "mixed bucket-width ladder "
+      "(serving/ladder.py): '|'-separated strictly-increasing slot "
+      "widths (e.g. '1|4|16') the bucket builder draws from by queue "
+      "composition — each BUILD uses the smallest rung seating every "
+      "queued same-fingerprint request (capped at the top rung) "
+      "instead of the fixed serving_bucket_slots width, cutting pad "
+      "waste for singleton patterns and queue latency for bursts. "
+      "Each rung keeps its own AOT executable (slots is part of the "
+      "AOT key). '' = fixed width", "")
+    # fleet router (serving/fleet.py): N replicas behind one
+    # fingerprint-affine submit/step/drain surface
+    R("fleet_replicas", int, "replica count FleetRouter.build (and "
+      "AMGX_fleet_create without an explicit count) fronts: N "
+      "SolveService instances sharing this config, each with a "
+      "derived per-service replica id (r0..rN-1, labels its metric "
+      "series; the process-global serving_replica_id scrape label is "
+      "left alone) and, when journaling is on, a per-replica journal "
+      "subdirectory", 2, None, 1)
+    R("fleet_spill_depth", int, "queue depth at which a fingerprint's "
+      "home replica counts as overloaded and the router spills the "
+      "request to the next rendezvous candidate (only when that "
+      "candidate is strictly less loaded — a uniformly saturated "
+      "fleet keeps affinity and sheds instead of ping-ponging). "
+      "0 = auto: max(2 x serving_bucket_slots, 2)", 0, None, 0)
     R("flightrec_dir", str, "directory for the crash-surviving flight "
       "recorder (telemetry/flightrec.py): state transitions (bucket "
       "builds/quarantines, shed decisions + feasibility estimates, "

@@ -31,6 +31,17 @@ Plus the fault-tolerance layer (PR 11):
   per-tenant quotas, plus a wedged-bucket supervisor with bounded
   retry/backoff under the `serving_fault_policy` grammar.
 
+And the scale-out layer (ROADMAP item 2):
+
+- **fleet router** (`fleet.FleetRouter`): N replicas behind one
+  submit/step/drain surface with fingerprint-affine rendezvous
+  routing (warm|cold|spill), fleet-wide shed consults over merged
+  per-replica metrics, and per-request replica attribution on the
+  trace chain;
+- **mixed bucket-width ladder** (`ladder`): `serving_bucket_ladder`
+  draws each bucket build's width from the queue composition instead
+  of one fixed `serving_bucket_slots`.
+
 Quick start::
 
     from amgx_tpu.serving import SolveService
@@ -38,12 +49,22 @@ Quick start::
     t = svc.submit(A, b, tenant="alice", deadline_s=0.5)
     svc.drain()          # or svc.start() for the background scheduler
     print(t.result.status, t.latency_s)
+
+Fleet::
+
+    from amgx_tpu.serving import FleetRouter
+    fleet = FleetRouter.build(cfg, n_replicas=2)
+    t = fleet.submit(A, b, tenant="alice")
+    fleet.drain()
+    print(t.replica, t.route, fleet.stats()["routes"])
 """
 from __future__ import annotations
 
 from .aot import AotStore  # noqa: F401
 from .cache import HierarchyCache, solve_data_bytes  # noqa: F401
 from .engine import BucketEngine  # noqa: F401
+from .fleet import FleetRouter  # noqa: F401
 from .hstore import HierarchyStore  # noqa: F401
 from .journal import SolveJournal  # noqa: F401
+from .ladder import choose_slots, parse_ladder  # noqa: F401
 from .service import ServiceTicket, SolveService  # noqa: F401

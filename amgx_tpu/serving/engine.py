@@ -63,6 +63,9 @@ class BucketEngine:
                  hstore: Optional[HierarchyStore] = None):
         self.fingerprint = fingerprint
         self.slots = int(slots)
+        if self.slots < 1:
+            raise BadParametersError(
+                f"serving: bucket width must be >= 1 slot, got {slots}")
         self.chunk = int(chunk)
         self.dtype = jnp.dtype(dtype)
         self.trace_count = 0     # python traces of the engine functions
@@ -96,6 +99,8 @@ class BucketEngine:
             self._B = jnp.zeros((self.slots, self.n), self.dtype)
             self._state = self._initial_state()
             self.build_time = time.perf_counter() - t0
+        from ..telemetry import metrics as _tm
+        _tm.set_gauge("serving.bucket_width", self.slots)
         # slot bookkeeping is the scheduler's: the engine stores the
         # occupant object opaquely (a ticket, a request, anything)
         self.occupant: List[Optional[Any]] = [None] * self.slots

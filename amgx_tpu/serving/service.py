@@ -79,6 +79,7 @@ from .cache import HierarchyCache, solve_data_bytes
 from .engine import BucketEngine
 from .hstore import HierarchyStore
 from .journal import SolveJournal
+from .ladder import choose_slots, parse_ladder
 
 
 def _now() -> float:
@@ -174,11 +175,24 @@ class SolveService:
         from ..resilience.policy import parse_service_policy
         self._svc_policy = parse_service_policy(
             cfg.get("serving_fault_policy", scope))
+        # mixed bucket-width ladder: () = fixed self.slots width
+        self.ladder = parse_ladder(
+            cfg.get("serving_bucket_ladder", scope))
         # request-path tracing + fleet observability knobs
         self.tracing = bool(int(cfg.get("serving_tracing", scope)))
         replica = str(cfg.get("serving_replica_id", scope)).strip()
         if replica:
             _tm.set_replica_label(replica)
+        # per-SERVICE replica identity for in-process fleets: when
+        # non-empty, this service's latency observations carry a
+        # replica=<id> label so two replicas' per-tenant series stay
+        # distinct in the shared registry. Assigned by the FleetRouter
+        # (or explicitly on the attribute), NEVER from the knob above:
+        # serving_replica_id sets the process-global scrape label,
+        # which stamps samples at EXPOSITION time and stays clearable
+        # via set_replica_label(None) — baking it into stored label
+        # sets would survive the clear and break that contract.
+        self.replica = ""
         frdir = str(cfg.get("flightrec_dir", scope)).strip()
         if frdir:
             _fr.configure(frdir)
@@ -226,6 +240,13 @@ class SolveService:
         # recent in-bucket execution times (shed estimator window)
         import collections
         self._exec_recent = collections.deque(maxlen=64)
+        # execution-device share factor for the feasibility estimate:
+        # an in-process fleet (FleetRouter) runs N replicas on ONE
+        # device, so each replica's observed exec window undercounts
+        # wall latency by the number of co-resident replicas competing
+        # for it; the router sets this to N. Standalone services (and
+        # one-replica-per-host fleets) keep 1.0
+        self.exec_share = 1.0
         # completed journaled tickets awaiting their record_done write
         # (flushed outside the lock each cycle)
         self._journal_doneq: List[ServiceTicket] = []
@@ -291,6 +312,14 @@ class SolveService:
         ids = [t.trace_id for t in tickets
                if t is not None and t.trace_id]
         return ids or None
+
+    def _hlabels(self, tenant: str) -> Dict[str, str]:
+        """Labels for this service's histogram observations: tenant
+        always, replica only when this service has an identity (so a
+        plain single service keeps its historical label shape)."""
+        if self.replica:
+            return {"tenant": tenant, "replica": self.replica}
+        return {"tenant": tenant}
 
     # -- submission --------------------------------------------------------
     def _tenant(self, name: str) -> Dict[str, int]:
@@ -468,6 +497,12 @@ class SolveService:
         if len(self._exec_recent) >= 3:
             window = sorted(self._exec_recent)
             est = window[len(window) // 2]
+        elif self.replica:
+            # in-process fleet: train from THIS replica's labeled
+            # series, not the registry-wide aggregate a co-resident
+            # replica also feeds
+            est = _tm.quantile_where("serving.exec_s", 0.50,
+                                     {"replica": self.replica})
         else:
             est = _tm.quantile("serving.exec_s", 0.50)
         if est is None or est <= 0:
@@ -478,7 +513,8 @@ class SolveService:
             if eng is not None:
                 cap += eng.slots
         cap = max(cap, self.slots, 1)
-        return 1.25 * (1.0 + len(self._queue) / cap) * float(est)
+        return 1.25 * (1.0 + len(self._queue) / cap) * float(est) \
+            * self.exec_share
 
     _SHED_COUNTERS = {"overload": "serving.shed.overload",
                       "quota": "serving.shed.quota",
@@ -549,11 +585,11 @@ class SolveService:
         # too) so the p50/p99 the scrape reports are honest
         _tm.observe("serving.solve_latency_s",
                     t.complete_t - t.submit_t,
-                    labels={"tenant": t.tenant})
+                    labels=self._hlabels(t.tenant))
         if t.admit_t is not None:
             # the in-bucket half: what the shed estimator reads
             _tm.observe("serving.exec_s", t.complete_t - t.admit_t,
-                        labels={"tenant": t.tenant})
+                        labels=self._hlabels(t.tenant))
             self._exec_recent.append(t.complete_t - t.admit_t)
         if t.journal_id is not None and self.journal is not None:
             # queued, not written: _finish runs under the service lock
@@ -805,20 +841,34 @@ class SolveService:
                 self._queue = requeue_tickets + self._queue
 
     # -- scheduling --------------------------------------------------------
+    def _slots_for(self, t: ServiceTicket) -> int:
+        """Bucket width for the build `t` triggers: the ladder rung
+        fitting the queued same-fingerprint demand at build time (the
+        queue composition — `t` itself is still queued), or the fixed
+        serving_bucket_slots width when no ladder is configured."""
+        if not self.ladder:
+            return self.slots
+        with self._lock:
+            pending = sum(1 for q in self._queue
+                          if q.fingerprint == t.fingerprint)
+        return choose_slots(self.ladder, pending, self.slots)
+
     def _build_engine(self, t: ServiceTicket) -> BucketEngine:
         """One bucket build, wrapped in a serving.build span tagged
         with the TRIGGERING ticket's trace (the build serves every
         same-fingerprint ticket, but the oldest unserved one caused
         it) and logged on the flight recorder."""
+        slots = self._slots_for(t)
         with self._tspan("serving.build", trace=t.trace_id,
-                         fingerprint=t.fingerprint[:24]):
+                         fingerprint=t.fingerprint[:24], slots=slots):
             eng = BucketEngine(
-                self.cfg, self.scope, t.A, slots=self.slots,
+                self.cfg, self.scope, t.A, slots=slots,
                 chunk=self.chunk, dtype=t.b.dtype,
                 fingerprint=t.fingerprint, aot=self.aot,
                 hstore=self.hstore)
         _fr.record("bucket.build", trace=t.trace_id,
                    fingerprint=t.fingerprint[:24],
+                   slots=eng.slots,
                    wall_s=round(eng.build_time, 4),
                    aot_warm=eng.aot_warm,
                    hier_restored=eng.hier_restored)
@@ -964,7 +1014,7 @@ class SolveService:
                 t.admit_t = _now()
                 _tm.observe("serving.queue_wait_s",
                             t.admit_t - t.submit_t,
-                            labels={"tenant": t.tenant})
+                            labels=self._hlabels(t.tenant))
                 if self.tracing and t.trace_id:
                     # the queue wait, recorded retroactively now that
                     # it is known — the flow chain's submit->admit gap
@@ -1207,6 +1257,8 @@ class SolveService:
                     0 if self.journal is None
                     else len(self.journal.pending()),
                 "quarantined_fingerprints": len(self._faulted),
+                "replica": self.replica,
+                "bucket_ladder": list(self.ladder),
                 "tenants": {k: dict(v)
                             for k, v in self._tenants.items()},
             }

@@ -40,8 +40,9 @@ from __future__ import annotations
 
 import bisect
 import os
+import re
 import threading
-from typing import Dict, Optional, Tuple, Union
+from typing import Any, Dict, Optional, Tuple, Union
 
 _lock = threading.Lock()
 # fleet identity: a replica/shard label stamped on EVERY OpenMetrics
@@ -254,6 +255,28 @@ def snapshot() -> Dict[str, Union[int, float, dict]]:
         return out
 
 
+def quantile_where(name: str, q: float,
+                   labels: Dict[str, str]) -> Optional[float]:
+    """Estimated q-quantile aggregated across every label variant of
+    `name` whose label set CONTAINS the given pairs (subset match,
+    vs. quantile()'s exact match). This is the fleet-level read:
+    ``quantile_where("serving.solve_latency_s", 0.99, {"tenant":
+    "a"})`` folds tenant `a`'s series across every replica label into
+    one distribution. None = no matching samples."""
+    if name not in HISTOGRAMS:
+        _unknown(name, HISTOGRAMS, "histogram")
+    want = {(str(k), str(v)) for k, v in (labels or {}).items()}
+    edges = HISTOGRAM_EDGES[name]
+    counts = [0] * (len(edges) + 1)
+    with _lock:
+        for (nm, lk), h in _hists.items():
+            if nm != name or not want.issubset(set(lk)):
+                continue
+            for i, c in enumerate(h["counts"]):
+                counts[i] += c
+    return _quantile_from_counts(edges, counts, q)
+
+
 def reset():
     """Zero every counter and drop every gauge/histogram sample
     (declarations stay — a reset registry still documents its
@@ -262,6 +285,101 @@ def reset():
         _counters.clear()
         _gauges.clear()
         _hists.clear()
+
+
+# ---------------------------------------------------------------------------
+# fleet snapshot merging (serving/fleet.py + cross-process aggregation)
+# ---------------------------------------------------------------------------
+
+_ENTRY_KEY_RE = re.compile(r'^([^{]+)\{(.*)\}$')
+_LABEL_PAIR_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def _label_unescape(s: str) -> str:
+    return re.sub(r'\\(.)',
+                  lambda m: {"n": "\n"}.get(m.group(1), m.group(1)), s)
+
+
+def _parse_entry_key(key: str) -> Tuple[str, tuple]:
+    """Snapshot-entry key -> (name, ((k, v), ...)): the inverse of the
+    `name{k="v",...}` rendering snapshot() uses for labeled histogram
+    series; a bare name parses to (key, ())."""
+    m = _ENTRY_KEY_RE.match(key)
+    if not m:
+        return key, ()
+    pairs = tuple((k, _label_unescape(v))
+                  for k, v in _LABEL_PAIR_RE.findall(m.group(2)))
+    return m.group(1), pairs
+
+
+def merge_snapshots(snaps: Dict[str, dict]
+                    ) -> Dict[str, Union[int, float, dict]]:
+    """Fleet-wide aggregate of per-replica snapshot() dumps, keyed by
+    replica id: ``merge_snapshots({"r0": snap0, "r1": snap1})``.
+
+    Scalars (counters and gauges) SUM — both are live totals that add
+    across a fleet (completed requests, queue depths, cache bytes).
+    Histogram entries merge bucket-wise; edges are part of the
+    declaration, so a mismatch across snapshots raises instead of
+    producing a silently wrong distribution, and p50/p90/p99 are
+    recomputed from the merged counts (never averaged). A LABELED
+    entry missing a ``replica`` label gains one from its snapshot's
+    key, so two replicas' same-named per-tenant series never collide
+    in the merge — the in-process analog of the `serving_replica_id`
+    scrape label. For every histogram with labeled entries but no
+    bare aggregate in the inputs (per-replica filtered views), the
+    fleet-wide bare aggregate is synthesized from the labeled
+    series."""
+    scalars: Dict[str, Union[int, float]] = {}
+    # (name, sorted label pairs) -> [counts, sum, count, edges]
+    hists: Dict[Tuple[str, tuple], list] = {}
+    bare_seen = set()
+
+    def _fold(hk, val):
+        cur = hists.get(hk)
+        edges = tuple(val["edges"])
+        counts = val["counts"]
+        if cur is None:
+            hists[hk] = [list(counts), float(val["sum"]),
+                         int(val["count"]), edges]
+            return
+        if edges != cur[3] or len(counts) != len(cur[0]):
+            raise ValueError(
+                f"merge_snapshots: histogram {hk[0]!r} bucket edges "
+                f"differ across snapshots — edges are part of the "
+                f"declaration and must match to merge")
+        for i, c in enumerate(counts):
+            cur[0][i] += c
+        cur[1] += float(val["sum"])
+        cur[2] += int(val["count"])
+
+    for rid, snap in snaps.items():
+        for key, val in (snap or {}).items():
+            if isinstance(val, dict) and "counts" in val \
+                    and "edges" in val:
+                name, pairs = _parse_entry_key(key)
+                if not pairs:
+                    bare_seen.add(name)
+                elif not any(k == "replica" for k, _v in pairs):
+                    pairs = pairs + (("replica", str(rid)),)
+                _fold((name, tuple(sorted(pairs))), val)
+            elif isinstance(val, (int, float)) \
+                    and not isinstance(val, bool):
+                scalars[key] = scalars.get(key, 0) + val
+    # synthesize the fleet-wide bare aggregate where the inputs only
+    # carried labeled series (per-replica views)
+    for (name, pairs), (counts, total, n, edges) in list(hists.items()):
+        if not pairs or name in bare_seen:
+            continue
+        _fold((name, ()), {"counts": counts, "sum": total,
+                           "count": n, "edges": edges})
+    out: Dict[str, Union[int, float, dict]] = dict(scalars)
+    for (name, pairs), (counts, total, n, edges) in sorted(
+            hists.items()):
+        disp = name if not pairs else name + "{" + ",".join(
+            f'{k}="{_om_label_escape(v)}"' for k, v in pairs) + "}"
+        out[disp] = _hist_snapshot_entry(name, edges, counts, total, n)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -600,6 +718,36 @@ declare_histogram("serving.exec_s",
                   "in-bucket half of solve latency — what the shed "
                   "policy's deadline-feasibility estimate reads",
                   _LATENCY_EDGES_S)
+declare_gauge("serving.bucket_width",
+              "slot width of the most recently built serving bucket — "
+              "the mixed-width ladder's live choice "
+              "(serving_bucket_ladder; fixed-width services report "
+              "serving_bucket_slots)")
+
+# fleet router (serving/fleet.py): fingerprint-affine routing over N
+# SolveService replicas — every routing decision lands in exactly one
+# of the three route classes
+declare_counter("fleet.route.warm",
+                "requests routed to their fingerprint's home replica "
+                "(rendezvous-hash affinity): warm hierarchy cache, "
+                "hstore and AOT paths")
+declare_counter("fleet.route.cold",
+                "first-seen fingerprints placed on the least-loaded "
+                "replica (live queue depth x recent exec estimate), "
+                "becoming its home")
+declare_counter("fleet.route.spill",
+                "requests diverted off an overloaded, "
+                "quarantine-looping or deadline-infeasible home "
+                "replica to the next rendezvous candidate (each spill "
+                "writes a fleet.handoff flight-recorder note)")
+declare_counter("fleet.shed.infeasible",
+                "submits whose deadline the FLEET-WIDE feasibility "
+                "aggregate (per-replica estimates + merged per-tenant "
+                "latency) judged unmeetable on every replica — routed "
+                "home anyway so the replica's shed policy completes "
+                "them honestly OVERLOADED")
+declare_gauge("fleet.replicas",
+              "replicas fronted by the live FleetRouter")
 
 # distributed comms/shard telemetry (distributed/comms.py records at
 # TRACE time — collectives are emitted by the traced program, so the

@@ -119,6 +119,11 @@ DECLARED_SPANS: Tuple[str, ...] = (
     "serving.build",
     "serving.resume",
     "serving.complete",
+    # fleet router (serving/fleet.py): the per-request routing
+    # decision — an instant event on the ticket's flow chain carrying
+    # the serving replica id and route class (warm|cold|spill), the
+    # cross-replica postmortem's attribution anchor
+    "fleet.route",
     # distributed comms/shard telemetry: one synthetic track per
     # shard in the Perfetto export (record_span with a per-shard tid)
     "shard.solve",

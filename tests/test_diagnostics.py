@@ -612,6 +612,47 @@ def test_bench_stamps_round_and_schema(tmp_path, monkeypatch):
     assert bench.BENCH_SCHEMA_VERSION >= 2
 
 
+def test_phase_artifacts_feed_series(tmp_path):
+    """BENCH_serving.json / BENCH_fleet.json phase artifacts (round
+    stamp + `extra` scalars) contribute series points alongside the
+    wrapper rounds; an unstamped artifact contributes nothing; a
+    malformed one fails --smoke by name."""
+    with open(tmp_path / "BENCH_r06.json", "w") as f:
+        json.dump(_wrapper(6, {"northstar_256^3_setup_warm_s": 5.0}), f)
+    with open(tmp_path / "BENCH_fleet.json", "w") as f:
+        json.dump({"metric": "fleet scaling", "value": 2.0, "unit": "x",
+                   "round": 6,
+                   "extra": {"fleet_scaling_efficiency": 1.3,
+                             "fleet_p99_at_2x_ms": 900.0,
+                             "fleet_ok": True}}, f)
+    # unstamped (standalone run outside the driver): ignored, not fatal
+    with open(tmp_path / "BENCH_serving.json", "w") as f:
+        json.dump({"metric": "serving", "value": 9.0,
+                   "extra": {"serving_solves_per_s": 9.0}}, f)
+    p = _run_history(["--root", str(tmp_path)])
+    assert p.returncode == 0, p.stdout + p.stderr
+    hist = json.load(open(tmp_path / "BENCH_HISTORY.json"))
+    assert hist["series"]["fleet_scaling_efficiency"]["points"] == \
+        [{"round": 6, "value": 1.3}]
+    assert hist["series"]["fleet_p99_at_2x_ms"]["points"] == \
+        [{"round": 6, "value": 900.0}]
+    assert hist["series"]["serving_solves_per_s"]["points"] == []
+    assert "BENCH_fleet.json" in hist["rounds"][0]["files"]
+    # a wrapper round carrying the same key wins over the artifact
+    with open(tmp_path / "BENCH_r06.json", "w") as f:
+        json.dump(_wrapper(6, {"fleet_scaling_efficiency": 1.9}), f)
+    p = _run_history(["--root", str(tmp_path)])
+    assert p.returncode == 0, p.stdout + p.stderr
+    hist = json.load(open(tmp_path / "BENCH_HISTORY.json"))
+    assert hist["series"]["fleet_scaling_efficiency"]["points"] == \
+        [{"round": 6, "value": 1.9}]
+    with open(tmp_path / "BENCH_fleet.json", "w") as f:
+        f.write("{not json")
+    p = _run_history(["--smoke", "--root", str(tmp_path)])
+    assert p.returncode != 0
+    assert "BENCH_fleet.json" in p.stdout
+
+
 # ---------------------------------------------------------------------------
 # metric-name lint (tools/check_spans.py contract 3)
 # ---------------------------------------------------------------------------

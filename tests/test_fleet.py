@@ -1,0 +1,448 @@
+"""Fleet router + mixed bucket-width ladder tests
+(amgx_tpu/serving/fleet.py, ladder.py): fingerprint-affine routing
+(stickiness, least-loaded cold placement, overload spill with a
+handoff flight event, quarantine spill with rehoming), fleet-wide
+deadline-infeasibility consults over merged per-replica metrics,
+drain-all-terminal under an injected replica build crash, trace-chain
+replica attribution, the replica-label collision regression
+(auto-assigned ids + metrics.merge_snapshots), ladder width selection
+and per-width AOT-key separation, and the AMGX_fleet_* capi surface.
+No reference analog — AMGX ships no multi-replica router; the fleet
+layer is new."""
+import numpy as np
+import pytest
+
+import amgx_tpu as amgx
+from amgx_tpu import gallery
+from amgx_tpu.batch.queue import pattern_fingerprint
+from amgx_tpu.config import Config
+from amgx_tpu.errors import BadParametersError
+from amgx_tpu.presets import BATCHED_CG
+from amgx_tpu.resilience import faultinject
+from amgx_tpu.resilience.status import SolveStatus
+from amgx_tpu.serving import (AotStore, BucketEngine, FleetRouter,
+                              SolveService, choose_slots, parse_ladder)
+from amgx_tpu.serving.fleet import _rendezvous_score
+from amgx_tpu.telemetry import flightrec as _frec
+from amgx_tpu.telemetry import metrics
+from amgx_tpu.telemetry import spans as _spans
+
+amgx.initialize()
+
+
+@pytest.fixture(scope="module")
+def poisson16():
+    return gallery.poisson("5pt", 16, 16).init()
+
+
+@pytest.fixture(scope="module")
+def poisson14():
+    return gallery.poisson("5pt", 14, 14).init()
+
+
+def _shift(A, c):
+    vals = np.asarray(A.values).copy()
+    vals[np.asarray(A.diag_idx)] += c
+    return A.with_values(vals)
+
+
+def _rhs(A, seed=0):
+    return np.random.default_rng(seed).standard_normal(A.num_rows)
+
+
+def _svc_cfg(extra=""):
+    return Config.from_string(
+        BATCHED_CG + ", serving_bucket_slots=2, serving_chunk_iters=4"
+        + (", " + extra if extra else ""))
+
+
+def _key(A, b):
+    return f"{pattern_fingerprint(A)}/{np.asarray(b).dtype}"
+
+
+def _fleet(extra="", n=2):
+    return FleetRouter.build(_svc_cfg(extra=extra), n)
+
+
+# ---------------------------------------------------------------------------
+# ladder: parsing + width selection + AOT-key separation
+# ---------------------------------------------------------------------------
+
+
+def test_ladder_parse():
+    assert parse_ladder("1|4|16") == (1, 4, 16)
+    assert parse_ladder(" 2 | 8 ") == (2, 8)
+    assert parse_ladder("4") == (4,)
+    assert parse_ladder("") == ()
+    for bad in ("0|2", "4|2", "2|2", "a|b", "-1"):
+        with pytest.raises(BadParametersError):
+            parse_ladder(bad)
+
+
+def test_choose_slots():
+    assert choose_slots((1, 4, 16), 1, 8) == 1
+    assert choose_slots((1, 4, 16), 3, 8) == 4
+    assert choose_slots((1, 4, 16), 4, 8) == 4
+    assert choose_slots((1, 4, 16), 99, 8) == 16   # burst > top rung
+    assert choose_slots((), 99, 8) == 8            # ladder off
+    assert choose_slots((2, 4), 0, 8) == 2         # pending clamps >= 1
+
+
+def test_ladder_width_follows_queue_composition(poisson16):
+    """A singleton fingerprint builds the narrowest rung; a burst
+    queued at build time gets the smallest rung that seats it."""
+    ladder = "serving_bucket_ladder=1|2|4"
+    svc = SolveService(_svc_cfg(extra=ladder))
+    b = _rhs(poisson16, 1)
+    t = svc.submit(poisson16, b)
+    svc.drain(timeout_s=300)
+    assert t.done and t.result.converged
+    eng = svc.buckets.peek(_key(poisson16, b))
+    assert eng is not None and eng.slots == 1
+
+    svc2 = SolveService(_svc_cfg(extra=ladder))
+    ts = [svc2.submit(_shift(poisson16, 0.1 * i), _rhs(poisson16, i))
+          for i in range(3)]
+    done = svc2.drain(timeout_s=300)
+    assert len(done) == 3 and all(t.result.converged for t in ts)
+    eng = svc2.buckets.peek(_key(poisson16, _rhs(poisson16, 0)))
+    assert eng is not None and eng.slots == 4    # smallest rung >= 3
+
+
+def test_ladder_off_keeps_fixed_width(poisson16):
+    svc = SolveService(_svc_cfg())
+    assert svc.ladder == ()
+    b = _rhs(poisson16, 2)
+    svc.submit(poisson16, b)
+    svc.drain(timeout_s=300)
+    eng = svc.buckets.peek(_key(poisson16, b))
+    assert eng is not None and eng.slots == svc.slots == 2
+
+
+def test_ladder_widths_get_distinct_aot_keys(poisson16, tmp_path):
+    """`slots` is part of the AOT key: every rung keeps its own
+    exported executable, widths never cross-serve traces."""
+    aot = AotStore(str(tmp_path))
+    cfg = _svc_cfg()
+    e1 = BucketEngine(cfg, "default", poisson16, slots=1, chunk=4,
+                      dtype=np.float64, fingerprint="fpX")
+    e2 = BucketEngine(cfg, "default", poisson16, slots=2, chunk=4,
+                      dtype=np.float64, fingerprint="fpX")
+    e1b = BucketEngine(cfg, "default", poisson16, slots=1, chunk=4,
+                       dtype=np.float64, fingerprint="fpX")
+    assert e1._aot_key(aot) != e2._aot_key(aot)
+    assert e1._aot_key(aot) == e1b._aot_key(aot)
+
+
+def test_engine_rejects_nonpositive_width(poisson16):
+    with pytest.raises(BadParametersError):
+        BucketEngine(_svc_cfg(), "default", poisson16, slots=0,
+                     chunk=4, dtype=np.float64)
+
+
+# ---------------------------------------------------------------------------
+# router: affinity, cold placement, spill, rehoming
+# ---------------------------------------------------------------------------
+
+
+def test_affinity_stickiness(poisson16, poisson14):
+    """Same fingerprint -> same replica across submits; distinct
+    fingerprints spread by least-loaded cold placement."""
+    fleet = _fleet()
+    tickets = []
+    for i in range(8):
+        A = poisson16 if i % 2 == 0 else poisson14
+        tickets.append(fleet.submit(_shift(A, 0.05 * i), _rhs(A, i)))
+    fleet.drain(timeout_s=300)
+    assert all(t.done and t.result.converged for t in tickets)
+    homes = {}
+    for t in tickets:
+        fp = t.fingerprint
+        homes.setdefault(fp, t.replica)
+        assert t.replica == homes[fp]          # sticky
+    assert len(homes) == 2
+    routes = fleet.stats()["routes"]
+    warm = sum(c["warm"] for c in routes.values())
+    cold = sum(c["cold"] for c in routes.values())
+    spill = sum(c["spill"] for c in routes.values())
+    assert cold == 2 and warm == 6 and spill == 0
+    # the two patterns spread across both replicas (the second cold
+    # placement saw the first one's queued load)
+    assert len(set(homes.values())) == 2
+
+
+def test_rendezvous_is_stable():
+    a = _rendezvous_score("fp1", "r0")
+    assert a == _rendezvous_score("fp1", "r0")
+    assert a != _rendezvous_score("fp1", "r1")
+
+
+def test_spill_on_overload_writes_handoff(poisson16):
+    """An overloaded home (queue depth past fleet_spill_depth, with a
+    strictly less-loaded candidate) spills to the next rendezvous
+    candidate; the flight recorder gets the affinity-handoff note and
+    the placement map keeps the original home (no rehome on load)."""
+    seq0 = _frec.last_seq()
+    fleet = _fleet(extra="fleet_spill_depth=1")
+    t1 = fleet.submit(poisson16, _rhs(poisson16, 1))
+    home = t1.replica
+    assert t1.route == "cold"
+    t2 = fleet.submit(_shift(poisson16, 0.1), _rhs(poisson16, 2))
+    assert t2.route == "spill" and t2.replica != home
+    ev = _frec.events(kind="fleet.handoff", since_seq=seq0)
+    assert len(ev) == 1
+    assert ev[0]["from_replica"] == home
+    assert ev[0]["to_replica"] == t2.replica
+    assert ev[0]["reason"] == "overload"
+    assert fleet._placed[t1.fingerprint] == home   # not rehomed
+    fleet.drain(timeout_s=300)
+    assert t1.done and t2.done
+    routes = fleet.stats()["routes"]
+    assert routes[t2.replica]["spill"] == 1
+
+
+def test_saturated_fleet_keeps_affinity(poisson16):
+    """No spill ping-pong: when EVERY replica is loaded past the
+    spill depth, requests stay home (warm) instead of bouncing cold
+    builds between equally-overloaded replicas."""
+    fleet = _fleet(extra="fleet_spill_depth=1")
+    A2 = gallery.poisson("5pt", 15, 15).init()
+    t1 = fleet.submit(poisson16, _rhs(poisson16, 1))
+    t2 = fleet.submit(A2, _rhs(A2, 2))
+    assert t2.replica != t1.replica       # least-loaded cold split
+    # both replicas now at depth 1 == spill limit: no candidate is
+    # strictly less loaded, so same-fp traffic must stay home
+    t3 = fleet.submit(_shift(poisson16, 0.1), _rhs(poisson16, 3))
+    assert t3.route == "warm" and t3.replica == t1.replica
+    fleet.drain(timeout_s=300)
+    assert all(t.done for t in (t1, t2, t3))
+
+
+def test_quarantine_spill_rehomes_and_drain_all_terminal(poisson16):
+    """A build crash on the home replica: its fault/backoff state
+    makes the router spill same-fingerprint traffic to a healthy
+    replica AND rehome the fingerprint there; the fleet drain still
+    ends with every ticket terminal (the crashed replica retries
+    behind its backoff window)."""
+    seq0 = _frec.last_seq()
+    fleet = _fleet(extra="serving_fault_policy=BUILD_FAILED>"
+                         "retry_backoff, serving_retry_backoff_s=0.05")
+    b = _rhs(poisson16, 5)
+    with faultinject.inject("build_crash", fires=1):
+        t1 = fleet.submit(poisson16, b)
+        home = t1.replica
+        # step until the injected crash lands in the home's fault state
+        for _ in range(50):
+            fleet.step()
+            if t1.fingerprint in fleet.replicas[home]._faulted:
+                break
+        assert t1.fingerprint in fleet.replicas[home]._faulted
+        t2 = fleet.submit(_shift(poisson16, 0.2), _rhs(poisson16, 6))
+    assert t2.route == "spill" and t2.replica != home
+    assert fleet._placed[t1.fingerprint] == t2.replica   # rehomed
+    ev = _frec.events(kind="fleet.handoff", since_seq=seq0)
+    assert ev and ev[-1]["reason"] == "quarantine"
+    done = fleet.drain(timeout_s=300)
+    assert t1.done and t2.done                 # all-terminal
+    assert t1.result.converged and t2.result.converged
+    assert len(done) == 2
+
+
+def test_fleet_shed_consults_fleetwide_estimates(poisson16, poisson14):
+    """When EVERY replica's feasibility estimate says a deadline is
+    unmeetable, the router records the fleet-wide consult (estimates +
+    merged per-tenant quantiles) and routes home for the honest
+    OVERLOADED shed."""
+    fleet = _fleet(extra="serving_shed_policy=deadline")
+    # train BOTH replicas' estimators (>= 3 completions each)
+    for i in range(4):
+        fleet.submit(_shift(poisson16, 0.1 * i), _rhs(poisson16, i),
+                     tenant="acme")
+        fleet.submit(_shift(poisson14, 0.1 * i), _rhs(poisson14, i),
+                     tenant="acme")
+    fleet.drain(timeout_s=300)
+    for svc in fleet.replicas.values():
+        assert len(svc._exec_recent) >= 3
+    seq0 = _frec.last_seq()
+    before = metrics.get("fleet.shed.infeasible")
+    t = fleet.submit(poisson16, _rhs(poisson16, 9), tenant="acme",
+                     deadline_s=1e-9)
+    assert t.route == "warm"                   # stayed home
+    assert t.done and t.result.status_code == int(SolveStatus.OVERLOADED)
+    assert metrics.get("fleet.shed.infeasible") == before + 1
+    ev = _frec.events(kind="fleet.shed", since_seq=seq0)
+    assert len(ev) == 1 and ev[0]["verdict"] == "infeasible"
+    assert set(ev[0]["estimates_s"]) == set(fleet.replicas)
+    assert all(e is not None and e > 1e-9
+               for e in ev[0]["estimates_s"].values())
+    assert ev[0]["tenant_p99_s"] is not None   # merged per-tenant read
+
+
+def test_trace_chain_records_serving_replica(poisson16):
+    """Replica attribution on the flow chain: the fleet.route instant
+    event carries the ticket's trace id, serving replica and route
+    class — what a cross-replica flightrec/Perfetto postmortem pivots
+    on."""
+    fleet = _fleet()
+    t = fleet.submit(poisson16, _rhs(poisson16, 7))
+    assert t.trace_id
+    fleet.drain(timeout_s=300)
+    recs = [r for r in _spans.records()
+            if r["name"] == "fleet.route"
+            and r.get("args", {}).get("trace") == t.trace_id]
+    assert len(recs) == 1
+    assert recs[0]["args"]["replica"] == t.replica
+    assert recs[0]["args"]["route"] == t.route == "cold"
+
+
+# ---------------------------------------------------------------------------
+# replica labels + snapshot merging (the collision regression)
+# ---------------------------------------------------------------------------
+
+
+def test_auto_assigned_replica_ids_keep_series_distinct(poisson16,
+                                                        poisson14):
+    """Two services constructed WITHOUT serving_replica_id used to
+    scrape identically; the router must assign distinct ids and the
+    merged snapshot must keep their series apart."""
+    s0, s1 = SolveService(_svc_cfg()), SolveService(_svc_cfg())
+    assert s0.replica == "" and s1.replica == ""
+    fleet = FleetRouter([s0, s1])
+    assert {s0.replica, s1.replica} == {"r0", "r1"}
+    fleet.submit(poisson16, _rhs(poisson16, 1), tenant="dupes")
+    fleet.submit(poisson14, _rhs(poisson14, 2), tenant="dupes")
+    fleet.drain(timeout_s=300)
+    views = fleet.snapshots()
+    assert all(views[rid] for rid in ("r0", "r1"))
+    assert not set(views["r0"]) & set(views["r1"])   # disjoint series
+    merged = fleet.fleet_snapshot()
+    k0 = 'serving.solve_latency_s{replica="r0",tenant="dupes"}'
+    k1 = 'serving.solve_latency_s{replica="r1",tenant="dupes"}'
+    assert merged[k0]["count"] == 1 and merged[k1]["count"] == 1
+    # the synthesized fleet-wide aggregate equals the per-replica sum
+    per_replica = sum(
+        v["count"] for k, v in merged.items()
+        if k.startswith("serving.solve_latency_s{"))
+    assert merged["serving.solve_latency_s"]["count"] == per_replica
+
+
+def test_router_rejects_duplicate_replica_ids(poisson16):
+    s0, s1 = SolveService(_svc_cfg()), SolveService(_svc_cfg())
+    s0.replica = s1.replica = "twin"
+    with pytest.raises(BadParametersError):
+        FleetRouter([s0, s1])
+
+
+def test_merge_snapshots_unit():
+    def h(counts, total):
+        return {"count": sum(counts), "sum": total,
+                "edges": [0.5, 1.0], "counts": list(counts)}
+    snaps = {
+        "a": {"c": 2, "g": 1.5, 'h{tenant="x"}': h([1, 0, 0], 0.2),
+              "h": h([1, 0, 0], 0.2)},
+        "b": {"c": 3, 'h{tenant="x"}': h([0, 2, 0], 1.4),
+              "h": h([0, 2, 0], 1.4)},
+    }
+    m = metrics.merge_snapshots(snaps)
+    assert m["c"] == 5 and m["g"] == 1.5           # scalars sum
+    # same-named labeled series gained the snapshot's replica id
+    ka = 'h{replica="a",tenant="x"}'
+    kb = 'h{replica="b",tenant="x"}'
+    assert m[ka]["count"] == 1 and m[kb]["count"] == 2
+    # bare entries merged bucket-wise, quantiles recomputed
+    assert m["h"]["count"] == 3 and m["h"]["counts"] == [1, 2, 0]
+    assert 0.5 <= m["h"]["p50"] <= 1.0
+    # an entry already carrying a replica label keeps it
+    m2 = metrics.merge_snapshots(
+        {"z": {'h{replica="keep",tenant="x"}': h([1, 0, 0], 0.1)}})
+    assert 'h{replica="keep",tenant="x"}' in m2
+
+
+def test_merge_snapshots_edge_mismatch_raises():
+    e1 = {"count": 1, "sum": 0.1, "edges": [0.5, 1.0],
+          "counts": [1, 0, 0]}
+    e2 = {"count": 1, "sum": 0.1, "edges": [0.25, 1.0],
+          "counts": [1, 0, 0]}
+    with pytest.raises(ValueError):
+        metrics.merge_snapshots({"a": {"h": e1}, "b": {"h": e2}})
+
+
+def test_quantile_where_subset_match():
+    metrics.observe("serving.solve_latency_s", 0.011,
+                    labels={"tenant": "qw_only", "replica": "qz0"})
+    metrics.observe("serving.solve_latency_s", 0.013,
+                    labels={"tenant": "qw_only", "replica": "qz1"})
+    q = metrics.quantile_where("serving.solve_latency_s", 0.50,
+                               {"tenant": "qw_only"})
+    assert q is not None and 0.005 <= q <= 0.05
+    assert metrics.quantile_where("serving.solve_latency_s", 0.50,
+                                  {"tenant": "qw_nobody"}) is None
+
+
+# ---------------------------------------------------------------------------
+# capi surface
+# ---------------------------------------------------------------------------
+
+
+def test_capi_fleet_roundtrip(poisson16):
+    from amgx_tpu import capi
+    assert capi.AMGX_initialize() == 0
+    rc, cfg_h = capi.AMGX_config_create(
+        BATCHED_CG + ", serving_bucket_slots=2, fleet_replicas=2")
+    assert rc == 0
+    rc, rsrc_h = capi.AMGX_resources_create_simple(cfg_h)
+    assert rc == 0
+    rc, fleet_h = capi.AMGX_fleet_create(rsrc_h, "dDDI", cfg_h)
+    assert rc == 0
+    rc, m_h = capi.AMGX_matrix_create(rsrc_h, "dDDI")
+    rc, b_h = capi.AMGX_vector_create(rsrc_h, "dDDI")
+    rc, x_h = capi.AMGX_vector_create(rsrc_h, "dDDI")
+    ro = np.asarray(poisson16.row_offsets)
+    ci = np.asarray(poisson16.col_indices)
+    v = np.asarray(poisson16.values)
+    assert capi.AMGX_matrix_upload_all(
+        m_h, poisson16.num_rows, v.size, 1, 1, ro, ci, v, None) == 0
+    b = _rhs(poisson16, 21)
+    assert capi.AMGX_vector_upload(b_h, b.size, 1, b) == 0
+    rc, t1 = capi.AMGX_fleet_submit(fleet_h, m_h, b_h, "acme", None)
+    assert rc == 0
+    rc, t2 = capi.AMGX_fleet_submit(fleet_h, m_h, b_h, "acme", None)
+    assert rc == 0
+    rc, n_done = capi.AMGX_fleet_drain(fleet_h, 300)
+    assert rc == 0 and n_done == 2
+    rc, done, st = capi.AMGX_service_ticket_status(t1)
+    assert rc == 0 and done == 1 and st == 0      # AMGX_SOLVE_SUCCESS
+    rc, rid1 = capi.AMGX_fleet_ticket_replica(t1)
+    rc, rid2 = capi.AMGX_fleet_ticket_replica(t2)
+    assert rid1 in ("r0", "r1") and rid2 == rid1  # affine
+    assert capi.AMGX_service_ticket_download(t1, x_h) == 0
+    rc, stats = capi.AMGX_fleet_stats(fleet_h)
+    assert rc == 0 and set(stats["routes"]) == {"r0", "r1"}
+    total_routes = sum(sum(c.values())
+                       for c in stats["routes"].values())
+    assert total_routes == 2
+    rc, tr = capi.AMGX_ticket_trace(t1)
+    assert rc == 0 and tr          # trace id works on fleet tickets
+    assert capi.AMGX_service_ticket_destroy(t1) == 0
+    assert capi.AMGX_service_ticket_destroy(t2) == 0
+    assert capi.AMGX_fleet_destroy(fleet_h) == 0
+
+
+# ---------------------------------------------------------------------------
+# fleet journaling isolation
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_build_splits_journal_dirs(poisson16, tmp_path):
+    """FleetRouter.build gives every replica its own journal
+    subdirectory — two replicas must never replay each other's
+    records."""
+    fleet = _fleet(extra=f"serving_journal_dir={tmp_path}")
+    dirs = {rid: svc.journal.root if hasattr(svc.journal, "root")
+            else getattr(svc.journal, "directory", None)
+            for rid, svc in fleet.replicas.items()}
+    vals = set(str(d) for d in dirs.values())
+    assert len(vals) == 2 and all(v is not None for v in vals)
+    t = fleet.submit(poisson16, _rhs(poisson16, 3))
+    fleet.drain(timeout_s=300)
+    assert t.done and t.result.converged
