@@ -1058,6 +1058,42 @@ def AMGX_fleet_stats(fleet_h):
     return RC.OK, fl.fleet.stats()
 
 
+@_api
+@_outputs(1)
+def AMGX_fleet_drain_replica(fleet_h, replica: str):
+    """rc, handed-off queue count: administratively drain one replica
+    for a rolling restart — no new placements land on it, its queued
+    tickets move to survivors (the journal rides along), in-flight
+    work finishes in place. `AMGX_fleet_restore_replica` re-enters it
+    into the rendezvous."""
+    fl = _get(fleet_h, _CFleet)
+    return RC.OK, fl.fleet.drain_replica(str(replica))
+
+
+@_api
+def AMGX_fleet_restore_replica(fleet_h, replica: str):
+    """rc: re-enter a drained/down replica into the rendezvous —
+    breaker reset, captured error cleared, cold-placement warm-up
+    grace started (rehomed fingerprints stay with their adopter until
+    natural eviction)."""
+    fl = _get(fleet_h, _CFleet)
+    fl.fleet.restore_replica(str(replica))
+    return RC.OK
+
+
+@_api
+@_outputs(1)
+def AMGX_fleet_health(fleet_h):
+    """rc, health dict per replica: breaker state
+    (closed|open|half_open), down/draining flags, consecutive
+    failures, last health event, live scheduler facts (cycle counter,
+    thread aliveness, captured error, queue depth) — the
+    serving/health.py monitor's view, for ops dashboards and the
+    rolling-restart loop."""
+    fl = _get(fleet_h, _CFleet)
+    return RC.OK, fl.fleet.health_snapshot()
+
+
 # ---------------------------------------------------------------------------
 # system IO API
 # ---------------------------------------------------------------------------

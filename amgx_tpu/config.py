@@ -535,6 +535,37 @@ def _register_default_parameters():
       "candidate is strictly less loaded — a uniformly saturated "
       "fleet keeps affinity and sheds instead of ping-ponging). "
       "0 = auto: max(2 x serving_bucket_slots, 2)", 0, None, 0)
+    R("fleet_fault_policy", str, "per-replica breaker chains "
+      "'EVENT>action|...' (serving/health.py): events REPLICA_DEAD/"
+      "REPLICA_WEDGED/REPLICA_SLOW, actions failover (rehome + move "
+      "tickets + journal adoption), probe_backoff (OPEN the breaker "
+      "for fleet_probe_backoff_s x 2^n, then HALF_OPEN one trial "
+      "fingerprint), ignore. The Nth consecutive event takes the "
+      "chain's Nth step (last repeats)",
+      "REPLICA_DEAD>failover|REPLICA_WEDGED>probe_backoff"
+      "|REPLICA_WEDGED>failover|REPLICA_SLOW>probe_backoff")
+    R("fleet_suspect_checks", int, "consecutive rate-limited health "
+      "checks a BUSY replica's scheduler-cycle counter must flatline "
+      "before the monitor calls it REPLICA_WEDGED (the first "
+      "flatlined check already marks it SUSPECT in the flight "
+      "recorder)", 4, None, 1)
+    R("fleet_probe_backoff_s", float, "base of the breaker's bounded "
+      "exponential backoff: an OPEN replica is re-probed (HALF_OPEN, "
+      "one trial fingerprint) after fleet_probe_backoff_s x 2^n, "
+      "exponent capped at 6", 0.05, None, 0.0)
+    R("fleet_health_check_s", float, "heartbeat sampling window: "
+      "wedge/slow counting reads each replica's cycle counter at "
+      "most once per this many seconds (dead-thread detection is "
+      "never rate-limited)", 0.25, None, 0.001)
+    R("fleet_warmup_s", float, "restore grace: a just-restored "
+      "replica takes no COLD placements for this long, so an empty "
+      "(least-loaded) returnee doesn't instantly become every new "
+      "fingerprint's home; warm traffic returns at once", 1.0,
+      None, 0.0)
+    R("fleet_slow_cycle_s", float, "pace threshold: a busy replica "
+      "whose per-scheduler-cycle wall between health checks exceeds "
+      "this emits REPLICA_SLOW through the fault-policy chain. "
+      "0 = disabled", 0.0, None, 0.0)
     R("flightrec_dir", str, "directory for the crash-surviving flight "
       "recorder (telemetry/flightrec.py): state transitions (bucket "
       "builds/quarantines, shed decisions + feasibility estimates, "

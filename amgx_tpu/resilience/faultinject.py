@@ -53,6 +53,24 @@ counts straight occurrences:
   damage is discovered at read time, which must degrade, never hang);
 - ``clock_skew``     — `service_now()` returns monotonic time shifted
   by `value` seconds (deadline bookkeeping under a skewed clock).
+
+**Fleet-level chaos** (the replica health/failover layer,
+serving/fleet.py + serving/health.py) targets a whole REPLICA's
+scheduler instead of one bucket; `target` names the replica id the
+fault lands on (empty = the first replica whose scheduler crosses the
+hook):
+
+- ``replica_kill``  — raise ChaosInjected at the top of the targeted
+  replica's next step() cycle(s): a background scheduler thread dies
+  with the captured exception, an inline-driven fleet surfaces it
+  through the router — either way the health monitor must declare the
+  replica DEAD and fail over;
+- ``replica_wedge`` — the targeted replica's next cycle(s) return
+  without doing anything and WITHOUT advancing the cycle counter: the
+  replica-level heartbeat flatline the breaker opens on;
+- ``replica_slow``  — sleep `value` seconds at the top of the targeted
+  replica's next cycle(s): per-cycle wall blows past
+  `fleet_slow_cycle_s` and the health monitor counts REPLICA_SLOW.
 """
 from __future__ import annotations
 
@@ -66,7 +84,10 @@ from typing import Optional
 KINDS = ("spmv_nan", "halo_corrupt", "galerkin_perturb",
          # service-level (host-side) chaos kinds — serving/
          "build_crash", "step_crash", "step_wedge",
-         "journal_corrupt", "aot_corrupt", "clock_skew")
+         "journal_corrupt", "aot_corrupt", "clock_skew",
+         # fleet-level chaos kinds (whole-replica faults) — serving/
+         # fleet.py + serving/health.py failover drills
+         "replica_kill", "replica_wedge", "replica_slow")
 
 
 class ChaosInjected(RuntimeError):
@@ -85,6 +106,7 @@ class FaultSpec:
     value: float = math.nan  # poison value for spmv/halo corruption
     scale: float = 100.0   # multiplicative perturbation for galerkin
     fires: Optional[int] = 1  # armed traces/applications left; None = always
+    target: str = ""       # replica id for replica_* kinds ("" = any)
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -146,6 +168,8 @@ def _check_env():
             kw[k] = int(v)
         elif k in ("value", "scale"):
             kw[k] = float(v)
+        elif k == "target":
+            kw[k] = v.strip()
     _SPEC = FaultSpec(parts[0].strip(), **kw)
     _bump()
     # the env path is how a LIVE process gets a drill — its arming
@@ -331,6 +355,56 @@ def service_now() -> float:
     if spec is None:
         return now
     return now + float(spec.value)
+
+
+# -- fleet-level hooks (whole-replica faults; serving/fleet.py) ---------
+
+
+def _replica_spec(kind: str, replica: str) -> Optional[FaultSpec]:
+    """The armed replica-fault spec for `kind` when it targets THIS
+    replica (spec.target empty = any replica's scheduler may trip it)."""
+    spec = active(kind)
+    if spec is None:
+        return None
+    if spec.target and spec.target != str(replica):
+        return None
+    return spec
+
+
+def replica_crash(replica: str):
+    """Raise ChaosInjected at the top of the targeted replica's
+    scheduler cycle while 'replica_kill' is armed — the whole-replica
+    analog of service_crash (one consumed firing per raise)."""
+    spec = _replica_spec("replica_kill", replica)
+    if spec is None:
+        return
+    consume("replica_kill")
+    raise ChaosInjected(
+        f"chaos: injected replica_kill on {replica or 'replica'}")
+
+
+def replica_wedged(replica: str) -> bool:
+    """True while 'replica_wedge' targets this replica: the scheduler
+    cycle returns without running AND without advancing the cycle
+    counter — the replica-level heartbeat flatline (one firing per
+    wedged cycle)."""
+    spec = _replica_spec("replica_wedge", replica)
+    if spec is None:
+        return False
+    consume("replica_wedge")
+    return True
+
+
+def replica_delay(replica: str) -> float:
+    """Seconds to stall the targeted replica's cycle while
+    'replica_slow' is armed (spec.value; one firing per slowed
+    cycle), else 0.0."""
+    spec = _replica_spec("replica_slow", replica)
+    if spec is None:
+        return 0.0
+    consume("replica_slow")
+    v = float(spec.value)
+    return v if math.isfinite(v) and v > 0.0 else 0.0
 
 
 def perturb_galerkin(Ac, level: int):

@@ -57,6 +57,16 @@ ACTIONS = ("retry", "rescale_retry", "switch_solver", "escalate_sweeps")
 SERVICE_EVENTS = ("BUILD_FAILED", "STEP_FAILED", "WEDGED")
 SERVICE_ACTIONS = ("retry_backoff", "requeue", "reject")
 
+# fleet-level grammar (fleet_fault_policy, serving/health.py): the same
+# 'EVENT>action|...' shape one level up — keyed on REPLICA health
+# events instead of per-fingerprint service events. Multiple steps for
+# one event form a chain tried in order across that replica's
+# consecutive verdicts (the last step repeats once the chain is
+# exhausted, so a 'probe_backoff|failover' wedge chain probes once and
+# then fails over for good).
+FLEET_EVENTS = ("REPLICA_DEAD", "REPLICA_WEDGED", "REPLICA_SLOW")
+FLEET_ACTIONS = ("failover", "probe_backoff", "ignore")
+
 ANY = "ANY"
 
 _STATUS_ALIASES = {"NAN": "NAN_DETECTED", "DEADLINE": "DEADLINE_EXCEEDED"}
@@ -139,6 +149,49 @@ def parse_service_policy(spec: str) -> Dict[str, List[str]]:
             raise BadConfigurationError(
                 f"serving_fault_policy: unknown action {action!r}"
                 f"{did_you_mean(action, SERVICE_ACTIONS)}")
+        policy.setdefault(ev, []).append(action)
+    return policy
+
+
+def parse_fleet_policy(spec: str) -> Dict[str, List[str]]:
+    """Parse the fleet-level grammar into {event: [action, ...]}.
+    Events: REPLICA_DEAD (the replica's scheduler thread died with a
+    captured exception, or an inline step() raised), REPLICA_WEDGED
+    (the replica is busy but its cycle counter flatlined across
+    consecutive health checks), REPLICA_SLOW (cycles advance, but
+    slower than `fleet_slow_cycle_s` per cycle). Actions:
+
+    * ``failover``      — declare the replica DOWN: rehome its
+      fingerprints along rendezvous order, move its queued/in-flight
+      tickets to survivors, adopt its journal;
+    * ``probe_backoff`` — open the circuit breaker (no new placements)
+      for a bounded exponential backoff (fleet_probe_backoff_s *
+      2^failures), then HALF_OPEN: exactly one trial fingerprint is
+      admitted until the replica proves progress;
+    * ``ignore``        — count the event, change nothing.
+
+    Raises BadConfigurationError (with a did-you-mean) on unknown
+    events or actions, mirroring parse_service_policy."""
+    policy: Dict[str, List[str]] = {}
+    for step in str(spec or "").split("|"):
+        step = step.strip()
+        if not step:
+            continue
+        if ">" not in step:
+            raise BadConfigurationError(
+                f"fleet_fault_policy step {step!r}: expected "
+                f"'EVENT>action'")
+        ev, action = (p.strip() for p in step.split(">", 1))
+        ev = ev.upper()
+        if ev not in FLEET_EVENTS:
+            raise BadConfigurationError(
+                f"fleet_fault_policy: unknown event {ev!r}"
+                f"{did_you_mean(ev, FLEET_EVENTS)}")
+        action = action.strip().lower()
+        if action not in FLEET_ACTIONS:
+            raise BadConfigurationError(
+                f"fleet_fault_policy: unknown action {action!r}"
+                f"{did_you_mean(action, FLEET_ACTIONS)}")
         policy.setdefault(ev, []).append(action)
     return policy
 

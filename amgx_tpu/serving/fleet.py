@@ -50,6 +50,24 @@ attributes and, when tracing is on, a `fleet.route` instant event on
 its flow chain — so `tools/flightrec.py --trace <id>` and the
 Perfetto export both say which replica served a request across a
 cross-replica postmortem.
+
+**Fault tolerance (PR 17).** The router owns a `HealthMonitor`
+(serving/health.py) and ticks it from submit/step/drain. Routing is
+availability-aware: DOWN, draining and breaker-OPEN replicas take no
+traffic, a HALF_OPEN replica admits exactly one probe fingerprint,
+and a just-restored replica sits out COLD placements for a warm-up
+grace. When the monitor's `fleet_fault_policy` chain says `failover`,
+`_failover()` runs the zero-loss DOWN path: the dead replica's queued
+AND in-flight tickets move to survivors (in-flight resume from their
+last journal checkpoint, deadlines re-anchored as remaining budget),
+its fingerprints rehome along rendezvous order, and the least-loaded
+survivor ADOPTS its journal — pending records replay cross-replica
+under their original trace ids, completions settle back into the
+adopted journal so nothing double-replays. With no survivor left the
+outstanding tickets complete BREAKDOWN with the captured error
+(`ticket.error`) instead of wedging drain. `drain_replica()` /
+`restore_replica()` give rolling restarts the same guarantees
+administratively.
 """
 from __future__ import annotations
 
@@ -68,7 +86,8 @@ from ..matrix import CsrMatrix
 from ..telemetry import flightrec as _fr
 from ..telemetry import metrics as _tm
 from ..telemetry import spans as _spans
-from .service import ServiceTicket, SolveService
+from .health import CLOSED, HALF_OPEN, HealthMonitor
+from .service import ServiceTicket, SolveService, _now
 
 
 def _rendezvous_score(fingerprint: str, rid: str) -> int:
@@ -88,7 +107,13 @@ class FleetRouter:
     `r0..rN-1` — two unlabeled replicas in one process must never
     scrape identically (their latency series would silently merge)."""
 
-    def __init__(self, replicas, *, spill_depth: int = 0):
+    def __init__(self, replicas, *, spill_depth: int = 0,
+                 fault_policy: Optional[str] = None,
+                 suspect_checks: int = 4,
+                 probe_backoff_s: float = 0.05,
+                 health_check_s: float = 0.25,
+                 warmup_s: float = 1.0,
+                 slow_cycle_s: float = 0.0):
         if isinstance(replicas, dict):
             items = list(replicas.items())
         else:
@@ -129,6 +154,14 @@ class FleetRouter:
         self.route_counts: Dict[str, Dict[str, int]] = {
             rid: {"warm": 0, "cold": 0, "spill": 0}
             for rid in self.replicas}
+        self.health = HealthMonitor(
+            self.replicas, policy=fault_policy,
+            suspect_checks=suspect_checks,
+            probe_backoff_s=probe_backoff_s, check_s=health_check_s,
+            warmup_s=warmup_s, slow_cycle_s=slow_cycle_s)
+        # the poll cadence start() last used: restore_replica restarts
+        # a restored replica's scheduler iff the fleet runs background
+        self._bg_poll: Optional[float] = None
         _tm.set_gauge("fleet.replicas", len(self.replicas))
 
     @classmethod
@@ -164,7 +197,18 @@ class FleetRouter:
             replicas[rid] = svc
         return cls(replicas,
                    spill_depth=int(cfg.get("fleet_spill_depth",
-                                           scope)))
+                                           scope)),
+                   fault_policy=str(cfg.get("fleet_fault_policy",
+                                            scope)),
+                   suspect_checks=int(cfg.get("fleet_suspect_checks",
+                                              scope)),
+                   probe_backoff_s=float(
+                       cfg.get("fleet_probe_backoff_s", scope)),
+                   health_check_s=float(
+                       cfg.get("fleet_health_check_s", scope)),
+                   warmup_s=float(cfg.get("fleet_warmup_s", scope)),
+                   slow_cycle_s=float(
+                       cfg.get("fleet_slow_cycle_s", scope)))
 
     # -- load/feasibility reads -------------------------------------------
     def _queue_depth(self, svc: SolveService) -> int:
@@ -192,25 +236,77 @@ class FleetRouter:
         return self.spill_depth or max(2 * svc.slots, 2)
 
     # -- routing -----------------------------------------------------------
+    def _healthy(self, rid: str, now: float,
+                 cold: bool = False) -> bool:
+        """May `rid` take regular (non-probe) traffic? CLOSED breaker,
+        not down, not draining — and for COLD placements, past its
+        restore warm-up grace (a just-restored empty replica would
+        otherwise instantly be the least-loaded home for every new
+        fingerprint). Lock-free: breaker fields are plain scalars."""
+        br = self.health.breaker(rid)
+        if br.down or br.draining or br.state != CLOSED:
+            return False
+        if cold and now < br.warm_until:
+            return False
+        return True
+
     def _route(self, fp: str, tenant: str,
                deadline_s: Optional[float]):
         """(replica id, route class, handoff, consult): the whole
         decision under the router lock — placement map reads/writes
         must not interleave across concurrent submits."""
+        now_m = time.monotonic()
         with self._lock:
             order = sorted(
                 self.replicas,
                 key=lambda r: _rendezvous_score(fp, r), reverse=True)
             home = self._placed.get(fp)
             if home is None or home not in self.replicas:
+                # cold placement: healthy-and-warmed-up first, then
+                # healthy, then anything not down — an all-down fleet
+                # still routes (the ticket waits for a restore; a
+                # refusal would lose it outright)
+                cands = [r for r in order
+                         if self._healthy(r, now_m, cold=True)] \
+                    or [r for r in order if self._healthy(r, now_m)] \
+                    or [r for r in order
+                        if not self.health.breaker(r).down] \
+                    or order
                 loads = {rid: self._load(self.replicas[rid])
-                         for rid in order}
-                rid = min(order,
+                         for rid in cands}
+                rid = min(cands,
                           key=lambda r: (loads[r], order.index(r)))
                 self._placed[fp] = rid
                 return rid, "cold", None, None
             home_svc = self.replicas[home]
-            cands = [r for r in order if r != home]
+            br_home = self.health.breaker(home)
+            if br_home.down or br_home.draining \
+                    or br_home.state != CLOSED:
+                # the home can't take regular traffic. HALF_OPEN
+                # admits exactly ONE trial fingerprint (the breaker
+                # probe); everything else diverts to the next healthy
+                # rendezvous candidate
+                if br_home.state == HALF_OPEN and not br_home.down \
+                        and not br_home.draining \
+                        and self.health.probe_admit(home, fp):
+                    return home, "warm", None, None
+                reason = ("draining" if br_home.draining
+                          else "down" if br_home.down else "breaker")
+                target = next(
+                    (r for r in order
+                     if r != home and self._healthy(r, now_m)), None)
+                if target is None:
+                    # no healthy alternative: degraded beats refused
+                    return home, "warm", None, None
+                if br_home.down:
+                    # failover rehomes placements, but a submit can
+                    # race it — make the diversion sticky so the warm
+                    # state grows in ONE place
+                    self._placed[fp] = target
+                return target, "spill", \
+                    (home, reason, self._queue_depth(home_svc)), None
+            cands = [r for r in order
+                     if r != home and self._healthy(r, now_m)]
             # 1. quarantine-looping home: its fault/backoff state for
             # this fingerprint is live — rebuild-crash loops there
             # while a healthy replica could just serve. Rehome.
@@ -285,11 +381,13 @@ class FleetRouter:
         """Route one request to a replica and submit it there. The
         returned ticket is the replica's own (same wait/result API),
         plus `.replica` and `.route` attribution."""
+        self._health_tick()
         fp = f"{pattern_fingerprint(A)}/{np.asarray(b).dtype}"
         if request_key:
             with self._lock:
                 prior = self._keyed.get(request_key)
-            if prior is not None and prior in self.replicas:
+            if prior is not None and prior in self.replicas \
+                    and not self.health.breaker(prior).down:
                 # idempotent retry: the original's replica holds the
                 # live ticket (or its journal holds the result) —
                 # routing elsewhere would re-solve it
@@ -337,17 +435,30 @@ class FleetRouter:
         return t
 
     def step(self) -> List[ServiceTicket]:
-        """One scheduler cycle on EVERY replica (round-robin inline
-        driving — the single-process analog of N schedulers); returns
-        the tickets completed across the fleet."""
+        """One scheduler cycle on every LIVE replica (round-robin
+        inline driving — the single-process analog of N schedulers);
+        returns the tickets completed across the fleet. A step() that
+        raises (chaos replica_kill, a real scheduler bug) is captured
+        for the health monitor exactly where a background loop would
+        put it, then the health tick runs the policy chain."""
         done: List[ServiceTicket] = []
-        for svc in self.replicas.values():
-            done.extend(svc.step())
+        for rid, svc in self.replicas.items():
+            if self.health.breaker(rid).down:
+                continue
+            try:
+                done.extend(svc.step())
+            except Exception as e:
+                self.health.note_error(rid, e)
+        done.extend(self._health_tick())
         return done
 
     @property
     def idle(self) -> bool:
-        return all(svc.idle for svc in self.replicas.values())
+        """DOWN replicas are excluded: their outstanding work was
+        moved or failed terminal by _failover, and a racing builder
+        thread repopulating their install map must not wedge drain."""
+        return all(svc.idle for rid, svc in self.replicas.items()
+                   if not self.health.breaker(rid).down)
 
     @property
     def completed_total(self) -> int:
@@ -356,31 +467,344 @@ class FleetRouter:
 
     def drain(self, timeout_s: Optional[float] = None
               ) -> List[ServiceTicket]:
-        """Step until every replica is idle (or timeout). Replicas
-        running their own background scheduler are waited on;
-        inline-driven ones are stepped."""
+        """Step until every live replica is idle (or timeout).
+        Replicas running their own background scheduler are waited on;
+        inline-driven ones are stepped. The health monitor ticks every
+        loop, so a replica whose scheduler thread died mid-drain is
+        failed over (tickets move to survivors, or complete BREAKDOWN
+        with the captured error when none remain) instead of spinning
+        this loop to its timeout."""
         t0 = time.monotonic()
         done: List[ServiceTicket] = []
+        done.extend(self._health_tick())
         while not self.idle:
             if timeout_s is not None \
                     and time.monotonic() - t0 > timeout_s:
                 break
             stepped = False
-            for svc in self.replicas.values():
+            for rid, svc in self.replicas.items():
+                if self.health.breaker(rid).down:
+                    continue
                 if svc._thread is None:
-                    done.extend(svc.step())
+                    try:
+                        done.extend(svc.step())
+                    except Exception as e:
+                        self.health.note_error(rid, e)
                     stepped = True
+            done.extend(self._health_tick())
             if not stepped:
                 time.sleep(0.001)
         return done
 
     def start(self, poll_s: float = 0.0005):
-        for svc in self.replicas.values():
-            svc.start(poll_s=poll_s)
+        self._bg_poll = poll_s
+        for rid, svc in self.replicas.items():
+            if not self.health.breaker(rid).down:
+                svc.start(poll_s=poll_s)
 
     def stop(self):
+        self._bg_poll = None
         for svc in self.replicas.values():
             svc.stop()
+
+    # -- fault tolerance ---------------------------------------------------
+    def _health_tick(self) -> List[ServiceTicket]:
+        """One health check + the actions its verdicts demand. Called
+        from submit/step/drain — cheap when nothing is wrong (a few
+        scalar reads per replica). Returns tickets a no-survivor
+        failover completed BREAKDOWN, so drain loops can report
+        them."""
+        done: List[ServiceTicket] = []
+        for rid, _event, _action, err in self.health.check():
+            done.extend(self._failover(rid, err, _event))
+        # straggler rescue: a submit that raced a failover may have
+        # queued onto a replica marked down in between — move it
+        for rid, svc in self.replicas.items():
+            if self.health.breaker(rid).down and svc._queue:
+                self._rescue_queue(rid)
+        return done
+
+    def _failover(self, rid: str, err: Optional[BaseException],
+                  event: str = "REPLICA_DEAD") -> List[ServiceTicket]:
+        """The DOWN path: mark `rid` down, extract its queued AND
+        in-flight tickets, rehome its fingerprints along rendezvous
+        order, re-submit the tickets to survivors at the FRONT of
+        their queues (in-flight ones resume from their last journal
+        checkpoint with deadlines re-anchored as remaining budget),
+        and have the least-loaded survivor adopt the dead replica's
+        journal so its other pending records replay exactly once.
+        With no survivor, everything outstanding completes BREAKDOWN
+        with the captured error — terminal honesty over a wedged
+        drain. Returns the tickets completed here (empty on the
+        survivor path: moved work completes later, on its adopter)."""
+        t0 = time.monotonic()
+        svc = self.replicas[rid]
+        self.health.mark_down(rid)
+        svc._stopping = True       # a still-breathing loop exits
+        # a DEAD scheduler's cycle lock is free; a truly WEDGED one
+        # may never release it — bounded acquire keeps failover from
+        # hanging on the very replica it is rescuing
+        got = svc._sched_lock.acquire(timeout=0.1)
+        try:
+            with svc._lock:
+                queued = list(svc._queue)
+                svc._queue = []
+                svc._builds.clear()
+                svc._built.clear()
+                svc._build_failed.clear()
+                engines = [svc.buckets.peek(k)
+                           for k in svc.buckets.keys()]
+            inflight: List[ServiceTicket] = []
+            for eng in engines:
+                if eng is None:
+                    continue
+                for j in range(eng.slots):
+                    t = eng.occupant[j]
+                    if t is None:
+                        continue
+                    try:
+                        eng.release(j)
+                    except Exception:
+                        eng.occupant[j] = None
+                    if not t.done:
+                        inflight.append(t)
+            with svc._lock:
+                for t in queued + inflight:
+                    if t.request_key:
+                        svc._keyed.pop(t.request_key, None)
+        finally:
+            if got:
+                svc._sched_lock.release()
+        jr = svc.journal
+        now = _now()
+        for t in inflight:
+            # resume from the last DURABLE checkpoint (what a
+            # cross-process adoption would see); the journal's
+            # remaining deadline budget re-anchors against the
+            # adopter's service_now() — same contract as recover().
+            # Without a journal the live absolute deadline stands
+            # (in-process replicas share one skew-hookable clock)
+            state = remaining = None
+            if jr is not None and t.journal_id is not None:
+                try:
+                    state, remaining = jr.load_checkpoint(t.journal_id)
+                except Exception:
+                    state = remaining = None
+            if state is not None:
+                t.resume_state = state
+            if remaining is not None:
+                t.deadline_t = now + float(remaining)
+            t.admit_t = None
+        victims = queued + inflight
+        for t in victims:
+            if jr is not None and t.journal_id is not None:
+                # completions settle the DEAD replica's records —
+                # the adopted journal must never replay moved work
+                t.journal_ref = jr
+        now_m = time.monotonic()
+        surv = [r for r in self.replicas
+                if r != rid and self._healthy(r, now_m)]
+        survset = set(surv)
+        rehomed = 0
+        with self._lock:
+            for fp, h in list(self._placed.items()):
+                if h != rid:
+                    continue
+                order = sorted(
+                    self.replicas,
+                    key=lambda r: _rendezvous_score(fp, r),
+                    reverse=True)
+                target = next((r for r in order if r in survset),
+                              None)
+                if target is None:
+                    self._placed.pop(fp)
+                else:
+                    self._placed[fp] = target
+                    rehomed += 1
+        if rehomed:
+            _tm.inc("fleet.health.rehomed", rehomed)
+        if not surv:
+            e = err if isinstance(err, Exception) else RuntimeError(
+                f"replica {rid} {event.lower()}"
+                + ("" if err is None else f": {err}"))
+            with svc._lock:
+                for t in victims:
+                    if not t.done:
+                        svc._fail_ticket(t, e)
+            svc._flush_flightrec()
+            svc._flush_journal_done()
+            _fr.record("fleet.failover", replica=rid, event=event,
+                       survivors=0, failed=len(victims),
+                       error=None if err is None else str(err)[:120])
+            _spans.mark("fleet.failover", args={
+                "replica": rid, "event": event, "survivors": 0,
+                "failed": len(victims)})
+            return [t for t in victims if t.done]
+        per: Dict[str, List[ServiceTicket]] = {}
+        with self._lock:
+            for t in victims:
+                target = self._placed.get(t.fingerprint)
+                if target not in survset:
+                    order = sorted(
+                        self.replicas,
+                        key=lambda r: _rendezvous_score(
+                            t.fingerprint, r), reverse=True)
+                    target = next(
+                        (r for r in order if r in survset), surv[0])
+                per.setdefault(target, []).append(t)
+                if t.request_key:
+                    self._keyed[t.request_key] = target
+        for trid, ts in per.items():
+            tsvc = self.replicas[trid]
+            with tsvc._lock:
+                # FRONT of the queue: moved work was submitted before
+                # anything already waiting here
+                tsvc._queue[0:0] = ts
+                for t in ts:
+                    if t.request_key:
+                        tsvc._keyed[t.request_key] = t
+                _tm.set_gauge("serving.queue_depth",
+                              len(tsvc._queue))
+            for t in ts:
+                t.replica = trid
+        if victims:
+            _tm.inc("fleet.health.requeued", len(victims))
+        adopter = None
+        adopted = 0
+        if jr is not None:
+            adopter = min(surv,
+                          key=lambda r: self._load(self.replicas[r]))
+            skipids = frozenset(t.journal_id for t in victims
+                                if t.journal_id is not None)
+            adopted = self.replicas[adopter].adopt_journal(
+                jr, skip=skipids)
+            _fr.record("fleet.adopt", from_replica=rid,
+                       to_replica=adopter, replayed=adopted,
+                       skipped=len(skipids))
+        wall_ms = round((time.monotonic() - t0) * 1e3, 3)
+        _fr.record("fleet.failover", replica=rid, event=event,
+                   survivors=len(surv), queued=len(queued),
+                   inflight=len(inflight), rehomed=rehomed,
+                   adopter=adopter, adopted=adopted,
+                   wall_ms=wall_ms,
+                   error=None if err is None else str(err)[:120])
+        _spans.mark("fleet.failover", args={
+            "replica": rid, "event": event,
+            "survivors": len(surv), "requeued": len(victims),
+            "rehomed": rehomed, "adopter": adopter,
+            "adopted": adopted, "wall_ms": wall_ms})
+        return []
+
+    def _rescue_queue(self, rid: str) -> List[ServiceTicket]:
+        """Move a draining/down replica's QUEUED tickets to healthy
+        survivors. In-flight work is NOT touched: a draining replica
+        finishes its slots in place (rolling restart), and a down
+        one's slots were already extracted by _failover. The source
+        journal rides along on journal_ref so completions settle the
+        original records. Placements are NOT rehomed — a drained
+        replica keeps its homes and takes them back on restore."""
+        svc = self.replicas[rid]
+        now_m = time.monotonic()
+        surv = [r for r in self.replicas
+                if r != rid and self._healthy(r, now_m)]
+        if not surv:
+            return []
+        survset = set(surv)
+        with svc._lock:
+            moved = list(svc._queue)
+            svc._queue = []
+            for t in moved:
+                if t.request_key:
+                    svc._keyed.pop(t.request_key, None)
+        if not moved:
+            return []
+        jr = svc.journal
+        per: Dict[str, List[ServiceTicket]] = {}
+        for t in moved:
+            if jr is not None and t.journal_id is not None:
+                t.journal_ref = jr
+            order = sorted(
+                self.replicas,
+                key=lambda r: _rendezvous_score(t.fingerprint, r),
+                reverse=True)
+            target = next((r for r in order if r in survset),
+                          surv[0])
+            per.setdefault(target, []).append(t)
+        for trid, ts in per.items():
+            tsvc = self.replicas[trid]
+            with tsvc._lock:
+                tsvc._queue[0:0] = ts
+                for t in ts:
+                    if t.request_key:
+                        tsvc._keyed[t.request_key] = t
+                _tm.set_gauge("serving.queue_depth",
+                              len(tsvc._queue))
+            for t in ts:
+                t.replica = trid
+        with self._lock:
+            for trid, ts in per.items():
+                for t in ts:
+                    if t.request_key:
+                        self._keyed[t.request_key] = trid
+        _tm.inc("fleet.health.requeued", len(moved))
+        _fr.record("fleet.rehome", from_replica=rid,
+                   moved=len(moved),
+                   targets={trid: len(ts)
+                            for trid, ts in per.items()})
+        return moved
+
+    def drain_replica(self, rid: str) -> int:
+        """Rolling-restart entry: stop NEW placements on `rid`, hand
+        its queued tickets to survivors, let in-flight work finish in
+        place (or hand off via the journal if the process is killed
+        anyway — the DOWN path covers that). Returns the number of
+        queued tickets handed off. The replica keeps serving its
+        slots; wait for `replicas[rid].idle` (or fleet drain) before
+        actually restarting it."""
+        if rid not in self.replicas:
+            raise BadParametersError(
+                f"drain_replica: unknown replica {rid!r}")
+        self.health.drain(rid)
+        return len(self._rescue_queue(rid))
+
+    def restore_replica(self, rid: str):
+        """Re-enter `rid` into the rendezvous: breaker reset, error
+        cleared, warm-up grace started (no COLD placements until it
+        elapses; warm traffic returns at once). Rehomed fingerprints
+        are NOT pulled back — they stay with their adopter until
+        natural eviction, so a restore never thunders the herd. A
+        dead scheduler thread's corpse is cleared and, when the fleet
+        runs background, a fresh one started."""
+        if rid not in self.replicas:
+            raise BadParametersError(
+                f"restore_replica: unknown replica {rid!r}")
+        svc = self.replicas[rid]
+        th = svc._thread
+        if th is not None and not th.is_alive():
+            svc._thread = None
+        svc._stopping = False
+        self.health.restore(rid)
+        if self._bg_poll is not None and svc._thread is None:
+            svc.start(poll_s=self._bg_poll)
+        _fr.record("fleet.restore", replica=rid,
+                   background=self._bg_poll is not None)
+
+    def health_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """The monitor's per-replica breaker view plus live scheduler
+        facts (cycle counter, thread aliveness, captured error, queue
+        depth) — what `AMGX_fleet_health` serializes."""
+        snap = self.health.snapshot()
+        for rid, svc in self.replicas.items():
+            th = svc._thread
+            snap[rid].update({
+                "cycle": svc._cycle,
+                "thread_alive": bool(th is not None
+                                     and th.is_alive()),
+                "error": None if svc._thread_error is None
+                else str(svc._thread_error)[:160],
+                "queue_depth": self._queue_depth(svc),
+            })
+        return snap
 
     # -- fleet observability ----------------------------------------------
     def snapshots(self) -> Dict[str, Dict[str, Any]]:

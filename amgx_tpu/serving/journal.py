@@ -268,6 +268,32 @@ class SolveJournal:
             return (A, req["b"], req.get("x0"), state,
                     None if remaining is None else float(remaining))
 
+    def load_checkpoint(self, jid: str
+                        ) -> Tuple[Optional[Dict[str, np.ndarray]],
+                                   Optional[float]]:
+        """(checkpoint_state, deadline_remaining_s) for one pending
+        record — the fleet-failover path: a survivor adopting a dead
+        replica's LIVE in-flight ticket needs only the resume point
+        (it already holds A/b/x0 on the ticket object), not the full
+        load_request rebuild. A missing/corrupt checkpoint returns
+        (None, submit-time remaining): the solve restarts clean with
+        its original budget."""
+        with self._lock:
+            meta = self._index.get(jid)
+        remaining = None if meta is None \
+            else meta.get("deadline_remaining_s")
+        ckpt = self._read_npz(self._jpath(jid, "ckpt.npz"))
+        state = None
+        if ckpt is not None:
+            state = {k[len(_CKPT_PREFIX):]: v
+                     for k, v in ckpt.items()
+                     if k.startswith(_CKPT_PREFIX)}
+            if not state:
+                state = None
+            if "deadline_remaining_s" in ckpt:
+                remaining = float(ckpt["deadline_remaining_s"])
+        return state, (None if remaining is None else float(remaining))
+
     # -- maintenance -------------------------------------------------------
     def forget(self, jid: str):
         """Drop one record entirely (corrupt-record cleanup)."""

@@ -118,6 +118,11 @@ class ServiceTicket:
     # solve-state row; admission then resumes instead of initializing)
     journal_id: Optional[str] = None
     resume_state: Optional[Dict[str, np.ndarray]] = None
+    # fleet failover: the journal holding this ticket's pending record
+    # when that is NOT the serving replica's own (a survivor adopting a
+    # dead replica's work writes checkpoints/completions back to the
+    # ADOPTED journal, so its records settle instead of replaying twice)
+    journal_ref: Optional[SolveJournal] = None
     admit_t: Optional[float] = None
     # request trace id (telemetry/spans.py): every lifecycle span of
     # this request is tagged with it, so the Perfetto export connects
@@ -223,6 +228,10 @@ class SolveService:
         self._sched_lock = threading.RLock()
         self._thread: Optional[threading.Thread] = None
         self._stopping = False
+        # the exception that killed the background scheduler loop (or
+        # an inline step(), captured by the FleetRouter): the fleet
+        # health monitor's REPLICA_DEAD signal. None while healthy.
+        self._thread_error: Optional[BaseException] = None
         # async bucket builds (background-scheduler mode): fingerprint
         # -> builder thread / finished engine / failure
         self._builds: Dict[str, threading.Thread] = {}
@@ -591,7 +600,8 @@ class SolveService:
             _tm.observe("serving.exec_s", t.complete_t - t.admit_t,
                         labels=self._hlabels(t.tenant))
             self._exec_recent.append(t.complete_t - t.admit_t)
-        if t.journal_id is not None and self.journal is not None:
+        if t.journal_id is not None \
+                and self._journal_for(t) is not None:
             # queued, not written: _finish runs under the service lock
             # and journal completion is file IO (the whole solution
             # vector) — the scheduler flushes the queue outside the
@@ -670,19 +680,82 @@ class SolveService:
         self.journal.prune()       # bound the done-record history
         return n
 
+    def adopt_journal(self, journal: SolveJournal,
+                      skip=frozenset()) -> int:
+        """Cross-replica recover(): replay ANOTHER replica's journal
+        into this service's queue (fleet failover — the survivor
+        adopting a dead replica's journal dir). Same machinery as
+        recover(), with the failover deltas: tickets carry
+        `journal_ref` pointing at the ADOPTED journal (checkpoints and
+        completions settle the dead replica's records, never this
+        service's), deadlines re-anchor as REMAINING budget against
+        this adopter's service_now(), trace ids stay the originals,
+        and `skip` excludes records whose live ticket the router
+        already moved over (nothing double-solves). request_key dedupe
+        guards the rest: a key already live here means the record's
+        work is present, so its replay is skipped too."""
+        n = 0
+        for meta in journal.pending():
+            if meta["id"] in skip:
+                continue
+            key = meta.get("key")
+            if key:
+                with self._lock:
+                    if key in self._keyed:
+                        continue
+            loaded = journal.load_request(meta)
+            if loaded is None:
+                journal.forget(meta["id"])
+                continue
+            A, b, x0, state, remaining = loaded
+            now = _now()
+            t = ServiceTicket(
+                A=A, b=np.asarray(b),
+                x0=None if x0 is None else np.asarray(x0),
+                tenant=meta.get("tenant", "default"),
+                fingerprint=meta["fingerprint"], submit_t=now,
+                deadline_t=None if remaining is None
+                else now + float(remaining),
+                request_key=key,
+                trace_id=(meta.get("trace") or _spans.new_trace_id())
+                if self.tracing else None,
+                _perf_submit=time.perf_counter())
+            t.journal_id = meta["id"]
+            t.journal_ref = journal
+            t.resume_state = state
+            _tm.inc("serving.recovery.replayed")
+            _tm.inc("fleet.health.adopted")
+            self._tmark("serving.resume", trace=t.trace_id,
+                        journal_id=t.journal_id,
+                        checkpointed=state is not None)
+            with self._lock:
+                self._tenant(t.tenant)["submitted"] += 1
+                if t.request_key:
+                    self._keyed[t.request_key] = t
+                self._queue.append(t)
+            n += 1
+        with self._lock:
+            _tm.set_gauge("serving.queue_depth", len(self._queue))
+        return n
+
+    def _journal_for(self, t: ServiceTicket) -> Optional[SolveJournal]:
+        """The journal holding this ticket's pending record: its
+        adopted journal_ref when a fleet failover moved it here, else
+        this service's own."""
+        return t.journal_ref if t.journal_ref is not None \
+            else self.journal
+
     def _journal_done(self, t: ServiceTicket, result: SolveResult):
         """Persist one completed ticket's journal result. File IO —
         callers must NOT hold the service lock."""
         try:
-            self.journal.record_done(
+            self._journal_for(t).record_done(
                 t.journal_id, np.asarray(result.x),
                 int(result.status_code), int(result.iterations))
         except Exception:
             _tm.inc("serving.recovery.journal_corrupt")
 
     def _flush_journal_done(self):
-        if self.journal is None:
-            return
         with self._lock:
             flush, self._journal_doneq = self._journal_doneq, []
         for t in flush:
@@ -726,7 +799,7 @@ class SolveService:
                     remaining = None if t.deadline_t is None \
                         else max(0.0, t.deadline_t - now)
                     try:
-                        self.journal.record_checkpoint(
+                        self._journal_for(t).record_checkpoint(
                             t.journal_id, rows[j], remaining)
                     except Exception:
                         _tm.inc("serving.recovery.journal_corrupt")
@@ -900,6 +973,19 @@ class SolveService:
         synchronously (no start()), builds run inline — one per cycle,
         for the oldest unserved ticket — which keeps step()
         deterministic for tests."""
+        # fleet-level chaos hooks, BEFORE the cycle lock and BEFORE
+        # the cycle counter: replica_kill raises out of step() (the
+        # background loop captures it and dies, an inline fleet's
+        # router captures it — either way the health monitor sees a
+        # dead scheduler); replica_wedge returns without advancing
+        # _cycle (the heartbeat flatline); replica_slow stalls the
+        # cycle so per-cycle wall blows the pace threshold
+        delay = _fi.replica_delay(self.replica)
+        if delay > 0.0:
+            time.sleep(delay)
+        if _fi.replica_wedged(self.replica):
+            return []
+        _fi.replica_crash(self.replica)
         with self._sched_lock:
             return self._step_impl()
 
@@ -1196,10 +1282,55 @@ class SolveService:
                     and time.monotonic() - t0 > timeout_s:
                 break
             if self._thread is not None:
+                if self._thread_error is not None \
+                        and not self._thread.is_alive():
+                    # the background scheduler died: nothing will ever
+                    # step this work — surface the captured exception
+                    # on the outstanding tickets (BREAKDOWN +
+                    # ticket.error) instead of spinning to timeout
+                    done.extend(
+                        self._fail_outstanding(self._thread_error))
+                    break
                 time.sleep(0.001)
             else:
                 done.extend(self.step())
         return done
+
+    def _fail_outstanding(self, err: BaseException
+                          ) -> List[ServiceTicket]:
+        """Complete every queued and in-flight ticket BREAKDOWN with
+        `err` on ticket.error — the dead-scheduler terminal path (a
+        drain must never wait on work nothing will ever step). Slots
+        are released so the service reads idle afterwards. Shared by
+        the standalone drain above and the FleetRouter's no-survivor
+        failover."""
+        with self._lock:
+            victims = list(self._queue)
+            self._queue = []
+            self._builds.clear()
+            self._built.clear()
+            self._build_failed.clear()
+            engines = [self.buckets.peek(k)
+                       for k in self.buckets.keys()]
+        for eng in engines:
+            if eng is None:
+                continue
+            for j in range(eng.slots):
+                t = eng.occupant[j]
+                if t is None:
+                    continue
+                try:
+                    eng.release(j)
+                except Exception:
+                    eng.occupant[j] = None
+                if not t.done:
+                    victims.append(t)
+        with self._lock:
+            for t in victims:
+                self._fail_ticket(t, err)
+        self._flush_flightrec()
+        self._flush_journal_done()
+        return victims
 
     # -- background scheduler ---------------------------------------------
     def start(self, poll_s: float = 0.0005):
@@ -1208,18 +1339,30 @@ class SolveService:
         if self._thread is not None:
             return
         self._stopping = False
+        self._thread_error = None
 
         def loop():
             while not self._stopping:
-                if self.idle:
-                    time.sleep(poll_s)
-                    continue
-                done = self.step()
-                if not done and self._inflight() == 0:
-                    # nothing advanced: only waiting on builder
-                    # threads or a retry backoff window — don't spin
-                    # the scheduler hot
-                    time.sleep(poll_s)
+                try:
+                    if self.idle:
+                        time.sleep(poll_s)
+                        continue
+                    done = self.step()
+                    if not done and self._inflight() == 0:
+                        # nothing advanced: only waiting on builder
+                        # threads or a retry backoff window — don't
+                        # spin the scheduler hot
+                        time.sleep(poll_s)
+                except Exception as e:
+                    # the scheduler thread must never die SILENTLY: a
+                    # captured exception is the fleet health monitor's
+                    # REPLICA_DEAD signal (and a standalone service's
+                    # drain surfaces it instead of spinning forever)
+                    self._thread_error = e
+                    _fr.record("scheduler.died",
+                               replica=self.replica or None,
+                               error=str(e)[:160])
+                    return
 
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name="amgx-serving")

@@ -749,6 +749,60 @@ declare_counter("fleet.shed.infeasible",
 declare_gauge("fleet.replicas",
               "replicas fronted by the live FleetRouter")
 
+# fleet health + failover (serving/health.py + fleet.py): the
+# breaker/liveness layer's literal transition counters — one per
+# detector/transition so a scrape alone reconstructs the incident
+declare_counter("fleet.health.suspect",
+                "busy replicas whose scheduler-cycle counter first "
+                "flatlined across a heartbeat window (the wedge "
+                "detector's first strike)")
+declare_counter("fleet.health.wedged",
+                "REPLICA_WEDGED events: a busy replica's cycle "
+                "counter flatlined fleet_suspect_checks consecutive "
+                "heartbeat windows")
+declare_counter("fleet.health.slow",
+                "REPLICA_SLOW events: per-cycle wall between health "
+                "checks exceeded fleet_slow_cycle_s")
+declare_counter("fleet.health.dead",
+                "REPLICA_DEAD detections: a captured scheduler "
+                "exception, or a started thread no longer alive "
+                "without stop()")
+declare_counter("fleet.health.down",
+                "replicas marked DOWN (failover ran; only "
+                "restore_replica resets)")
+declare_counter("fleet.health.breaker_open",
+                "breaker OPEN transitions (probe_backoff policy "
+                "action: no traffic until the bounded backoff "
+                "elapses)")
+declare_counter("fleet.health.breaker_half_open",
+                "breaker HALF_OPEN transitions (backoff elapsed: one "
+                "trial fingerprint may probe)")
+declare_counter("fleet.health.breaker_closed",
+                "breakers closed by a successful probe (a completion "
+                "since the probe began)")
+declare_counter("fleet.health.probe_trials",
+                "HALF_OPEN probe admissions (exactly one fingerprint "
+                "per probe window)")
+declare_counter("fleet.health.rehomed",
+                "fingerprint placements moved off a DOWN replica "
+                "along rendezvous order during failover")
+declare_counter("fleet.health.requeued",
+                "tickets (queued + in-flight) moved off a down or "
+                "draining replica into survivor queues")
+declare_counter("fleet.health.adopted",
+                "pending journal records a survivor replayed from a "
+                "dead replica's adopted journal (cross-replica "
+                "recover)")
+declare_counter("fleet.health.drains",
+                "administrative drain_replica calls (rolling "
+                "restarts)")
+declare_counter("fleet.health.restores",
+                "restore_replica calls re-entering a replica into "
+                "the rendezvous")
+declare_gauge("fleet.health.available",
+              "replicas currently able to take traffic (not down, "
+              "not draining, breaker not OPEN)")
+
 # distributed comms/shard telemetry (distributed/comms.py records at
 # TRACE time — collectives are emitted by the traced program, so the
 # honest countable event is the traced exchange SITE; bytes are the

@@ -124,6 +124,15 @@ DECLARED_SPANS: Tuple[str, ...] = (
     # the serving replica id and route class (warm|cold|spill), the
     # cross-replica postmortem's attribution anchor
     "fleet.route",
+    # fleet health (serving/health.py): every breaker/liveness
+    # transition (SUSPECT, WEDGED, DEAD, OPEN/HALF_OPEN/CLOSED, DOWN,
+    # DRAINING, RESTORED, PROBE) as an instant event — the Perfetto
+    # view of an incident timeline
+    "fleet.health.transition",
+    # fleet failover (serving/fleet.py): one instant event per DOWN
+    # path with its whole outcome (survivors, tickets requeued,
+    # fingerprints rehomed, journal adopter + replay count, wall)
+    "fleet.failover",
     # distributed comms/shard telemetry: one synthetic track per
     # shard in the Perfetto export (record_span with a per-shard tid)
     "shard.solve",

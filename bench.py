@@ -1185,6 +1185,57 @@ def bench_fleet(n: int = 16, smoke: bool = False):
                   and all(t.result.status == "overloaded" for t in shed)
                   and (p99 < 0 or p99 <= 1e3 * deadline_s))
 
+    # -- 4. failover: kill 1 of 2 mid-load ------------------------------
+    # The fleet-level kill-and-recover drill (bench_chaos section 1
+    # raised to the router): a journaled 2-replica fleet takes a mixed
+    # two-pattern load, steps until the first ticket's home replica
+    # holds admitted + checkpointed work, then that replica is killed
+    # (chaos replica_kill). Gates: ZERO lost tickets (every submit
+    # terminal), the moved solves finish BIT-IDENTICAL to an
+    # uninterrupted twin fleet, the victim reads DOWN, and at least
+    # one ticket actually changed replicas. fleet_failover_wall_s is
+    # kill -> last victim-homed ticket terminal.
+    import shutil
+    import tempfile
+    from amgx_tpu.resilience import faultinject
+    k_fo = 6 if smoke else 12
+    reqs = [sat_req(1000 + i) for i in range(k_fo)]
+    fo_dirs = [tempfile.mkdtemp(prefix="amgx_fleet_fo_")
+               for _ in range(2)]
+    fo_base = (base_cfg + ", serving_chunk_iters=1,"
+               " serving_checkpoint_cycles=1")
+    ref_fleet = FleetRouter.build(Config.from_string(
+        fo_base + f", serving_journal_dir={fo_dirs[0]}"), n_replicas=2)
+    ref_ts = [ref_fleet.submit(A_i, b_i) for A_i, b_i in reqs]
+    ref_fleet.drain(timeout_s=600)
+    xrefs = [np.asarray(t.result.x) for t in ref_ts]
+    flt = FleetRouter.build(Config.from_string(
+        fo_base + f", serving_journal_dir={fo_dirs[1]}"), n_replicas=2)
+    fo_ts = [flt.submit(A_i, b_i) for A_i, b_i in reqs]
+    victim = fo_ts[0].replica
+    orig_replica = [t.replica for t in fo_ts]
+    for _ in range(3):     # admit + checkpoint work on the victim
+        flt.step()
+    t0 = time.monotonic()
+    with faultinject.inject("replica_kill", fires=1, target=victim):
+        flt.drain(timeout_s=600)
+    fo_lost = sum(0 if t.done else 1 for t in fo_ts)
+    vt = [t for t, r0 in zip(fo_ts, orig_replica)
+          if r0 == victim and t.done]
+    failover_wall = (max(t.complete_t for t in vt) - t0) if vt else -1.0
+    fo_bit_same = bool(all(
+        t.done and np.array_equal(np.asarray(t.result.x), xr)
+        for t, xr in zip(fo_ts, xrefs)))
+    fo_moved = sum(1 for t, r0 in zip(fo_ts, orig_replica)
+                   if t.replica != r0)
+    fo_down = bool(flt.health_snapshot()[victim]["down"])
+    failover_ok = bool(fo_lost == 0 and fo_bit_same and fo_moved > 0
+                       and fo_down
+                       and all(t.done and t.result.converged
+                               for t in fo_ts))
+    for d in fo_dirs:
+        shutil.rmtree(d, ignore_errors=True)
+
     scaling_ok = bool(scaling_x >= 1.7)
     affinity_ok = bool(affinity_rate >= 0.90)
     out = {
@@ -1212,9 +1263,17 @@ def bench_fleet(n: int = 16, smoke: bool = False):
         "fleet_p99_at_2x_ms": round(p99, 2),
         "fleet_shed_consults": delta(cur, base, "fleet.shed.infeasible"),
         "sat_ok": sat_ok,
+        "failover_requests": k_fo,
+        "failover_victim": victim,
+        "failover_moved_tickets": fo_moved,
+        "failover_bit_identical": fo_bit_same,
+        "fleet_failover_wall_s": round(failover_wall, 4),
+        "fleet_failover_lost_requests": int(fo_lost),
+        "failover_ok": failover_ok,
         "scaling_ok": scaling_ok,
         "affinity_ok": affinity_ok,
         "fleet_ok": bool(scaling_ok and affinity_ok and sat_ok
+                         and failover_ok
                          and single_ok and fleet_done_ok),
         "smoke": bool(smoke),
     }
@@ -1972,6 +2031,10 @@ def main():
                 fl["fleet_scaling_efficiency"]
             extra["fleet_p99_at_2x_ms"] = fl["fleet_p99_at_2x_ms"]
             extra["fleet_affinity_rate"] = fl["fleet_affinity_rate"]
+            extra["fleet_failover_wall_s"] = \
+                fl["fleet_failover_wall_s"]
+            extra["fleet_failover_lost_requests"] = \
+                fl["fleet_failover_lost_requests"]
             extra["fleet_ok"] = fl["fleet_ok"]
         finally:
             signal.alarm(0)
@@ -2352,6 +2415,9 @@ if __name__ == "__main__":
             "fleet_p99_at_2x_ms": res["fleet_p99_at_2x_ms"],
             "fleet_affinity_rate": res["fleet_affinity_rate"],
             "fleet_solves_per_s": res["fleet_solves_per_s"],
+            "fleet_failover_wall_s": res["fleet_failover_wall_s"],
+            "fleet_failover_lost_requests":
+                res["fleet_failover_lost_requests"],
         }
         try:
             import os

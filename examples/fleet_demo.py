@@ -11,10 +11,20 @@ replica attribution, the per-replica route counters (warm|cold|spill
 — the affinity proof), and the merged fleet-wide metrics snapshot
 with per-replica latency series kept apart by their `replica` label.
 
+Act two kills a replica mid-load: a scripted `replica_kill` chaos
+fault crashes one scheduler while journaled requests are queued
+against it. The health monitor declares it REPLICA_DEAD on the next
+tick, failover re-submits its work to the survivor, the survivor
+adopts the dead replica's journal (checkpointed solves resume under
+their original trace ids), and the flight-recorder postmortem names
+the whole incident — kill, failover, adoption, rehome — on one trail.
+
 Run:  python examples/fleet_demo.py
 """
 import os
+import shutil
 import sys
+import tempfile
 
 import numpy as np
 
@@ -24,7 +34,9 @@ import amgx_tpu as amgx  # noqa: E402
 from amgx_tpu import gallery  # noqa: E402
 from amgx_tpu.config import Config  # noqa: E402
 from amgx_tpu.presets import SERVING_CG  # noqa: E402
+from amgx_tpu.resilience import faultinject  # noqa: E402
 from amgx_tpu.serving import FleetRouter  # noqa: E402
+from amgx_tpu.telemetry import flightrec  # noqa: E402
 
 
 def shifted(A, c):
@@ -87,6 +99,54 @@ def main():
             p50 = v.get("p50")
             print(f"  {key:60s} count={v['count']:3d} "
                   f"p50={-1 if p50 is None else round(1e3 * p50, 1)} ms")
+
+    failover_act(hot, rng)
+
+
+def failover_act(hot, rng):
+    """Act two: kill one of two replicas under journaled load, watch
+    the survivor adopt its journal, and read the postmortem."""
+    print()
+    print("=== ACT TWO: replica kill + journal adoption ===")
+    jdir = tempfile.mkdtemp(prefix="fleet_demo_journal_")
+    try:
+        cfg = Config.from_string(
+            SERVING_CG + ", serving_bucket_slots=2,"
+            " serving_chunk_iters=2, serving_checkpoint_cycles=1,"
+            f" serving_journal_dir={jdir}")
+        fleet = FleetRouter.build(cfg, n_replicas=2)
+        tickets = [fleet.submit(shifted(hot, 0.05 * i),
+                                rng.standard_normal(hot.num_rows),
+                                tenant="hot")
+                   for i in range(4)]
+        victim = tickets[0].replica
+        fleet.step()                       # let work start on the victim
+        seq0 = flightrec.recorder().last_seq
+        print(f"  killing {victim} mid-flight "
+              f"({sum(t.replica == victim for t in tickets)} tickets "
+              f"homed there) ...")
+        with faultinject.inject("replica_kill", fires=1, target=victim):
+            fleet.drain(timeout_s=600)
+        lost = sum(not (t.done and t.result.converged) for t in tickets)
+        print(f"  survivors finished everything: lost={lost}")
+        for t in tickets:
+            print(f"    trace={t.trace_id} replica={t.replica:3s} "
+                  f"status={t.result.status}")
+        hs = fleet.health_snapshot()
+        print(f"  health[{victim}]: down={hs[victim]['down']} "
+              f"state={hs[victim]['state']} "
+              f"last_event={hs[victim]['last_event']}")
+        print("  --- flight-recorder postmortem (the incident trail) ---")
+        for e in flightrec.events(kind="fleet.", since_seq=seq0):
+            print("   " + flightrec.format_event(e))
+        for e in flightrec.events(kind="serving.resume", since_seq=seq0):
+            print("   " + flightrec.format_event(e))
+        # rolling restart: bring the replica back into rendezvous
+        fleet.restore_replica(victim)
+        print(f"  restored {victim}: "
+              f"available={fleet.health_snapshot()[victim]['state']}")
+    finally:
+        shutil.rmtree(jdir, ignore_errors=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,423 @@
+"""Fleet fault tolerance (amgx_tpu/serving/health.py + fleet.py
+failover paths): replica liveness detection (dead scheduler, wedged
+cycle counter, slow pace) driving the per-replica circuit breaker
+through the fleet_fault_policy chains; the zero-loss DOWN path (ticket
+move + fingerprint rehome + cross-replica journal adoption with
+bit-identical resumes under original trace ids); deadline re-anchoring
+as remaining budget, including under clock_skew chaos; rolling
+restarts (drain_replica/restore_replica) with affinity snap-back by
+natural eviction only; HALF_OPEN single-fingerprint probes; the
+dead-thread drain fix (BREAKDOWN + ticket.error, never a wedged
+drain); and the AMGX_fleet_drain_replica/AMGX_fleet_health capi
+surface. No reference analog — AMGX ships no replica failover."""
+import time
+
+import numpy as np
+import pytest
+
+import amgx_tpu as amgx
+from amgx_tpu import gallery
+from amgx_tpu.config import Config
+from amgx_tpu.errors import BadConfigurationError
+from amgx_tpu.presets import BATCHED_CG
+from amgx_tpu.resilience import faultinject
+from amgx_tpu.resilience.faultinject import ChaosInjected
+from amgx_tpu.resilience.policy import parse_fleet_policy
+from amgx_tpu.resilience.status import SolveStatus
+from amgx_tpu.serving import FleetRouter, SolveService
+from amgx_tpu.serving.health import CLOSED, HALF_OPEN, OPEN
+from amgx_tpu.telemetry import flightrec as _frec
+from amgx_tpu.telemetry import metrics
+
+amgx.initialize()
+
+
+@pytest.fixture(scope="module")
+def poisson16():
+    return gallery.poisson("5pt", 16, 16).init()
+
+
+@pytest.fixture(scope="module")
+def poisson14():
+    return gallery.poisson("5pt", 14, 14).init()
+
+
+def _rhs(A, seed=0):
+    return np.random.default_rng(seed).standard_normal(A.num_rows)
+
+
+def _svc_cfg(extra=""):
+    return Config.from_string(
+        BATCHED_CG + ", serving_bucket_slots=2, serving_chunk_iters=4"
+        + (", " + extra if extra else ""))
+
+
+def _fleet(extra="", n=2):
+    return FleetRouter.build(_svc_cfg(extra=extra), n)
+
+
+def _fast_health(fleet, check_s=0.01, suspect=2):
+    """Tighten the heartbeat for tests (the production default of a
+    0.25 s window would make wedge detection a multi-second wait)."""
+    fleet.health.check_s = check_s
+    fleet.health.suspect_checks = suspect
+    return fleet
+
+
+# ---------------------------------------------------------------------------
+# policy grammar
+# ---------------------------------------------------------------------------
+
+
+def test_parse_fleet_policy():
+    p = parse_fleet_policy("REPLICA_DEAD>failover"
+                           "|REPLICA_WEDGED>probe_backoff"
+                           "|REPLICA_WEDGED>failover")
+    assert p["REPLICA_DEAD"] == ["failover"]
+    assert p["REPLICA_WEDGED"] == ["probe_backoff", "failover"]
+    with pytest.raises(BadConfigurationError, match="REPLICA_DEAD"):
+        parse_fleet_policy("REPLICA_DED>failover")
+    with pytest.raises(BadConfigurationError, match="failover"):
+        parse_fleet_policy("REPLICA_DEAD>failovr")
+
+
+# ---------------------------------------------------------------------------
+# kill failover: zero loss, bit-identical resume, original traces,
+# journal settles on the DEAD replica's records
+# ---------------------------------------------------------------------------
+
+
+def test_kill_failover_zero_loss_bit_identical(poisson16, poisson14,
+                                               tmp_path):
+    kr = (f"serving_checkpoint_cycles=1, serving_chunk_iters=1")
+    reqs = [(poisson16, _rhs(poisson16, 1)),
+            (poisson14, _rhs(poisson14, 2)),
+            (poisson16, _rhs(poisson16, 3)),
+            (poisson14, _rhs(poisson14, 4))]
+
+    ref = FleetRouter.build(
+        _svc_cfg(extra=kr + f", serving_journal_dir={tmp_path}/ref"), 2)
+    ref_ts = [ref.submit(A, b) for A, b in reqs]
+    ref.drain(timeout_s=300)
+    xrefs = [np.asarray(t.result.x) for t in ref_ts]
+
+    fleet = FleetRouter.build(
+        _svc_cfg(extra=kr + f", serving_journal_dir={tmp_path}/f"), 2)
+    ts = [fleet.submit(A, b) for A, b in reqs]
+    victim = ts[0].replica
+    orig = [(t.replica, t.trace_id) for t in ts]
+    for _ in range(3):     # admit + checkpoint work on the victim
+        fleet.step()
+    seq0 = _frec.last_seq()
+    with faultinject.inject("replica_kill", fires=1, target=victim):
+        fleet.drain(timeout_s=300)
+
+    # zero loss: every submit terminal and converged
+    assert all(t.done and t.result.converged for t in ts)
+    # bit-identical to the uninterrupted twin fleet
+    for t, xr in zip(ts, xrefs):
+        assert np.array_equal(np.asarray(t.result.x), xr)
+    # original trace ids survived the move
+    assert [t.trace_id for t in ts] == [tr for _r, tr in orig]
+    # victim-homed tickets actually changed replicas
+    moved = [t for t, (r0, _t) in zip(ts, orig) if r0 == victim]
+    assert moved and all(t.replica != victim for t in moved)
+    # the victim is DOWN; survivors untouched
+    hs = fleet.health_snapshot()
+    assert hs[victim]["down"] and hs[victim]["state"] == OPEN
+    assert sum(1 for s in hs.values() if s["down"]) == 1
+    # moved completions settled the DEAD replica's journal (via
+    # journal_ref): nothing left to replay, nothing double-solves
+    assert fleet.replicas[victim].journal.pending() == []
+    # the postmortem trail names the whole incident
+    assert _frec.events(kind="fleet.failover", since_seq=seq0)
+    assert _frec.events(kind="fleet.health", since_seq=seq0)
+
+
+def test_kill_failover_background_then_restore(poisson16):
+    # Dead-thread detection is never rate-limited, so this test does not
+    # need tight heartbeat windows -- and tight windows would false-trip
+    # the wedge detector on a survivor's long admission resetup.
+    fleet = _fleet()
+    fleet.start()
+    try:
+        ts = [fleet.submit(poisson16, _rhs(poisson16, s))
+              for s in range(3)]
+        victim = ts[0].replica
+        with faultinject.inject("replica_kill", fires=1,
+                                target=victim):
+            fleet.drain(timeout_s=300)
+        assert all(t.done and t.result.converged for t in ts)
+        hs = fleet.health_snapshot()
+        assert hs[victim]["down"] and not hs[victim]["thread_alive"]
+        # restore: breaker reset, a fresh scheduler thread, traffic OK
+        fleet.restore_replica(victim)
+        hs = fleet.health_snapshot()
+        assert hs[victim]["state"] == CLOSED and not hs[victim]["down"]
+        assert hs[victim]["thread_alive"]
+        t2 = fleet.submit(poisson16, _rhs(poisson16, 9))
+        fleet.drain(timeout_s=300)
+        assert t2.done and t2.result.converged
+    finally:
+        fleet.stop()
+
+
+def test_no_survivor_breakdown_not_wedged(poisson16):
+    """Satellite: a dead scheduler must never wedge fleet drain. With
+    no survivor, outstanding tickets complete BREAKDOWN with the
+    captured exception on ticket.error."""
+    fleet = _fleet(n=1)
+    t = fleet.submit(poisson16, _rhs(poisson16, 5))
+    t0 = time.monotonic()
+    with faultinject.inject("replica_kill", fires=1):
+        done = fleet.drain(timeout_s=60)
+    assert time.monotonic() - t0 < 30      # returned, didn't spin out
+    assert t.done
+    assert t.result.status_code == int(SolveStatus.BREAKDOWN)
+    assert isinstance(t.error, ChaosInjected)
+    assert any(d is t for d in done)
+
+
+# ---------------------------------------------------------------------------
+# wedge + slow detection through the policy chain
+# ---------------------------------------------------------------------------
+
+
+def test_wedge_detected_and_failed_over(poisson16):
+    fleet = _fast_health(_fleet())
+    t = fleet.submit(poisson16, _rhs(poisson16, 6))
+    victim = t.replica
+    with faultinject.inject("replica_wedge", fires=None,
+                            target=victim):
+        fleet.drain(timeout_s=300)
+    # default chain: WEDGED>probe_backoff then WEDGED>failover
+    assert t.done and t.result.converged and t.replica != victim
+    hs = fleet.health_snapshot()
+    assert hs[victim]["down"]
+    assert hs[victim]["last_event"] == "REPLICA_WEDGED"
+
+
+def test_slow_pace_opens_breaker(poisson16):
+    fleet = _fast_health(_fleet(
+        extra="fleet_slow_cycle_s=0.05, fleet_probe_backoff_s=30"))
+    t = fleet.submit(poisson16, _rhs(poisson16, 7))
+    victim = t.replica
+    base = metrics.snapshot().get("fleet.health.slow", 0)
+    with faultinject.inject("replica_slow", fires=3, value=0.2,
+                            target=victim):
+        fleet.drain(timeout_s=300)
+    # the replica still finishes its work (OPEN blocks ROUTING, not
+    # stepping) but the pace detector fired and opened the breaker
+    assert t.done and t.result.converged
+    assert metrics.snapshot().get("fleet.health.slow", 0) > base
+    hs = fleet.health_snapshot()
+    assert hs[victim]["last_event"] == "REPLICA_SLOW"
+    assert hs[victim]["state"] in (OPEN, HALF_OPEN)
+
+
+# ---------------------------------------------------------------------------
+# cross-replica journal adoption + deadline re-anchoring
+# ---------------------------------------------------------------------------
+
+
+def test_adopt_journal_replays_with_original_trace(poisson16,
+                                                   tmp_path):
+    """The replay half of adoption: pending records of a dead
+    replica's journal enter the adopter's queue under their ORIGINAL
+    trace ids, with deadlines re-anchored as remaining budget."""
+    a = SolveService(_svc_cfg(
+        extra=f"serving_journal_dir={tmp_path}/a"))
+    t0 = a.submit(poisson16, _rhs(poisson16, 8), deadline_s=500.0)
+    orig_trace = t0.trace_id
+    assert orig_trace
+    # service a "dies" without ever stepping: its journal holds one
+    # pending record
+    b = SolveService(_svc_cfg(
+        extra=f"serving_journal_dir={tmp_path}/b"))
+    base = metrics.snapshot().get("fleet.health.adopted", 0)
+    n = b.adopt_journal(a.journal)
+    assert n == 1
+    assert metrics.snapshot().get("fleet.health.adopted", 0) == base + 1
+    adopted = b._queue[0]
+    assert adopted.trace_id == orig_trace
+    assert adopted.journal_ref is a.journal
+    # remaining budget re-anchored against the adopter's clock
+    remaining = adopted.deadline_t - faultinject.service_now()
+    assert 0 < remaining <= 500.0 + 1e-6
+    b.drain(timeout_s=300)
+    assert adopted.done and adopted.result.converged
+    # the completion settled the ADOPTED journal, not b's own
+    assert a.journal.pending() == []
+
+
+def test_adopt_deadline_reanchor_under_clock_skew(poisson16,
+                                                  tmp_path):
+    """Satellite: the re-anchor math must hold when the service clock
+    itself is skewed (clock_skew chaos) — remaining budget is a
+    DELTA, immune to the absolute shift, matching the PR 11
+    same-replica recover() contract."""
+    with faultinject.inject("clock_skew", value=600.0, fires=None):
+        a = SolveService(_svc_cfg(
+            extra=f"serving_journal_dir={tmp_path}/a"))
+        a.submit(poisson16, _rhs(poisson16, 9), deadline_s=50.0)
+        b = SolveService(_svc_cfg(
+            extra=f"serving_journal_dir={tmp_path}/b"))
+        assert b.adopt_journal(a.journal) == 1
+        adopted = b._queue[0]
+        remaining = adopted.deadline_t - faultinject.service_now()
+        assert 0 < remaining <= 50.0 + 1e-6
+        b.drain(timeout_s=300)
+    assert adopted.done and adopted.result.converged
+
+
+# ---------------------------------------------------------------------------
+# rolling restarts: drain/restore + affinity snap-back + warm-up
+# ---------------------------------------------------------------------------
+
+
+def test_drain_replica_hands_off_and_restore_returns_home(poisson16):
+    fleet = _fleet(extra="fleet_warmup_s=0")
+    t = fleet.submit(poisson16, _rhs(poisson16, 10))
+    home = t.replica
+    moved = fleet.drain_replica(home)
+    assert moved == 1 and t.replica != home     # queued work handed off
+    fleet.drain(timeout_s=300)
+    assert t.done and t.result.converged
+    # draining diverts but does NOT rehome: restore brings it back
+    t2 = fleet.submit(poisson16, _rhs(poisson16, 11))
+    assert t2.replica != home and t2.route == "spill"
+    fleet.restore_replica(home)
+    t3 = fleet.submit(poisson16, _rhs(poisson16, 12))
+    assert t3.replica == home and t3.route == "warm"
+    fleet.drain(timeout_s=300)
+    assert t2.done and t3.done
+
+
+def test_affinity_snap_back_only_by_eviction(poisson16):
+    """After a kill + restore, the rehomed fingerprint STAYS with its
+    adopter (no thundering-herd snap-back); and during the restore
+    warm-up grace a NEW fingerprint's cold placement avoids the
+    returnee."""
+    fleet = _fleet(extra="fleet_warmup_s=30")
+    t = fleet.submit(poisson16, _rhs(poisson16, 13))
+    victim = t.replica
+    with faultinject.inject("replica_kill", fires=1, target=victim):
+        fleet.drain(timeout_s=300)
+    adopter = t.replica
+    assert adopter != victim
+    fleet.restore_replica(victim)
+    # rehomed fingerprint stays with the adopter
+    t2 = fleet.submit(poisson16, _rhs(poisson16, 14))
+    assert t2.replica == adopter and t2.route == "warm"
+    # a new fingerprint cold-places AWAY from the warming returnee
+    small = gallery.poisson("5pt", 12, 12).init()
+    t3 = fleet.submit(small, _rhs(small, 15))
+    assert t3.replica != victim and t3.route == "cold"
+    fleet.drain(timeout_s=300)
+    assert t2.done and t3.done
+
+
+# ---------------------------------------------------------------------------
+# breaker probe admission
+# ---------------------------------------------------------------------------
+
+
+def test_half_open_admits_exactly_one_fingerprint(poisson16):
+    fleet = _fleet()
+    rid = next(iter(fleet.replicas))
+    br = fleet.health.breaker(rid)
+    br.state = HALF_OPEN
+    br.probe_fp = None
+    base = metrics.snapshot().get("fleet.health.probe_trials", 0)
+    assert fleet.health.probe_admit(rid, "fpA")       # the one trial
+    assert not fleet.health.probe_admit(rid, "fpB")   # diverted
+    assert fleet.health.probe_admit(rid, "fpA")       # trial retries OK
+    assert metrics.snapshot().get(
+        "fleet.health.probe_trials", 0) == base + 1
+    # a completion since the probe began closes the breaker
+    br.probe_base = fleet.replicas[rid].completed_total - 1
+    fleet.health.check()
+    assert br.state == CLOSED and br.failures == 0
+
+
+def test_route_diverts_off_open_breaker(poisson16):
+    fleet = _fleet()
+    t = fleet.submit(poisson16, _rhs(poisson16, 16))
+    home = t.replica
+    fleet.drain(timeout_s=300)
+    br = fleet.health.breaker(home)
+    br.state = OPEN
+    br.not_before = time.monotonic() + 60
+    t2 = fleet.submit(poisson16, _rhs(poisson16, 17))
+    assert t2.replica != home and t2.route == "spill"
+    # placement NOT rehomed by a breaker divert (affinity retained)
+    assert fleet._placed[
+        f"{__import__('amgx_tpu.batch.queue', fromlist=['pattern_fingerprint']).pattern_fingerprint(poisson16)}/float64"] == home
+    br.state = CLOSED
+    fleet.drain(timeout_s=300)
+    assert t2.done and t2.result.converged
+
+
+# ---------------------------------------------------------------------------
+# capi surface
+# ---------------------------------------------------------------------------
+
+
+def test_capi_fleet_health_and_rolling_restart(poisson16):
+    from amgx_tpu import capi
+    assert capi.AMGX_initialize() == 0
+    rc, cfg_h = capi.AMGX_config_create(
+        BATCHED_CG + ", serving_bucket_slots=2, fleet_replicas=2,"
+        " fleet_warmup_s=0")
+    assert rc == 0
+    rc, rsrc_h = capi.AMGX_resources_create_simple(cfg_h)
+    rc, fleet_h = capi.AMGX_fleet_create(rsrc_h, "dDDI", cfg_h)
+    assert rc == 0
+    rc, m_h = capi.AMGX_matrix_create(rsrc_h, "dDDI")
+    rc, b_h = capi.AMGX_vector_create(rsrc_h, "dDDI")
+    ro = np.asarray(poisson16.row_offsets)
+    ci = np.asarray(poisson16.col_indices)
+    v = np.asarray(poisson16.values)
+    assert capi.AMGX_matrix_upload_all(
+        m_h, poisson16.num_rows, v.size, 1, 1, ro, ci, v, None) == 0
+    b = _rhs(poisson16, 18)
+    assert capi.AMGX_vector_upload(b_h, b.size, 1, b) == 0
+    rc, health = capi.AMGX_fleet_health(fleet_h)
+    assert rc == 0 and set(health) == {"r0", "r1"}
+    assert all(s["state"] == CLOSED and not s["down"]
+               for s in health.values())
+    rc, t1 = capi.AMGX_fleet_submit(fleet_h, m_h, b_h, "acme", None)
+    assert rc == 0
+    rc, home = capi.AMGX_fleet_ticket_replica(t1)
+    rc, n_moved = capi.AMGX_fleet_drain_replica(fleet_h, home)
+    assert rc == 0 and n_moved == 1
+    rc, health = capi.AMGX_fleet_health(fleet_h)
+    assert rc == 0 and health[home]["draining"]
+    rc, _n = capi.AMGX_fleet_drain(fleet_h, 300)
+    assert rc == 0
+    rc, done, st = capi.AMGX_service_ticket_status(t1)
+    assert rc == 0 and done == 1 and st == 0
+    assert capi.AMGX_fleet_restore_replica(fleet_h, home) == 0
+    rc, health = capi.AMGX_fleet_health(fleet_h)
+    assert rc == 0 and not health[home]["draining"]
+    assert capi.AMGX_service_ticket_destroy(t1) == 0
+    assert capi.AMGX_fleet_destroy(fleet_h) == 0
+
+
+# ---------------------------------------------------------------------------
+# telemetry catalog
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_health_metrics_declared():
+    snap = metrics.snapshot()
+    for name in ("fleet.health.suspect", "fleet.health.wedged",
+                 "fleet.health.slow", "fleet.health.dead",
+                 "fleet.health.down", "fleet.health.breaker_open",
+                 "fleet.health.breaker_half_open",
+                 "fleet.health.breaker_closed",
+                 "fleet.health.probe_trials",
+                 "fleet.health.rehomed", "fleet.health.requeued",
+                 "fleet.health.adopted", "fleet.health.drains",
+                 "fleet.health.restores", "fleet.health.available"):
+        assert name in snap, name

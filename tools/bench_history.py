@@ -144,6 +144,15 @@ SERIES: Tuple[Tuple[str, str, float, str], ...] = (
      "p99 latency of ADMITTED fleet requests at 2x the fleet's "
      "measured closed-loop service rate (ms) — must stay within the "
      "deadline budget, sheds classified OVERLOADED"),
+    # ISSUE 17 fleet failover: recorded from r07 on (replica health +
+    # journal adoption land between r06 and r07)
+    ("fleet_failover_wall_s", "lower", 0.50,
+     "fleet kill-1-of-2 failover wall: replica_kill to the last "
+     "victim-homed ticket terminal on a survivor (s), moved solves "
+     "bit-identical to an uninterrupted twin fleet"),
+    ("fleet_failover_lost_requests", "lower_abs", 0.0,
+     "requests lost across the fleet failover drill (abs gate: the "
+     "zero-loss guarantee is a constant target, any loss regresses)"),
     ("chaos_recover_wall_s", "lower", 0.60,
      "serving kill-and-recover wall: journal replay + persisted "
      "hierarchies + AOT warm start to fully drained (s)"),
