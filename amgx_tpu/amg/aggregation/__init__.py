@@ -250,10 +250,19 @@ class AggregationAMGLevel(AMGLevel):
         grid-transfer kernels; distributed level-data (explicit sharded
         R/P) declines — the cycle's plain compose already runs the
         halo-folded per-shard smoother kernel through the smoother's
-        own dispatch (ops/smooth.fused_smooth)."""
+        own dispatch (ops/smooth.fused_smooth). Matrix-free levels
+        (constant-coefficient stencil payload installed by the
+        hierarchy's `matrix_free` detector) additionally advertise
+        the "matrix_free" capability — the cycle's fused hooks then
+        route through the coefficient kernels of ops/stencil.py with
+        no A value-slab operand at all."""
         if "R" in data or "P" in data:
             return ()
-        return self.FUSION_CAPS if self.smoother is not None else ()
+        if self.smoother is None:
+            return ()
+        if "stencil" in data:
+            return self.FUSION_CAPS | {"matrix_free"}
+        return self.FUSION_CAPS
 
     def restrict_fused(self, data, b, x, sweeps: int):
         """Presmooth + restriction in one kernel (ops/smooth.py), or

@@ -513,6 +513,34 @@ class GeoRapPlan:
             block_dimx=1, block_dimy=1, initialized=True,
             grid_shape=tuple(self.coarse_shape))
 
+    def coarse_coeffs(self, coeffs):
+        """Coarse constant-stencil coefficients (kc,) straight from the
+        fine ones (k,) — the matrix-free twin of `values`: when the fine
+        level is a constant-coefficient stencil (ops/stencil.py), every
+        in-grid coarse entry is the same static contraction of the fine
+        coefficients, so the whole Galerkin numeric phase collapses to a
+        (kc, k) matmul on O(k) numbers. Per contribution the weight is
+        the number of fine cells in a coarse aggregate that carry it: 2
+        for each paired axis whose parity mask is None (both parities
+        contribute), 1 otherwise. None when a paired axis has an odd
+        fine extent — the last aggregate is then a singleton along that
+        axis and the coarse operator is no longer constant."""
+        for a in self.axes:
+            if self.fine_shape[a] % 2:
+                return None
+        M = getattr(self, "_coeff_mat", None)
+        if M is None:
+            M = np.zeros((self.kc, len(self.dia_offsets)))
+            for ci, entries in enumerate(self.contribs):
+                for (t, px, py, pz) in entries:
+                    w = 1
+                    for a, p in zip((0, 1, 2), (px, py, pz)):
+                        if a in self.axes and p is None:
+                            w *= 2
+                    M[ci, t] += w
+            self._coeff_mat = M
+        return jnp.asarray(M, coeffs.dtype) @ coeffs
+
     def coarse_matrix(self, A: CsrMatrix):
         """Planned numeric phase with the same wrap-check discipline
         as `geo_coarse_values`: deferred inside a hierarchy build

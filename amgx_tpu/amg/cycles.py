@@ -13,6 +13,7 @@ import jax.numpy as jnp
 
 from ..ops import blas
 from ..ops.spmv import residual, spmv
+from ..ops.stencil import level_operator as _level_A
 from ..telemetry import diagnostics as _diag
 
 
@@ -30,7 +31,9 @@ def _smooth_residual(level, data, b, x, sweeps: int):
     pass over A instead of sweeps+1. Smoothers without a fused form
     compose exactly what this replaced (Solver.smooth_residual)."""
     if sweeps <= 0 or level.smoother is None:
-        return x, residual(data["A"], x, b)
+        # matrix-free levels rebuild the operator in-trace
+        # (ops/stencil.level_operator); slab levels pass through
+        return x, residual(_level_A(data), x, b)
     return level.smoother.smooth_residual(data["smoother"], b, x, sweeps)
 
 
@@ -145,11 +148,11 @@ def _cycle(amg, shape: str, data, lvl: int, b, x):
     level = levels[lvl]
     ldata = data["levels"][lvl]
     if rec is not None:
-        rec.record(lvl, 0, ldata["A"], x, b)
+        rec.record(lvl, 0, _level_A(ldata), x, b)
     x, bc = _smooth_restrict(amg, level, ldata, b, x,
                              amg._sweeps(lvl, pre=True))
     if rec is not None:
-        rec.record(lvl, 1, ldata["A"], x, b)
+        rec.record(lvl, 1, _level_A(ldata), x, b)
     xc = jnp.zeros_like(bc)
     if shape == "V":
         xc = _cycle(amg, "V", data, lvl + 1, bc, xc)
@@ -165,9 +168,9 @@ def _cycle(amg, shape: str, data, lvl: int, b, x):
         raise ValueError(f"unknown fixed cycle {shape!r}")
     if rec is not None:
         x = x + level.prolongate(ldata, xc)
-        rec.record(lvl, 2, ldata["A"], x, b)
+        rec.record(lvl, 2, _level_A(ldata), x, b)
         x = _smooth(level, ldata, b, x, amg._sweeps(lvl, pre=False))
-        rec.record(lvl, 3, ldata["A"], x, b)
+        rec.record(lvl, 3, _level_A(ldata), x, b)
         return x
     return _prolongate_smooth(amg, level, ldata, b, x, xc,
                               amg._sweeps(lvl, pre=False))
@@ -184,11 +187,11 @@ def _kcycle(amg, data, lvl: int, b, x, flex: bool):
     ldata = data["levels"][lvl]
     rec = _diag.current()
     if rec is not None:
-        rec.record(lvl, 0, ldata["A"], x, b)
+        rec.record(lvl, 0, _level_A(ldata), x, b)
     x, bc = _smooth_restrict(amg, level, ldata, b, x,
                              amg._sweeps(lvl, pre=True))
     if rec is not None:
-        rec.record(lvl, 1, ldata["A"], x, b)
+        rec.record(lvl, 1, _level_A(ldata), x, b)
     Ac_data_lvl = lvl + 1
 
     def M(v):
@@ -203,7 +206,9 @@ def _kcycle(amg, data, lvl: int, b, x, flex: bool):
                 return spmv_coarsest(
                     amg, data, v.astype(jnp.float32)).astype(v.dtype)
             return spmv_coarsest(amg, data, v)
-        return spmv(data["levels"][Ac_data_lvl]["A"], v)
+        # matrix-free coarse levels materialize in-trace for the
+        # K-cycle matvec (VPU work instead of a resident slab)
+        return spmv(_level_A(data["levels"][Ac_data_lvl]), v)
 
     # a few steps of preconditioned CG on the coarse equation
     xc = jnp.zeros_like(bc)
@@ -235,9 +240,9 @@ def _kcycle(amg, data, lvl: int, b, x, flex: bool):
         p = z + beta * p
     if rec is not None:
         x = x + level.prolongate(ldata, xc)
-        rec.record(lvl, 2, ldata["A"], x, b)
+        rec.record(lvl, 2, _level_A(ldata), x, b)
         x = _smooth(level, ldata, b, x, amg._sweeps(lvl, pre=False))
-        rec.record(lvl, 3, ldata["A"], x, b)
+        rec.record(lvl, 3, _level_A(ldata), x, b)
         return x
     return _prolongate_smooth(amg, level, ldata, b, x, xc,
                               amg._sweeps(lvl, pre=False))

@@ -106,6 +106,16 @@ class AMGLevel:
             # the smoother's solve_data already slims its own A when its
             # sweeps only SpMV (Solver.slim_A_ok)
             d["smoother"] = self.smoother.solve_data()
+            st = d["smoother"].get("stencil") if isinstance(
+                d["smoother"], dict) else None
+            if st is not None:
+                # matrix-free level: the LEVEL operator view drops its
+                # value slab too (the stencil payload is the operator;
+                # consumers that need a matrix rebuild it in-trace via
+                # ops/stencil.level_operator)
+                from ..ops.stencil import mf_slim
+                d["A"] = mf_slim(A)
+                d["stencil"] = st
         return d
 
     def restrict(self, data, r):
@@ -176,6 +186,10 @@ class AMG:
         self.cycle_fusion = bool(int(cfg.get("cycle_fusion", scope)))
         self.cycle_fusion_tail_rows = int(
             cfg.get("cycle_fusion_tail_rows", scope))
+        # matrix-free GEO levels (ops/stencil.py): auto = only on a
+        # real TPU backend (CPU rigs stay bit-identical to the slab
+        # build), 1 = force the detector everywhere, 0 = never
+        self.matrix_free = str(cfg.get("matrix_free", scope))
         # effective hierarchy/cycle precision: the shared policy
         # resolves amg_precision / solve_precision / tpu_dtype into one
         # answer (precision.py) and rejects contradictory combinations
@@ -683,6 +697,33 @@ class AMG:
             level.smoother.set_cf_map(level.cf_map)
         with trace_region(f"amg.L{level.level_index}.smoother_setup"):
             level.smoother.setup(level.A)
+        self._maybe_install_stencil(level)
+
+    def _maybe_install_stencil(self, level: AMGLevel):
+        """Matrix-free install (`matrix_free` knob): when this level's
+        operator is a constant-coefficient grid stencil and its
+        smoother can run from coefficients alone, attach a
+        StencilOperator to the smoother — its solve_data then drops
+        the DIA value slab (and dinv vector / fused slabs) and every
+        smooth entry routes through ops/stencil.py. `_mf_stencil` is
+        ALWAYS (re)assigned so a stale stencil from a previous install
+        can never survive a resetup with new (variable) values."""
+        sm = level.smoother
+        if sm is None:
+            return
+        mode = getattr(self, "matrix_free", "auto")
+        on = mode == "1" or (mode == "auto"
+                             and jax.default_backend() == "tpu")
+        if not on or not getattr(type(sm), "supports_matrix_free",
+                                 False) \
+                or not getattr(sm, "fused_smoother", False):
+            sm._mf_stencil = None
+            return
+        from ..ops.stencil import detect_stencil
+        from ..profiling import trace_region
+        with trace_region(f"amg.L{level.level_index}.mf_detect"):
+            sm._mf_stencil = detect_stencil(
+                level.A, dinv_mode=sm.matrix_free_dinv)
 
     def _finalize_setup(self, t0: float):
         from ..solvers.base import make_solver
@@ -790,7 +831,13 @@ class AMG:
         slabs, damping tables, color maps)."""
         if self._ship_device is None:
             return
-        pieces = [level.A.slim_for_spmv()]
+        A_slim = level.A.slim_for_spmv()
+        if getattr(level.smoother, "_mf_stencil", None) is not None:
+            # matrix-free level: never ship the value slab — the
+            # solve-data tree carries only the stencil coefficients
+            from ..ops.stencil import mf_slim
+            A_slim = mf_slim(A_slim)
+        pieces = [A_slim]
         for name in ("P", "R"):
             op = getattr(level, name, None)
             if op is not None and op.initialized:

@@ -7,7 +7,9 @@ chains the SAME jitted building blocks the setup itself dispatches
 new fine DIA values in, every level's coarse DIA values, the Chebyshev
 taus, and the coarse dense QR factor out — all async dispatches with
 exactly ONE device sync (the batched GEO wrap-check flag, which must be
-re-validated because it depends on the values).
+re-validated because it depends on the values; matrix-free levels fold
+their stencil-constancy re-check into the same fetch and get their
+StencilOperator coefficients respliced from it).
 
 Reusing the setup's own jitted pieces is load-bearing for
 `resetup_first_s`: an earlier revision fused the whole plan into one
@@ -158,6 +160,15 @@ def build_plan(amg):
 
     from .aggregation.galerkin import _any_wrapped, _geo_compute
     from ..ops.pallas_spmv import LANES, dia_padded_rows
+    from ..ops.stencil import stencil_candidate
+
+    # matrix-free levels (ops/stencil.py): their StencilOperator
+    # coefficients must be refreshed from the new values, and the
+    # constancy invariant re-validated — new values may no longer be a
+    # constant stencil. The flag folds into the same single fetch as
+    # the wrap check below.
+    mf_on = [getattr(lv.smoother, "_mf_stencil", None) is not None
+             for lv in chain]
 
     def run(dia_vals0):
         # EAGER on purpose: every heavy piece below (_geo_compute,
@@ -165,13 +176,31 @@ def build_plan(amg):
         # compiled for this hierarchy, and the glue (DIA pack, dense
         # scatter, QR, casts) is small eager ops — so the first resetup
         # reuses the setup traces instead of compiling a fused twin.
-        outs = {"dia": [], "vals": [], "taus": [], "cast": {}}
+        outs = {"dia": [], "vals": [], "taus": [], "mf": [],
+                "cast": {}}
         dia_vals = dia_vals0
         wrapped = jnp.zeros((), bool)
         for i, p in enumerate(lv_plans):
             vals2d = dia_vals.reshape(p["k"], -1)[:, : p["n"]]
             wrapped = wrapped | _any_wrapped(vals2d, p["shifts"],
                                              p["fine_shape"])
+            if mf_on[i]:
+                c = None
+                if i > 0 and mf_on[i - 1]:
+                    gp = lv_plans[i - 1]["geo_plan"]
+                    if gp is not None:
+                        # constancy is inherited: a constant fine
+                        # stencil with even paired extents coarsens to
+                        # a constant stencil, so the derived coarse
+                        # coefficients need no re-compare
+                        c = gp.coarse_coeffs(outs["mf"][i - 1])
+                if c is None:
+                    ok_i, c = stencil_candidate(vals2d, p["shifts"],
+                                                p["fine_shape"])
+                    wrapped = wrapped | ~ok_i
+                outs["mf"].append(c)
+            else:
+                outs["mf"].append(None)
             if sm_plans[i][0] == "cheb":
                 lam = _lam_rowmax(vals2d)
                 taus = cheb_tabs[sm_plans[i][1]].astype(
@@ -216,7 +245,7 @@ def build_plan(amg):
         outs["wrapped"] = wrapped
         return outs
 
-    return {"fn": run, "lv": lv_plans, "sm": sm_plans,
+    return {"fn": run, "lv": lv_plans, "sm": sm_plans, "mf_on": mf_on,
             "l0_sig": (tuple(int(d) for d in chain[0].A.dia_offsets),
                        chain[0].A.num_rows, len(chain))}
 
@@ -237,6 +266,17 @@ def try_value_resetup(amg, A: CsrMatrix) -> bool:
            len(amg.levels))
     if sig != plan["l0_sig"]:
         return False
+    if [getattr(lv.smoother, "_mf_stencil", None) is not None
+            for lv in amg.levels] != plan["mf_on"]:
+        # a generic resetup flipped a level's matrix-free form since
+        # this plan was traced — rebuild so the coefficient refresh
+        # covers exactly the live stencils (a stale splice would leave
+        # old coefficients serving new values)
+        amg._vr_plan = None
+        plan = build_plan(amg)
+        amg._vr_plan = plan if plan is not None else False
+        if not plan:
+            return False
     outs = plan["fn"](A.dia_vals)
     if bool(outs["wrapped"]):     # ONE scalar fetch — the only sync
         amg._vr_plan = None       # values violate the GEO invariant
@@ -261,6 +301,12 @@ def try_value_resetup(amg, A: CsrMatrix) -> bool:
             precast[id(Ac.dia_vals)] = cast["dia"][i]
         sm = lv.smoother
         sm.A = fine
+        st = getattr(sm, "_mf_stencil", None)
+        if st is not None and outs["mf"][i] is not None:
+            # fresh leaf on purpose: downstream solve_data caches key
+            # on identity, and the stencil's static fields are unchanged
+            sm._mf_stencil = dataclasses.replace(
+                st, coeffs=outs["mf"][i])
         if plan["sm"][i][0] == "cheb":
             sm._taus = outs["taus"][i]
             if cast:

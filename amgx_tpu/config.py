@@ -357,6 +357,17 @@ def _register_default_parameters():
       "and the trailing cycle residual into single-pass Pallas kernels "
       "on DIA/SWELL levels (ops/smooth.py); 0 restores the unfused "
       "sweep-by-sweep compose bit-for-bit", 1, BOOL01)
+    R("matrix_free", str, "matrix-free form for constant-coefficient "
+      "GEO levels (ops/stencil.py): a setup-time detector replaces the "
+      "level's DIA value slab with a StencilOperator (k coefficients + "
+      "static geometry, O(levels) operator memory) and every fused "
+      "smoother/transfer/tail kernel reads the coefficients from SMEM "
+      "instead of streaming the A value slab from HBM; "
+      "variable-coefficient levels always keep the slab path. auto = "
+      "on only on a real TPU backend (CPU rigs bit-identical to the "
+      "slab build), 1 = force the detector on every backend (the XLA "
+      "masked-coefficient compose off-TPU), 0 = never detect — the "
+      "slab path bit-for-bit", "auto", ("auto", "0", "1"))
     R("cycle_fusion", int, "fuse the cycle's grid transfers into the "
       "smoother kernels on aggregation/DIA levels (restriction epilogue "
       "in the presmoother, prolongation+correction prologue in the "

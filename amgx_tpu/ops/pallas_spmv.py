@@ -316,8 +316,107 @@ def smooth_quota_rows(offsets, num_rows: int):
     return front, content, back
 
 
+# ---------------------------------------------------------------------------
+# Matrix-free (coeffs) mode: constant-coefficient stencil levels pass a
+# static `mf` spec (a namedtuple with fields offsets/shifts/shape/n/
+# dinv/diag_rank — ops.stencil.StencilSpec) instead of the quota-padded
+# vals/dinv slabs. The kernels synthesize each diagonal's masked value
+# rows in-register from k SMEM scalars: a row's entry for grid shift
+# (dx,dy,dz) is coeffs[t] where the shifted point stays inside the
+# (nx,ny,nz) grid and the row itself is a real matrix row, else 0 —
+# exactly the slab the matrix build would have materialized, so the
+# compute below the value fetch is shared, unchanged, and bit-equal.
+# ---------------------------------------------------------------------------
+
+# rows-of-f32 working-set charge per win_v row the plans budget for the
+# coeffs mode's in-register coordinates and masks (idx + 3 grid coords
+# + mask temporaries, ~6 int32/bool planes)
+_MF_WORK_ROWS = 6
+
+
+def _mf_coords(shape, idx):
+    """(gx, gy, gz) grid coordinates of linear element indices
+    (x fastest). Truncating div/rem: negative indices (front-halo pad
+    rows) produce garbage coordinates that the caller's row-valid mask
+    kills."""
+    nx, ny, _nz = shape
+    gx = jax.lax.rem(idx, jnp.int32(nx))
+    t1 = jax.lax.div(idx, jnp.int32(nx))
+    gy = jax.lax.rem(t1, jnp.int32(ny))
+    gz = jax.lax.div(t1, jnp.int32(ny))
+    return gx, gy, gz
+
+
+def _mf_ok(shape, coords, shift, base):
+    """`base` AND the in-grid mask of one stencil shift — static
+    bounds, so axes the shift does not cross cost nothing."""
+    nx, ny, nz = shape
+    dx, dy, dz = shift
+    gx, gy, gz = coords
+    ok = base
+    if dx < 0:
+        ok = ok & (gx >= -dx)
+    if dx > 0:
+        ok = ok & (gx < nx - dx)
+    if dy < 0:
+        ok = ok & (gy >= -dy)
+    if dy > 0:
+        ok = ok & (gy < ny - dy)
+    if dz < 0:
+        ok = ok & (gz >= -dz)
+    if dz > 0:
+        ok = ok & (gz < nz - dz)
+    return ok
+
+
+def _mf_vals_dinv(mf, cget, coords, valid, cdt):
+    """(val(t), dinv rows | None) synthesized from coefficient scalars.
+    `cget(t)` reads diagonal t's scalar at `cdt` (SMEM ref or array);
+    `valid` is the row-valid mask of the window. val(t) reproduces the
+    slab row (coefficient on in-grid rows, 0 on halo/off-grid rows);
+    the dinv rows reproduce safe_recip of the plain ("jacobi") or
+    L1-strengthened ("l1") diagonal the smoother would have shipped."""
+
+    def val(t):
+        ok = _mf_ok(mf.shape, coords, mf.shifts[t], valid)
+        return jnp.where(ok, cget(t), jnp.zeros((), cdt))
+
+    if mf.dinv is None:
+        return val, None
+    c0 = cget(mf.diag_rank)
+    if mf.dinv == "jacobi":
+        den = jnp.where(valid, c0, jnp.zeros((), cdt))
+    else:                           # "l1": diag + sign(diag)*sum|off|
+        l1 = jnp.zeros(valid.shape, cdt)
+        for t in range(len(mf.shifts)):
+            if t == mf.diag_rank:
+                continue
+            ok = _mf_ok(mf.shape, coords, mf.shifts[t], valid)
+            l1 = l1 + jnp.where(ok, jnp.abs(cget(t)),
+                                jnp.zeros((), cdt))
+        den = jnp.where(valid, c0 + jnp.sign(c0) * l1,
+                        jnp.zeros((), cdt))
+    dw = jnp.where(den == 0, jnp.zeros((), cdt),
+                   1 / jnp.where(den == 0, jnp.ones((), cdt), den))
+    return val, dw
+
+
+def _mf_block_vals(mf, coeffs_ref, row0, win_v, col, cdt):
+    """Coeffs-mode replacement for a block kernel's vals/dinv VMEM
+    windows: masked value rows + dinv rows for the compute region whose
+    first row is x row `row0` (traced). Coordinates are computed once
+    per block; each diagonal's mask is a handful of VPU compares."""
+    row = jax.lax.broadcasted_iota(jnp.int32, (win_v, LANES), 0)
+    idx = (row0 + row) * jnp.int32(LANES) + col
+    coords = _mf_coords(mf.shape, idx)
+    valid = (idx >= 0) & (idx < jnp.int32(mf.n))
+    return _mf_vals_dinv(mf, lambda t: coeffs_ref[t].astype(cdt),
+                         coords, valid, cdt)
+
+
 def dia_smooth_plan(offsets, k: int, num_rows: int, n_steps: int,
-                    with_residual: bool, itemsize: int = 4):
+                    with_residual: bool, itemsize: int = 4,
+                    coeffs: bool = False):
     """Block plan for the fused smoother or None when it does not pay.
 
     Returns (br, n_app, mr0, Mr0, win_x, win_v, n_blocks). The block
@@ -327,7 +426,13 @@ def dia_smooth_plan(offsets, k: int, num_rows: int, n_steps: int,
     (callers then chain shorter fused calls instead). `itemsize` is
     the operand-slab byte width: bf16 slabs (2) halve the DMA-window
     footprint so larger blocks fit, at the cost of the f32 upcast
-    working set the budget accounts below."""
+    working set the budget accounts below. `coeffs` plans the
+    matrix-free form: the values/dinv slabs (the k-stream that
+    dominates both the HBM traffic and the VMEM budget) vanish — the
+    kernel synthesizes masked value rows in-register from k SMEM
+    scalars, paying only a coordinate/mask working set — so the
+    halved traffic model admits larger blocks and the guard almost
+    never rejects."""
     if not offsets:
         return None
     n_app = int(n_steps) + (1 if with_residual else 0)
@@ -341,20 +446,31 @@ def dia_smooth_plan(offsets, k: int, num_rows: int, n_steps: int,
         win_v = br + (n_app - 1) * H
         win_x = win_v + H
         n_out = 2 if with_residual else 1
-        vmem = (2 * k * win_v            # values, double-buffered
-                + 2 * (2 * win_v + win_x)   # b/dinv/x windows, 2 slots
-                + 2 * n_out * br         # pipelined output blocks
-                ) * LANES * ib
+        if coeffs:
+            vmem = (2 * (win_v + win_x)  # b/x windows, 2 slots
+                    + 2 * n_out * br     # pipelined output blocks
+                    ) * LANES * ib \
+                + _MF_WORK_ROWS * win_v * LANES * 4   # coord/mask set
+        else:
+            vmem = (2 * k * win_v        # values, double-buffered
+                    + 2 * (2 * win_v + win_x)  # b/dinv/x windows
+                    + 2 * n_out * br     # pipelined output blocks
+                    ) * LANES * ib
         if ib < 4:
             # sub-f32 operands: the f32 state + per-application upcast
             # temporaries ride on top of the narrow DMA buffers
             vmem += (win_x + 3 * win_v) * LANES * 4
         if vmem > _SMOOTH_VMEM_BUDGET:
             continue
-        # traffic guard: the fused windows (k+2 streams of win_v plus
-        # the x window) must undercut the n_app separate passes
-        fused = (k + 2) * win_v + win_x
-        unfused = n_app * (k + 3) * br
+        # traffic guard: the fused windows must undercut the n_app
+        # separate passes (matrix-free: A contributes no stream on
+        # either side, so only the b/x/y vectors count)
+        if coeffs:
+            fused = 2 * win_v + win_x
+            unfused = n_app * 4 * br
+        else:
+            fused = (k + 2) * win_v + win_x
+            unfused = n_app * (k + 3) * br
         if n_app > 1 and fused >= 0.9 * unfused:
             return None     # halo dominates; caller chains smaller calls
         n_blocks = -(-rows128 // br)
@@ -380,7 +496,7 @@ def dia_smooth_supported(A, x_dtype, n_steps: int,
 
 def _dia_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x, win_v,
                        n_steps, with_residual, has_dinv, n_blocks,
-                       slab_shift, dtype):
+                       slab_shift, dtype, mf=None):
     """Kernel body factory. Buffer coordinates: state row j = x row
     i*br - n_app*mr0 + j; vals/b/dinv compute-region row j' = x row
     i*br - (n_app-1)*mr0 + j' (so an application's output row j'
@@ -389,7 +505,10 @@ def _dia_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x, win_v,
     beyond this plan's (n_app-1)*mr0 need. Sub-f32 operand dtypes
     (bf16) stream/DMA narrow and upcast per block in VMEM; the state
     and every accumulation run in `cdt` (f32+), and only the final
-    stores round back to the operand dtype."""
+    stores round back to the operand dtype. `mf` (matrix-free): no
+    vals/dinv operands or windows — value and dinv rows synthesize
+    in-register from k SMEM coefficient scalars (_mf_block_vals);
+    `has_dinv` must be False (the dinv, if any, comes from mf.dinv)."""
     ro = [mr0 + (o - (o % LANES)) // LANES for o in offsets]
     rl = [o % LANES for o in offsets]
     cdt = compute_dtype(dtype)
@@ -397,16 +516,29 @@ def _dia_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x, win_v,
     def kernel(*refs):
         # refs: xp, vals_q, bp, [dinv_q], taus, out_x, [out_r],
         #       xbuf, vbuf, bbuf, [dbuf], sems
-        xp_ref, vals_ref, bp_ref = refs[0], refs[1], refs[2]
-        dinv_ref = refs[3] if has_dinv else None
-        taus_ref = refs[3 + (1 if has_dinv else 0)]
-        off = 4 + (1 if has_dinv else 0)
-        y_ref = refs[off]
-        r_ref = refs[off + 1] if with_residual else None
-        off += 2 if with_residual else 1
-        xbuf, vbuf, bbuf = refs[off], refs[off + 1], refs[off + 2]
-        dbuf = refs[off + 3] if has_dinv else None
-        sems = refs[off + 3 + (1 if has_dinv else 0)]
+        # mf:   xp, bp, coeffs, taus, out_x, [out_r], xbuf, bbuf, sems
+        if mf is None:
+            xp_ref, vals_ref, bp_ref = refs[0], refs[1], refs[2]
+            dinv_ref = refs[3] if has_dinv else None
+            coeffs_ref = None
+            taus_ref = refs[3 + (1 if has_dinv else 0)]
+            off = 4 + (1 if has_dinv else 0)
+            y_ref = refs[off]
+            r_ref = refs[off + 1] if with_residual else None
+            off += 2 if with_residual else 1
+            xbuf, vbuf, bbuf = refs[off], refs[off + 1], refs[off + 2]
+            dbuf = refs[off + 3] if has_dinv else None
+            sems = refs[off + 3 + (1 if has_dinv else 0)]
+        else:
+            xp_ref, bp_ref = refs[0], refs[1]
+            vals_ref = dinv_ref = None
+            coeffs_ref, taus_ref = refs[2], refs[3]
+            y_ref = refs[4]
+            r_ref = refs[5] if with_residual else None
+            off = 6 if with_residual else 5
+            xbuf, bbuf = refs[off], refs[off + 1]
+            vbuf = dbuf = None
+            sems = refs[off + 2]
 
         i = pl.program_id(0)
         slot = jax.lax.rem(i, jnp.int32(2))
@@ -418,13 +550,14 @@ def _dia_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x, win_v,
                 pltpu.make_async_copy(xp_ref.at[pl.ds(base, win_x)],
                                       xbuf.at[jnp.int32(s)],
                                       sems.at[jnp.int32(s), 0]),
-                pltpu.make_async_copy(
-                    vals_ref.at[:, pl.ds(qbase, win_v)],
-                    vbuf.at[jnp.int32(s)], sems.at[jnp.int32(s), 1]),
-                pltpu.make_async_copy(bp_ref.at[pl.ds(base, win_v)],
-                                      bbuf.at[jnp.int32(s)],
-                                      sems.at[jnp.int32(s), 2]),
             ]
+            if mf is None:
+                ops.append(pltpu.make_async_copy(
+                    vals_ref.at[:, pl.ds(qbase, win_v)],
+                    vbuf.at[jnp.int32(s)], sems.at[jnp.int32(s), 1]))
+            ops.append(pltpu.make_async_copy(
+                bp_ref.at[pl.ds(base, win_v)], bbuf.at[jnp.int32(s)],
+                sems.at[jnp.int32(s), 1 if mf is not None else 2]))
             if has_dinv:
                 ops.append(pltpu.make_async_copy(
                     dinv_ref.at[pl.ds(qbase, win_v)],
@@ -445,9 +578,16 @@ def _dia_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x, win_v,
             d.wait()
 
         col = jax.lax.broadcasted_iota(jnp.int32, (win_v, LANES), 1)
-        vals = vbuf[slot]               # (k, win_v, 128) operand dtype
         bw = bbuf[slot].astype(cdt)     # (win_v, 128)
-        dw = dbuf[slot].astype(cdt) if has_dinv else None
+        if mf is None:
+            vals = vbuf[slot]           # (k, win_v, 128) operand dtype
+            def val(t):
+                return vals[t].astype(cdt)
+            dw = dbuf[slot].astype(cdt) if has_dinv else None
+        else:
+            row0 = i * jnp.int32(br) - jnp.int32((n_app - 1) * mr0)
+            val, dw = _mf_block_vals(mf, coeffs_ref, row0, win_v, col,
+                                     cdt)
 
         def apply_A(s):
             """A @ state on the compute region (win_v rows)."""
@@ -463,7 +603,7 @@ def _dia_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x, win_v,
                     wa = pltpu.roll(a, jnp.int32(shift), 1)
                     wb = pltpu.roll(b2, jnp.int32(shift), 1)
                     w = jnp.where(col < shift, wa, wb)
-                acc = acc + vals[t].astype(cdt) * w
+                acc = acc + val(t) * w
             return acc
 
         s = xbuf[slot].astype(cdt)      # (win_x, 128) state, f32+
@@ -471,7 +611,7 @@ def _dia_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x, win_v,
             tau = taus_ref[t]
             mid = jax.lax.slice_in_dim(s, mr0, mr0 + win_v, 1, 0)
             corr = tau * (bw - apply_A(s))
-            if has_dinv:
+            if dw is not None:
                 corr = corr * dw
             pieces = [mid + corr, jnp.zeros((Mr0, LANES), cdt)]
             if mr0:
@@ -489,28 +629,40 @@ def _dia_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x, win_v,
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "offsets", "num_rows", "with_residual", "interpret"))
+    "offsets", "num_rows", "with_residual", "mf", "interpret"))
 def _dia_smooth_call(vals_q, dinv_q, taus, b, x, offsets, num_rows,
-                     with_residual, interpret=False):
+                     with_residual, mf=None, coeffs=None,
+                     interpret=False):
     """Run the fused smoother kernel. `vals_q` (k, Q, 128) and `dinv_q`
     ((Q, 128) or None) are the QUOTA-PADDED operand slabs from
     ops.smooth (built once per setup, smooth_quota_rows layout); b and
     x are padded in-trace (the same cost the plain SpMV kernel already
-    pays for x). Caller must have checked dia_smooth_supported."""
-    k = vals_q.shape[0]
+    pays for x). Caller must have checked dia_smooth_supported.
+    Matrix-free form (`mf` spec + `coeffs` (k,)): vals_q/dinv_q are
+    None — the A-operand stream vanishes and the k coefficients ride
+    SMEM next to the taus."""
     n_steps = taus.shape[0]
     has_dinv = dinv_q is not None
-    dtype = vals_q.dtype
+    if mf is None:
+        k = vals_q.shape[0]
+        dtype = vals_q.dtype
+    else:
+        k = len(offsets)
+        dtype = x.dtype
     ib = jnp.dtype(dtype).itemsize
     plan = dia_smooth_plan(offsets, k, num_rows, n_steps, with_residual,
-                           itemsize=ib)
+                           itemsize=ib, coeffs=mf is not None)
     br, n_app, mr0, Mr0, win_x, win_v, nb = plan
-    qf, qc, qb = smooth_quota_rows(offsets, num_rows)
-    assert vals_q.shape[1] == qf + qc + qb, \
-        f"fused slab rows {vals_q.shape[1]} != quota {qf + qc + qb}"
-    # quota slab row qf == x row 0; this plan's window base (block i)
-    # is x row i*br - (n_app-1)*mr0, i.e. slab row i*br + slab_shift
-    slab_shift = qf - (n_app - 1) * mr0
+    if mf is None:
+        qf, qc, qb = smooth_quota_rows(offsets, num_rows)
+        assert vals_q.shape[1] == qf + qc + qb, \
+            f"fused slab rows {vals_q.shape[1]} != quota {qf + qc + qb}"
+        # quota slab row qf == x row 0; this plan's window base (block
+        # i) is x row i*br - (n_app-1)*mr0, i.e. slab row i*br +
+        # slab_shift
+        slab_shift = qf - (n_app - 1) * mr0
+    else:
+        slab_shift = 0
     n = num_rows
     # x window coordinates: front pad n_app*mr0 rows
     xp_rows = n_app * mr0 + nb * br + n_app * Mr0
@@ -527,17 +679,28 @@ def _dia_smooth_call(vals_q, dinv_q, taus, b, x, offsets, num_rows,
 
     kernel = _dia_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x,
                                 win_v, n_steps, with_residual, has_dinv,
-                                nb, slab_shift, dtype)
-    n_sem = 4 if has_dinv else 3
-    in_specs = [
-        pl.BlockSpec(memory_space=pl.ANY),          # xp
-        pl.BlockSpec(memory_space=pl.ANY),          # vals_q
-        pl.BlockSpec(memory_space=pl.ANY),          # bp
-    ]
-    operands = [xp, vals_q, bp]
-    if has_dinv:
-        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
-        operands.append(dinv_q)
+                                nb, slab_shift, dtype, mf=mf)
+    if mf is None:
+        n_sem = 4 if has_dinv else 3
+        in_specs = [
+            pl.BlockSpec(memory_space=pl.ANY),          # xp
+            pl.BlockSpec(memory_space=pl.ANY),          # vals_q
+            pl.BlockSpec(memory_space=pl.ANY),          # bp
+        ]
+        operands = [xp, vals_q, bp]
+        if has_dinv:
+            in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+            operands.append(dinv_q)
+    else:
+        n_sem = 2
+        in_specs = [
+            pl.BlockSpec(memory_space=pl.ANY),          # xp
+            pl.BlockSpec(memory_space=pl.ANY),          # bp
+            pl.BlockSpec((k,), lambda i: (jnp.int32(0),),
+                         memory_space=pltpu.SMEM),      # coeffs
+        ]
+        # coefficients ride SMEM at the accumulation dtype (like taus)
+        operands = [xp, bp, coeffs.astype(compute_dtype(dtype))]
     in_specs.append(pl.BlockSpec((n_steps,), lambda i: (jnp.int32(0),),
                                  memory_space=pltpu.SMEM))
     # taus stay at the ACCUMULATION dtype: a bf16-rounded damping
@@ -547,15 +710,16 @@ def _dia_smooth_call(vals_q, dinv_q, taus, b, x, offsets, num_rows,
     out_block = pl.BlockSpec((br, LANES), lambda i: (i, jnp.int32(0)),
                              memory_space=pltpu.VMEM)
     out_shape = jax.ShapeDtypeStruct((nb * br, LANES), dtype)
-    scratch = [
-        pltpu.VMEM((2, win_x, LANES), dtype),
-        pltpu.VMEM((2, k, win_v, LANES), dtype),
-        pltpu.VMEM((2, win_v, LANES), dtype),
-    ]
+    scratch = [pltpu.VMEM((2, win_x, LANES), dtype)]
+    if mf is None:
+        scratch.append(pltpu.VMEM((2, k, win_v, LANES), dtype))
+    scratch.append(pltpu.VMEM((2, win_v, LANES), dtype))
     if has_dinv:
         scratch.append(pltpu.VMEM((2, win_v, LANES), dtype))
     scratch.append(pltpu.SemaphoreType.DMA((2, n_sem)))
     n_out = 2 if with_residual else 1
+    nbytes = ((k + 2) * win_v + win_x + n_out * br) if mf is None \
+        else (2 * win_v + win_x + n_out * br)
     out = pl.pallas_call(
         kernel,
         grid=(nb,),
@@ -567,8 +731,7 @@ def _dia_smooth_call(vals_q, dinv_q, taus, b, x, offsets, num_rows,
         scratch_shapes=scratch,
         cost_estimate=pl.CostEstimate(
             flops=2 * n_app * k * nb * br * LANES,
-            bytes_accessed=((k + 2) * win_v + win_x + n_out * br)
-            * nb * LANES * ib,
+            bytes_accessed=nbytes * nb * LANES * ib,
             transcendentals=0,
         ),
         # NOTE: `interpret` must be resolved by the (un-jitted) caller —
@@ -583,6 +746,17 @@ def _dia_smooth_call(vals_q, dinv_q, taus, b, x, offsets, num_rows,
         v = o.reshape(-1)
         trimmed.append(v[:n] if v.shape[0] != n else v)
     return tuple(trimmed) if with_residual else trimmed[0]
+
+
+def _dia_stencil_smooth_call(coeffs, taus, b, x, spec, with_residual,
+                             interpret=False):
+    """Matrix-free fused smoother: the dia_smooth kernel with the
+    quota-padded vals/dinv slabs replaced by k SMEM scalars. `spec` is
+    the level's StencilSpec (ops.stencil); caller must have checked
+    stencil_smooth_supported."""
+    return _dia_smooth_call(None, None, taus, b, x, spec.offsets,
+                            spec.n, with_residual, mf=spec,
+                            coeffs=coeffs, interpret=interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -710,7 +884,8 @@ class TransferSlabs:
 
 def dia_restrict_plan(offsets, k: int, num_rows: int, n_steps: int,
                       m: int, windows, weighted: bool = False,
-                      wavg=None, itemsize: int = 4):
+                      wavg=None, itemsize: int = 4,
+                      coeffs: bool = False):
     """Block plan for the smoother+restriction-epilogue kernel, or
     None. Mirrors dia_smooth_plan(with_residual=True) plus the epilogue
     buffers: m double-buffered child-index windows (and, `weighted`,
@@ -739,11 +914,16 @@ def dia_restrict_plan(offsets, k: int, num_rows: int, n_steps: int,
         cw = wmap[br]
         win_v = br + (n_app - 1) * H
         win_x = win_v + H
-        vmem = (2 * k * win_v + 2 * (2 * win_v + win_x)
-                + 2 * br                 # x output pipeline
-                + 2 * cw                 # partial-coarse output pipeline
-                ) * LANES * ib \
-            + 2 * m * cw * LANES * 4     # child-index windows (int32)
+        if coeffs:
+            vmem = (2 * (win_v + win_x) + 2 * br + 2 * cw) * LANES \
+                * ib + 2 * m * cw * LANES * 4 \
+                + _MF_WORK_ROWS * win_v * LANES * 4
+        else:
+            vmem = (2 * k * win_v + 2 * (2 * win_v + win_x)
+                    + 2 * br             # x output pipeline
+                    + 2 * cw             # partial-coarse output pipeline
+                    ) * LANES * ib \
+                + 2 * m * cw * LANES * 4   # child-index windows (int32)
         if weighted:
             vmem += 2 * m * cw * LANES * ib   # weight windows
         if ib < 4:
@@ -755,9 +935,13 @@ def dia_restrict_plan(offsets, k: int, num_rows: int, n_steps: int,
         # plus the standalone restrict pass (r write + r read + bc
         # write ~ 3*br + cw; weighted: + the R vals/cols stream the
         # unfused SWELL SpMV would read, ~ 2*wavg*cw)
-        fused = (k + 2) * win_v + win_x + (tabs * m + 1) * cw
-        unfused = n_app * (k + 3) * br + 3 * br + cw \
-            + (2 * wavg * cw if weighted else 0)
+        if coeffs:
+            fused = 2 * win_v + win_x + (m + 1) * cw
+            unfused = n_app * 4 * br + 3 * br + cw
+        else:
+            fused = (k + 2) * win_v + win_x + (tabs * m + 1) * cw
+            unfused = n_app * (k + 3) * br + 3 * br + cw \
+                + (2 * wavg * cw if weighted else 0)
         if n_app > 1 and fused >= 0.95 * unfused:
             continue
         n_blocks = -(-rows128 // br)
@@ -767,7 +951,8 @@ def dia_restrict_plan(offsets, k: int, num_rows: int, n_steps: int,
 
 def dia_prolong_plan(offsets, k: int, num_rows: int, n_steps: int,
                      windows, mp: int = 1, weighted: bool = False,
-                     pavg=None, itemsize: int = 4):
+                     pavg=None, itemsize: int = 4,
+                     coeffs: bool = False):
     """Block plan for the prolongation-prologue+smoother kernel, or
     None. with_residual is never true here (the correction folds into
     the POST-smoother); the prologue adds the aggregate-id window (or,
@@ -792,11 +977,16 @@ def dia_prolong_plan(offsets, k: int, num_rows: int, n_steps: int,
         pcw = wmap[br]
         win_v = br + (n_app - 1) * H
         win_x = win_v + H
-        vmem = (2 * k * win_v + 2 * (2 * win_v + win_x)
-                + 2 * br                 # x output pipeline
-                + 2 * pcw                # coarse-vector windows
-                ) * LANES * ib \
-            + 2 * mp * win_x * LANES * 4      # id windows (int32)
+        if coeffs:
+            vmem = (2 * (win_v + win_x) + 2 * br + 2 * pcw) * LANES \
+                * ib + 2 * win_x * LANES * 4 \
+                + _MF_WORK_ROWS * win_v * LANES * 4
+        else:
+            vmem = (2 * k * win_v + 2 * (2 * win_v + win_x)
+                    + 2 * br             # x output pipeline
+                    + 2 * pcw            # coarse-vector windows
+                    ) * LANES * ib \
+                + 2 * mp * win_x * LANES * 4      # id windows (int32)
         if weighted:
             vmem += 2 * mp * win_x * LANES * ib   # weight windows
         if ib < 4:
@@ -806,9 +996,13 @@ def dia_prolong_plan(offsets, k: int, num_rows: int, n_steps: int,
         # guard vs unfused: n_app passes plus the correction pass
         # (x read + xc read + x write ~ 2*br + pcw; weighted: + the P
         # vals/cols stream of the unfused SWELL prolongation)
-        fused = (k + 2) * win_v + win_x + tabs * mp * win_x + pcw
-        unfused = n_app * (k + 3) * br + 2 * br + pcw \
-            + (2 * pavg * br if weighted else 0)
+        if coeffs:
+            fused = 2 * win_v + win_x + win_x + pcw
+            unfused = n_app * 4 * br + 2 * br + pcw
+        else:
+            fused = (k + 2) * win_v + win_x + tabs * mp * win_x + pcw
+            unfused = n_app * (k + 3) * br + 2 * br + pcw \
+                + (2 * pavg * br if weighted else 0)
         if fused >= 0.95 * unfused and n_app > 1:
             continue
         n_blocks = -(-rows128 // br)
@@ -850,7 +1044,8 @@ def dia_prolong_supported(A, x_dtype, n_steps: int, xfer) -> bool:
 
 def _dia_smooth_restrict_kernel(offsets, br, n_app, mr0, Mr0, win_x,
                                 win_v, n_steps, has_dinv, n_blocks,
-                                slab_shift, m, cw, has_w, dtype):
+                                slab_shift, m, cw, has_w, dtype,
+                                mf=None):
     """Kernel body factory: the dia_smooth body (window coordinates
     documented on _dia_smooth_kernel) with the residual epilogue
     replaced by per-block partial coarse segment-sums — r is gathered
@@ -869,27 +1064,39 @@ def _dia_smooth_restrict_kernel(offsets, br, n_app, mr0, Mr0, win_x,
         # refs: xp, vals_q, bp, [dinv_q], ctab, [cwt], cb, taus,
         #       out_x, out_bc, xbuf, vbuf, bbuf, [dbuf], cbuf, [wbuf],
         #       sems
-        xp_ref, vals_ref, bp_ref = refs[0], refs[1], refs[2]
-        off = 3
-        dinv_ref = refs[off] if has_dinv else None
-        off += 1 if has_dinv else 0
-        ctab_ref = refs[off]
-        off += 1
-        cwt_ref = refs[off] if has_w else None
-        off += 1 if has_w else 0
-        cb_ref, taus_ref = refs[off], refs[off + 1]
-        off += 2
-        y_ref, bc_ref = refs[off], refs[off + 1]
-        off += 2
-        xbuf, vbuf, bbuf = refs[off], refs[off + 1], refs[off + 2]
-        off += 3
-        dbuf = refs[off] if has_dinv else None
-        off += 1 if has_dinv else 0
-        cbuf = refs[off]
-        off += 1
-        wbuf = refs[off] if has_w else None
-        off += 1 if has_w else 0
-        sems = refs[off]
+        # mf:   xp, bp, ctab, coeffs, cb, taus, out_x, out_bc,
+        #       xbuf, bbuf, cbuf, sems
+        if mf is None:
+            xp_ref, vals_ref, bp_ref = refs[0], refs[1], refs[2]
+            coeffs_ref = None
+            off = 3
+            dinv_ref = refs[off] if has_dinv else None
+            off += 1 if has_dinv else 0
+            ctab_ref = refs[off]
+            off += 1
+            cwt_ref = refs[off] if has_w else None
+            off += 1 if has_w else 0
+            cb_ref, taus_ref = refs[off], refs[off + 1]
+            off += 2
+            y_ref, bc_ref = refs[off], refs[off + 1]
+            off += 2
+            xbuf, vbuf, bbuf = refs[off], refs[off + 1], refs[off + 2]
+            off += 3
+            dbuf = refs[off] if has_dinv else None
+            off += 1 if has_dinv else 0
+            cbuf = refs[off]
+            off += 1
+            wbuf = refs[off] if has_w else None
+            off += 1 if has_w else 0
+            sems = refs[off]
+        else:
+            xp_ref, bp_ref, ctab_ref = refs[0], refs[1], refs[2]
+            vals_ref = dinv_ref = cwt_ref = None
+            coeffs_ref, cb_ref, taus_ref = refs[3], refs[4], refs[5]
+            y_ref, bc_ref = refs[6], refs[7]
+            xbuf, bbuf, cbuf = refs[8], refs[9], refs[10]
+            vbuf = dbuf = wbuf = None
+            sems = refs[11]
 
         i = pl.program_id(0)
         slot = jax.lax.rem(i, jnp.int32(2))
@@ -901,14 +1108,15 @@ def _dia_smooth_restrict_kernel(offsets, br, n_app, mr0, Mr0, win_x,
                 pltpu.make_async_copy(xp_ref.at[pl.ds(base, win_x)],
                                       xbuf.at[jnp.int32(s)],
                                       sems.at[jnp.int32(s), 0]),
-                pltpu.make_async_copy(
-                    vals_ref.at[:, pl.ds(qbase, win_v)],
-                    vbuf.at[jnp.int32(s)], sems.at[jnp.int32(s), 1]),
-                pltpu.make_async_copy(bp_ref.at[pl.ds(base, win_v)],
-                                      bbuf.at[jnp.int32(s)],
-                                      sems.at[jnp.int32(s), 2]),
             ]
-            nsem = 3
+            if mf is None:
+                ops.append(pltpu.make_async_copy(
+                    vals_ref.at[:, pl.ds(qbase, win_v)],
+                    vbuf.at[jnp.int32(s)], sems.at[jnp.int32(s), 1]))
+            ops.append(pltpu.make_async_copy(
+                bp_ref.at[pl.ds(base, win_v)], bbuf.at[jnp.int32(s)],
+                sems.at[jnp.int32(s), 1 if mf is not None else 2]))
+            nsem = 2 if mf is not None else 3
             if has_dinv:
                 ops.append(pltpu.make_async_copy(
                     dinv_ref.at[pl.ds(qbase, win_v)],
@@ -942,9 +1150,16 @@ def _dia_smooth_restrict_kernel(offsets, br, n_app, mr0, Mr0, win_x,
             d.wait()
 
         col = jax.lax.broadcasted_iota(jnp.int32, (win_v, LANES), 1)
-        vals = vbuf[slot]
         bw = bbuf[slot].astype(cdt)
-        dw = dbuf[slot].astype(cdt) if has_dinv else None
+        if mf is None:
+            vals = vbuf[slot]
+            def val(t):
+                return vals[t].astype(cdt)
+            dw = dbuf[slot].astype(cdt) if has_dinv else None
+        else:
+            row0 = i * jnp.int32(br) - jnp.int32((n_app - 1) * mr0)
+            val, dw = _mf_block_vals(mf, coeffs_ref, row0, win_v, col,
+                                     cdt)
 
         def apply_A(s):
             acc = jnp.zeros((win_v, LANES), cdt)
@@ -959,7 +1174,7 @@ def _dia_smooth_restrict_kernel(offsets, br, n_app, mr0, Mr0, win_x,
                     wa = pltpu.roll(a, jnp.int32(shift), 1)
                     wb = pltpu.roll(b2, jnp.int32(shift), 1)
                     w = jnp.where(col < shift, wa, wb)
-                acc = acc + vals[t].astype(cdt) * w
+                acc = acc + val(t) * w
             return acc
 
         s = xbuf[slot].astype(cdt)
@@ -967,7 +1182,7 @@ def _dia_smooth_restrict_kernel(offsets, br, n_app, mr0, Mr0, win_x,
             tau = taus_ref[t]
             mid = jax.lax.slice_in_dim(s, mr0, mr0 + win_v, 1, 0)
             corr = tau * (bw - apply_A(s))
-            if has_dinv:
+            if dw is not None:
                 corr = corr * dw
             pieces = [mid + corr, jnp.zeros((Mr0, LANES), cdt)]
             if mr0:
@@ -995,26 +1210,36 @@ def _dia_smooth_restrict_kernel(offsets, br, n_app, mr0, Mr0, win_x,
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "offsets", "num_rows", "interpret"))
+    "offsets", "num_rows", "mf", "interpret"))
 def _dia_smooth_restrict_call(vals_q, dinv_q, taus, b, x, xfer,
-                              offsets, num_rows, interpret=False):
+                              offsets, num_rows, mf=None, coeffs=None,
+                              interpret=False):
     """Fused presmoother + restriction epilogue: (x', bc) after
     len(taus) damped sweeps, with bc the segment-summed coarse rhs of
     the trailing residual. Caller must have checked
-    dia_restrict_supported."""
-    k = vals_q.shape[0]
+    dia_restrict_supported. Matrix-free form (`mf` + `coeffs`): no
+    vals/dinv slabs; the child-index windows (structure-only) stay."""
     n_steps = taus.shape[0]
     has_dinv = dinv_q is not None
     has_w = xfer.cwt is not None
-    dtype = vals_q.dtype
+    if mf is None:
+        k = vals_q.shape[0]
+        dtype = vals_q.dtype
+    else:
+        k = len(offsets)
+        dtype = x.dtype
     ib = jnp.dtype(dtype).itemsize
     plan = dia_restrict_plan(offsets, k, num_rows, n_steps, xfer.m,
                              xfer.windows, weighted=has_w,
-                             wavg=xfer.wavg, itemsize=ib)
+                             wavg=xfer.wavg, itemsize=ib,
+                             coeffs=mf is not None)
     br, n_app, mr0, Mr0, win_x, win_v, nb, cw = plan
-    qf, qc, qb = smooth_quota_rows(offsets, num_rows)
-    assert vals_q.shape[1] == qf + qc + qb
-    slab_shift = qf - (n_app - 1) * mr0
+    if mf is None:
+        qf, qc, qb = smooth_quota_rows(offsets, num_rows)
+        assert vals_q.shape[1] == qf + qc + qb
+        slab_shift = qf - (n_app - 1) * mr0
+    else:
+        slab_shift = 0
     n = num_rows
     cb = xfer.bases[br][0]
     xp_rows = n_app * mr0 + nb * br + n_app * Mr0
@@ -1031,22 +1256,34 @@ def _dia_smooth_restrict_call(vals_q, dinv_q, taus, b, x, xfer,
 
     kernel = _dia_smooth_restrict_kernel(
         offsets, br, n_app, mr0, Mr0, win_x, win_v, n_steps, has_dinv,
-        nb, slab_shift, xfer.m, cw, has_w, dtype)
-    n_sem = (4 if has_dinv else 3) + xfer.m * (2 if has_w else 1)
-    in_specs = [
-        pl.BlockSpec(memory_space=pl.ANY),          # xp
-        pl.BlockSpec(memory_space=pl.ANY),          # vals_q
-        pl.BlockSpec(memory_space=pl.ANY),          # bp
-    ]
-    operands = [xp, vals_q, bp]
-    if has_dinv:
-        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
-        operands.append(dinv_q)
-    in_specs.append(pl.BlockSpec(memory_space=pl.ANY))   # ctab
-    operands.append(xfer.ctab)
-    if has_w:
-        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))   # cwt
-        operands.append(xfer.cwt.astype(dtype))
+        nb, slab_shift, xfer.m, cw, has_w, dtype, mf=mf)
+    if mf is None:
+        n_sem = (4 if has_dinv else 3) + xfer.m * (2 if has_w else 1)
+        in_specs = [
+            pl.BlockSpec(memory_space=pl.ANY),          # xp
+            pl.BlockSpec(memory_space=pl.ANY),          # vals_q
+            pl.BlockSpec(memory_space=pl.ANY),          # bp
+        ]
+        operands = [xp, vals_q, bp]
+        if has_dinv:
+            in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+            operands.append(dinv_q)
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))   # ctab
+        operands.append(xfer.ctab)
+        if has_w:
+            in_specs.append(pl.BlockSpec(memory_space=pl.ANY))   # cwt
+            operands.append(xfer.cwt.astype(dtype))
+    else:
+        n_sem = 2 + xfer.m
+        in_specs = [
+            pl.BlockSpec(memory_space=pl.ANY),          # xp
+            pl.BlockSpec(memory_space=pl.ANY),          # bp
+            pl.BlockSpec(memory_space=pl.ANY),          # ctab
+            pl.BlockSpec((k,), lambda i: (jnp.int32(0),),
+                         memory_space=pltpu.SMEM),      # coeffs
+        ]
+        operands = [xp, bp, xfer.ctab,
+                    coeffs.astype(compute_dtype(dtype))]
     in_specs.append(pl.BlockSpec((nb,), lambda i: (jnp.int32(0),),
                                  memory_space=pltpu.SMEM))
     operands.append(cb.astype(jnp.int32))
@@ -1063,17 +1300,19 @@ def _dia_smooth_restrict_call(vals_q, dinv_q, taus, b, x, xfer,
         jax.ShapeDtypeStruct((nb * br, LANES), dtype),
         jax.ShapeDtypeStruct((nb * cw, LANES), dtype),
     )
-    scratch = [
-        pltpu.VMEM((2, win_x, LANES), dtype),
-        pltpu.VMEM((2, k, win_v, LANES), dtype),
-        pltpu.VMEM((2, win_v, LANES), dtype),
-    ]
+    scratch = [pltpu.VMEM((2, win_x, LANES), dtype)]
+    if mf is None:
+        scratch.append(pltpu.VMEM((2, k, win_v, LANES), dtype))
+    scratch.append(pltpu.VMEM((2, win_v, LANES), dtype))
     if has_dinv:
         scratch.append(pltpu.VMEM((2, win_v, LANES), dtype))
     scratch.append(pltpu.VMEM((2, xfer.m, cw, LANES), jnp.int32))
     if has_w:
         scratch.append(pltpu.VMEM((2, xfer.m, cw, LANES), dtype))
     scratch.append(pltpu.SemaphoreType.DMA((2, n_sem)))
+    nbytes = ((k + 2) * win_v + win_x
+              + (xfer.m * (2 if has_w else 1) + 1) * cw + br) \
+        if mf is None else (2 * win_v + win_x + (xfer.m + 1) * cw + br)
     y2, parts = pl.pallas_call(
         kernel,
         grid=(nb,),
@@ -1083,9 +1322,7 @@ def _dia_smooth_restrict_call(vals_q, dinv_q, taus, b, x, xfer,
         scratch_shapes=scratch,
         cost_estimate=pl.CostEstimate(
             flops=2 * n_app * k * nb * br * LANES,
-            bytes_accessed=((k + 2) * win_v + win_x
-                            + (xfer.m * (2 if has_w else 1) + 1) * cw
-                            + br) * nb * LANES * ib,
+            bytes_accessed=nbytes * nb * LANES * ib,
             transcendentals=0,
         ),
         interpret=interpret,
@@ -1108,10 +1345,19 @@ def _dia_smooth_restrict_call(vals_q, dinv_q, taus, b, x, xfer,
     return y, bcp[:xfer.nc]
 
 
+def _dia_stencil_smooth_restrict_call(coeffs, taus, b, x, xfer, spec,
+                                      interpret=False):
+    """Matrix-free fused presmoother + restriction epilogue. Caller
+    must have checked stencil_restrict_supported."""
+    return _dia_smooth_restrict_call(None, None, taus, b, x, xfer,
+                                     spec.offsets, spec.n, mf=spec,
+                                     coeffs=coeffs, interpret=interpret)
+
+
 def _dia_prolong_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x,
                                win_v, n_steps, has_dinv, n_blocks,
                                slab_shift, ashift, pcw, mp, has_w,
-                               dtype):
+                               dtype, mf=None):
     """Kernel body factory: the dia_smooth body with a prologue that
     folds the coarse correction in — the state window becomes
     x + P xc (gather of the block's coarse window through the
@@ -1132,27 +1378,41 @@ def _dia_prolong_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x,
         # refs: xp, vals_q, bp, [dinv_q], xcp, atab|ptab, [pwt], pcb,
         #       taus, out_x, xbuf, vbuf, bbuf, [dbuf], xcbuf, abuf,
         #       [wbuf], sems
-        xp_ref, vals_ref, bp_ref = refs[0], refs[1], refs[2]
-        off = 3
-        dinv_ref = refs[off] if has_dinv else None
-        off += 1 if has_dinv else 0
-        xcp_ref, atab_ref = refs[off], refs[off + 1]
-        off += 2
-        pwt_ref = refs[off] if has_w else None
-        off += 1 if has_w else 0
-        pcb_ref, taus_ref = refs[off], refs[off + 1]
-        off += 2
-        y_ref = refs[off]
-        off += 1
-        xbuf, vbuf, bbuf = refs[off], refs[off + 1], refs[off + 2]
-        off += 3
-        dbuf = refs[off] if has_dinv else None
-        off += 1 if has_dinv else 0
-        xcbuf, abuf = refs[off], refs[off + 1]
-        off += 2
-        wbuf = refs[off] if has_w else None
-        off += 1 if has_w else 0
-        sems = refs[off]
+        # mf:   xp, bp, xcp, atab, coeffs, pcb, taus, out_x,
+        #       xbuf, bbuf, xcbuf, abuf, sems
+        if mf is None:
+            xp_ref, vals_ref, bp_ref = refs[0], refs[1], refs[2]
+            coeffs_ref = None
+            off = 3
+            dinv_ref = refs[off] if has_dinv else None
+            off += 1 if has_dinv else 0
+            xcp_ref, atab_ref = refs[off], refs[off + 1]
+            off += 2
+            pwt_ref = refs[off] if has_w else None
+            off += 1 if has_w else 0
+            pcb_ref, taus_ref = refs[off], refs[off + 1]
+            off += 2
+            y_ref = refs[off]
+            off += 1
+            xbuf, vbuf, bbuf = refs[off], refs[off + 1], refs[off + 2]
+            off += 3
+            dbuf = refs[off] if has_dinv else None
+            off += 1 if has_dinv else 0
+            xcbuf, abuf = refs[off], refs[off + 1]
+            off += 2
+            wbuf = refs[off] if has_w else None
+            off += 1 if has_w else 0
+            sems = refs[off]
+        else:
+            xp_ref, bp_ref = refs[0], refs[1]
+            vals_ref = dinv_ref = pwt_ref = None
+            xcp_ref, atab_ref = refs[2], refs[3]
+            coeffs_ref, pcb_ref, taus_ref = refs[4], refs[5], refs[6]
+            y_ref = refs[7]
+            xbuf, bbuf = refs[8], refs[9]
+            vbuf = dbuf = wbuf = None
+            xcbuf, abuf = refs[10], refs[11]
+            sems = refs[12]
 
         i = pl.program_id(0)
         slot = jax.lax.rem(i, jnp.int32(2))
@@ -1165,14 +1425,15 @@ def _dia_prolong_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x,
                 pltpu.make_async_copy(xp_ref.at[pl.ds(base, win_x)],
                                       xbuf.at[jnp.int32(s)],
                                       sems.at[jnp.int32(s), 0]),
-                pltpu.make_async_copy(
-                    vals_ref.at[:, pl.ds(qbase, win_v)],
-                    vbuf.at[jnp.int32(s)], sems.at[jnp.int32(s), 1]),
-                pltpu.make_async_copy(bp_ref.at[pl.ds(base, win_v)],
-                                      bbuf.at[jnp.int32(s)],
-                                      sems.at[jnp.int32(s), 2]),
             ]
-            nsem = 3
+            if mf is None:
+                ops.append(pltpu.make_async_copy(
+                    vals_ref.at[:, pl.ds(qbase, win_v)],
+                    vbuf.at[jnp.int32(s)], sems.at[jnp.int32(s), 1]))
+            ops.append(pltpu.make_async_copy(
+                bp_ref.at[pl.ds(base, win_v)], bbuf.at[jnp.int32(s)],
+                sems.at[jnp.int32(s), 1 if mf is not None else 2]))
+            nsem = 2 if mf is not None else 3
             if has_dinv:
                 ops.append(pltpu.make_async_copy(
                     dinv_ref.at[pl.ds(qbase, win_v)],
@@ -1212,9 +1473,16 @@ def _dia_prolong_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x,
             d.wait()
 
         col = jax.lax.broadcasted_iota(jnp.int32, (win_v, LANES), 1)
-        vals = vbuf[slot]
         bw = bbuf[slot].astype(cdt)
-        dw = dbuf[slot].astype(cdt) if has_dinv else None
+        if mf is None:
+            vals = vbuf[slot]
+            def val(t):
+                return vals[t].astype(cdt)
+            dw = dbuf[slot].astype(cdt) if has_dinv else None
+        else:
+            row0 = i * jnp.int32(br) - jnp.int32((n_app - 1) * mr0)
+            val, dw = _mf_block_vals(mf, coeffs_ref, row0, win_v, col,
+                                     cdt)
 
         def apply_A(s):
             acc = jnp.zeros((win_v, LANES), cdt)
@@ -1229,7 +1497,7 @@ def _dia_prolong_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x,
                     wa = pltpu.roll(a, jnp.int32(shift), 1)
                     wb = pltpu.roll(b2, jnp.int32(shift), 1)
                     w = jnp.where(col < shift, wa, wb)
-                acc = acc + vals[t].astype(cdt) * w
+                acc = acc + val(t) * w
             return acc
 
         # prologue: s = x + P xc over the WHOLE x window (the sweeps
@@ -1254,7 +1522,7 @@ def _dia_prolong_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x,
             tau = taus_ref[t]
             mid = jax.lax.slice_in_dim(s, mr0, mr0 + win_v, 1, 0)
             corr = tau * (bw - apply_A(s))
-            if has_dinv:
+            if dw is not None:
                 corr = corr * dw
             pieces = [mid + corr, jnp.zeros((Mr0, LANES), cdt)]
             if mr0:
@@ -1267,25 +1535,35 @@ def _dia_prolong_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x,
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "offsets", "num_rows", "interpret"))
+    "offsets", "num_rows", "mf", "interpret"))
 def _dia_prolong_smooth_call(vals_q, dinv_q, taus, b, x, xc, xfer,
-                             offsets, num_rows, interpret=False):
+                             offsets, num_rows, mf=None, coeffs=None,
+                             interpret=False):
     """Fused prolongation/correction prologue + postsmoother:
     x' = smooth(b, x + P xc) after len(taus) damped sweeps. Caller
-    must have checked dia_prolong_supported."""
-    k = vals_q.shape[0]
+    must have checked dia_prolong_supported. Matrix-free form (`mf` +
+    `coeffs`): no vals/dinv slabs; the aggregate-id windows
+    (structure-only) stay."""
     n_steps = taus.shape[0]
     has_dinv = dinv_q is not None
     has_w = xfer.ptab is not None
-    dtype = vals_q.dtype
+    if mf is None:
+        k = vals_q.shape[0]
+        dtype = vals_q.dtype
+    else:
+        k = len(offsets)
+        dtype = x.dtype
     ib = jnp.dtype(dtype).itemsize
     plan = dia_prolong_plan(offsets, k, num_rows, n_steps, xfer.windows,
                             mp=xfer.mp, weighted=has_w, pavg=xfer.pavg,
-                            itemsize=ib)
+                            itemsize=ib, coeffs=mf is not None)
     br, n_app, mr0, Mr0, win_x, win_v, nb, pcw = plan
-    qf, qc, qb = smooth_quota_rows(offsets, num_rows)
-    assert vals_q.shape[1] == qf + qc + qb
-    slab_shift = qf - (n_app - 1) * mr0
+    if mf is None:
+        qf, qc, qb = smooth_quota_rows(offsets, num_rows)
+        assert vals_q.shape[1] == qf + qc + qb
+        slab_shift = qf - (n_app - 1) * mr0
+    else:
+        slab_shift = 0
     aqf, aqc, aqb = transfer_quota_rows(offsets, num_rows)
     id_slab = xfer.ptab if has_w else xfer.atab
     assert id_slab.shape[1 if has_w else 0] == aqf + aqc + aqb
@@ -1309,25 +1587,38 @@ def _dia_prolong_smooth_call(vals_q, dinv_q, taus, b, x, xc, xfer,
 
     kernel = _dia_prolong_smooth_kernel(
         offsets, br, n_app, mr0, Mr0, win_x, win_v, n_steps, has_dinv,
-        nb, slab_shift, ashift, pcw, xfer.mp, has_w, dtype)
-    n_sem = (4 if has_dinv else 3) + 1 \
-        + (2 * xfer.mp if has_w else 1)
-    in_specs = [
-        pl.BlockSpec(memory_space=pl.ANY),          # xp
-        pl.BlockSpec(memory_space=pl.ANY),          # vals_q
-        pl.BlockSpec(memory_space=pl.ANY),          # bp
-    ]
-    operands = [xp, vals_q, bp]
-    if has_dinv:
-        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
-        operands.append(dinv_q)
-    in_specs.append(pl.BlockSpec(memory_space=pl.ANY))   # xcp
-    operands.append(xcp)
-    in_specs.append(pl.BlockSpec(memory_space=pl.ANY))   # atab | ptab
-    operands.append(id_slab)
-    if has_w:
-        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))   # pwt
-        operands.append(xfer.pwt.astype(dtype))
+        nb, slab_shift, ashift, pcw, xfer.mp, has_w, dtype, mf=mf)
+    if mf is None:
+        n_sem = (4 if has_dinv else 3) + 1 \
+            + (2 * xfer.mp if has_w else 1)
+        in_specs = [
+            pl.BlockSpec(memory_space=pl.ANY),          # xp
+            pl.BlockSpec(memory_space=pl.ANY),          # vals_q
+            pl.BlockSpec(memory_space=pl.ANY),          # bp
+        ]
+        operands = [xp, vals_q, bp]
+        if has_dinv:
+            in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+            operands.append(dinv_q)
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))   # xcp
+        operands.append(xcp)
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))   # atab|ptab
+        operands.append(id_slab)
+        if has_w:
+            in_specs.append(pl.BlockSpec(memory_space=pl.ANY))   # pwt
+            operands.append(xfer.pwt.astype(dtype))
+    else:
+        n_sem = 4
+        in_specs = [
+            pl.BlockSpec(memory_space=pl.ANY),          # xp
+            pl.BlockSpec(memory_space=pl.ANY),          # bp
+            pl.BlockSpec(memory_space=pl.ANY),          # xcp
+            pl.BlockSpec(memory_space=pl.ANY),          # atab
+            pl.BlockSpec((k,), lambda i: (jnp.int32(0),),
+                         memory_space=pltpu.SMEM),      # coeffs
+        ]
+        operands = [xp, bp, xcp, id_slab,
+                    coeffs.astype(compute_dtype(dtype))]
     in_specs.append(pl.BlockSpec((nb,), lambda i: (jnp.int32(0),),
                                  memory_space=pltpu.SMEM))
     operands.append(pcb.astype(jnp.int32))
@@ -1337,11 +1628,10 @@ def _dia_prolong_smooth_call(vals_q, dinv_q, taus, b, x, xc, xfer,
     out_specs = pl.BlockSpec((br, LANES), lambda i: (i, jnp.int32(0)),
                              memory_space=pltpu.VMEM)
     out_shape = jax.ShapeDtypeStruct((nb * br, LANES), dtype)
-    scratch = [
-        pltpu.VMEM((2, win_x, LANES), dtype),
-        pltpu.VMEM((2, k, win_v, LANES), dtype),
-        pltpu.VMEM((2, win_v, LANES), dtype),
-    ]
+    scratch = [pltpu.VMEM((2, win_x, LANES), dtype)]
+    if mf is None:
+        scratch.append(pltpu.VMEM((2, k, win_v, LANES), dtype))
+    scratch.append(pltpu.VMEM((2, win_v, LANES), dtype))
     if has_dinv:
         scratch.append(pltpu.VMEM((2, win_v, LANES), dtype))
     scratch.append(pltpu.VMEM((2, pcw, LANES), dtype))
@@ -1352,6 +1642,9 @@ def _dia_prolong_smooth_call(vals_q, dinv_q, taus, b, x, xc, xfer,
     else:
         scratch.append(pltpu.VMEM((2, win_x, LANES), jnp.int32))
     scratch.append(pltpu.SemaphoreType.DMA((2, n_sem)))
+    nbytes = ((k + 2) * win_v + win_x + pcw + br
+              + (2 * xfer.mp if has_w else 1) * win_x) if mf is None \
+        else (2 * win_v + win_x + pcw + br + win_x)
     y2 = pl.pallas_call(
         kernel,
         grid=(nb,),
@@ -1361,9 +1654,7 @@ def _dia_prolong_smooth_call(vals_q, dinv_q, taus, b, x, xc, xfer,
         scratch_shapes=scratch,
         cost_estimate=pl.CostEstimate(
             flops=2 * n_app * k * nb * br * LANES,
-            bytes_accessed=((k + 2) * win_v + win_x + pcw + br
-                            + (2 * xfer.mp if has_w else 1) * win_x)
-            * nb * LANES * ib,
+            bytes_accessed=nbytes * nb * LANES * ib,
             transcendentals=0,
         ),
         interpret=interpret,
@@ -1374,15 +1665,30 @@ def _dia_prolong_smooth_call(vals_q, dinv_q, taus, b, x, xc, xfer,
     return y
 
 
+def _dia_stencil_prolong_smooth_call(coeffs, taus, b, x, xc, xfer,
+                                     spec, interpret=False):
+    """Matrix-free fused prolongation prologue + postsmoother. Caller
+    must have checked stencil_prolong_supported."""
+    return _dia_prolong_smooth_call(None, None, taus, b, x, xc, xfer,
+                                    spec.offsets, spec.n, mf=spec,
+                                    coeffs=coeffs, interpret=interpret)
+
+
 # ---------------------------------------------------------------------------
 # VMEM-resident coarse-tail sub-cycle
 # ---------------------------------------------------------------------------
 
 import collections
 
+# `mf` (default None) marks a matrix-free level: its arrs dict carries
+# a (k,) "coeffs" leaf instead of the "vals"/"dinv" slab slices, and
+# the per-offset value/dinv rows synthesize from the StencilSpec in
+# _tail_compute — shared by the Pallas tail kernel and the XLA
+# fallback exactly like the slab form.
 TailLevelSpec = collections.namedtuple(
     "TailLevelSpec",
-    "offsets n qc has_dinv n_pre n_post nc ncr m")
+    "offsets n qc has_dinv n_pre n_post nc ncr m mf",
+    defaults=(None,))
 TailSpec = collections.namedtuple("TailSpec", "shape levels coarse")
 # coarse: ("inv", nz, ncrz) — dense inverse matmul; ("none", nz, ncrz)
 # — NOSOLVER (no coarse correction)
@@ -1418,7 +1724,24 @@ def _tail_compute(arrs, b, x, spec):
     b = b.astype(cdt)
     x = x.astype(cdt)
 
-    def apply_dia(ls, ar, s):
+    def level_vals(ls, ar):
+        """(val(t), dinv | None): slab levels slice their VMEM-loaded
+        quota slabs; matrix-free levels synthesize both from the (k,)
+        coefficient leaf and ls.mf's static masks (tail vectors start
+        at element 0, so idx = row*128 + lane directly)."""
+        if ls.mf is None:
+            dw = ar["dinv"].astype(cdt) if ls.has_dinv else None
+            return (lambda t: ar["vals"][t].astype(cdt)), dw
+        col = jax.lax.broadcasted_iota(jnp.int32, (ls.qc, LANES), 1)
+        row = jax.lax.broadcasted_iota(jnp.int32, (ls.qc, LANES), 0)
+        idx = row * jnp.int32(LANES) + col
+        coords = _mf_coords(ls.mf.shape, idx)
+        valid = idx < jnp.int32(ls.mf.n)
+        return _mf_vals_dinv(ls.mf,
+                             lambda t: ar["coeffs"][t].astype(cdt),
+                             coords, valid, cdt)
+
+    def apply_dia(ls, val, s):
         mr0, Mr0 = smooth_halo_rows(ls.offsets)
         sp = jnp.pad(s, ((mr0, Mr0), (0, 0)))
         col = jax.lax.broadcasted_iota(jnp.int32, (ls.qc, LANES), 1)
@@ -1435,21 +1758,22 @@ def _tail_compute(arrs, b, x, spec):
                 shift = LANES - rl
                 w = jnp.where(col < shift, jnp.roll(a, shift, 1),
                               jnp.roll(b2, shift, 1))
-            acc = acc + ar["vals"][t].astype(cdt) * w
+            acc = acc + val(t) * w
         return acc
 
-    def sweeps(ls, ar, bc, s, taus, n_taus):
+    def sweeps(ls, val, dw, bc, s, taus, n_taus):
         for t in range(n_taus):
-            corr = taus[t].astype(cdt) * (bc - apply_dia(ls, ar, s))
-            if ls.has_dinv:
-                corr = corr * ar["dinv"].astype(cdt)
+            corr = taus[t].astype(cdt) * (bc - apply_dia(ls, val, s))
+            if dw is not None:
+                corr = corr * dw
             s = s + corr
         return s
 
     def run(shape, i, bc, s):
         ls, ar = levels[i], arrs[i]
-        s = sweeps(ls, ar, bc, s, ar["taus_pre"], ls.n_pre)
-        r = bc - apply_dia(ls, ar, s)
+        val, dw = level_vals(ls, ar)
+        s = sweeps(ls, val, dw, bc, s, ar["taus_pre"], ls.n_pre)
+        r = bc - apply_dia(ls, val, s)
         rflat = r.reshape(-1)
         coarse_b = jnp.zeros((ls.ncr, LANES), cdt)
         for j in range(ls.m):
@@ -1482,7 +1806,7 @@ def _tail_compute(arrs, b, x, spec):
         valid = aw >= 0
         corr = jnp.take(xcflat, jnp.where(valid, aw, 0))
         s = s + jnp.where(valid, corr, jnp.zeros((), cdt))
-        s = sweeps(ls, ar, bc, s, ar["taus_post"], ls.n_post)
+        s = sweeps(ls, val, dw, bc, s, ar["taus_post"], ls.n_post)
         return s
 
     return run(spec.shape, 0, b, x)

@@ -919,8 +919,10 @@ def coarse_tail_cycle(amg, shape: str, data, lvl: int, b, x):
             # unit-weight — those levels keep per-level kernels
             return None
         fused = smd.get("fused")
+        mfst = smd.get("stencil")
         A = ld["A"]
-        if fused is None or not _ps.smooth_dtype_ok(A, x.dtype):
+        if mfst is None and (fused is None
+                             or not _ps.smooth_dtype_ok(A, x.dtype)):
             return None
         spec_fn = getattr(lv.smoother, "fused_tail_spec", None)
         if spec_fn is None:
@@ -937,14 +939,26 @@ def coarse_tail_cycle(amg, shape: str, data, lvl: int, b, x):
         qf, qc, _ = _ps.smooth_quota_rows(offsets, A.num_rows)
         aqf = _ps.transfer_quota_rows(offsets, A.num_rows)[0]
         ar = {
-            "vals": jax.lax.slice_in_dim(fused["vals_q"], qf, qf + qc,
-                                         1, 1),
             "taus_pre": taus_pre,
             "taus_post": taus_post,
             "ctab": xfer.ctab,
             "atab_c": jax.lax.slice_in_dim(xfer.atab, aqf, aqf + qc,
                                            1, 0),
         }
+        if mfst is not None:
+            # matrix-free level: k coefficients instead of the value
+            # slab; dinv is synthesized in-kernel from the stencil
+            ar["coeffs"] = mfst.coeffs.astype(cdt)
+            specs.append(_ps.TailLevelSpec(
+                offsets=tuple(int(o) for o in offsets), n=A.num_rows,
+                qc=qc, has_dinv=False, n_pre=n_pre, n_post=n_post,
+                nc=xfer.nc, ncr=xfer.ncr, m=xfer.m, mf=mfst.spec()))
+            total += sum(v.size * v.dtype.itemsize
+                         for v in jax.tree_util.tree_leaves(ar))
+            arrs.append(ar)
+            continue
+        ar["vals"] = jax.lax.slice_in_dim(fused["vals_q"], qf, qf + qc,
+                                          1, 1)
         if dinv is not None:
             if "dinv_q" not in fused:
                 return None

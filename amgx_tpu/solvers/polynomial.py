@@ -196,6 +196,11 @@ class ChebyshevPolySolver(Solver):
     x += tau_i (b - A x)."""
 
     is_smoother = True
+    # matrix-free capable (amg/hierarchy.py `matrix_free` knob): the
+    # damped-Richardson steps need only the stencil coefficients; no
+    # diagonal inverse is synthesized (dinv-free schedule)
+    supports_matrix_free = True
+    matrix_free_dinv = None
 
     def __init__(self, cfg, scope="default", name="CHEBYSHEV_POLY"):
         super().__init__(cfg, scope, name)
@@ -217,6 +222,15 @@ class ChebyshevPolySolver(Solver):
     def solve_data(self):
         d = super().solve_data()
         d["taus"] = self._taus
+        st = getattr(self, "_mf_stencil", None)
+        if st is not None:
+            # matrix-free level: drop the A value slab from the
+            # operator view; no fused slabs — the kernels read the
+            # stencil coefficients from SMEM (ops/stencil.py)
+            from ..ops.stencil import mf_slim
+            d["A"] = mf_slim(d["A"])
+            d["stencil"] = st
+            return d
         if self.fused_smoother and self.A is not None \
                 and not getattr(self.A, "is_block", True):
             from ..ops import smooth as fused
@@ -247,6 +261,14 @@ class ChebyshevPolySolver(Solver):
         return jnp.tile(taus, sweeps) if sweeps > 1 else taus
 
     def smooth(self, data, b, x, sweeps: int):
+        st = data.get("stencil")
+        if st is not None:
+            if sweeps < 1:
+                return x
+            from ..ops import stencil as mf
+            return mf.stencil_fused_smooth(
+                st, self._fused_taus(data, sweeps, x.dtype), b, x,
+                with_residual=False)
         if sweeps > 0 and self.fused_smoother:
             from ..ops import smooth as fused
             out = fused.fused_smooth(
@@ -257,6 +279,13 @@ class ChebyshevPolySolver(Solver):
         return super().smooth(data, b, x, sweeps)
 
     def smooth_residual(self, data, b, x, sweeps: int):
+        st = data.get("stencil")
+        if st is not None:
+            from ..ops import stencil as mf
+            taus = (self._fused_taus(data, sweeps, x.dtype)
+                    if sweeps > 0 else jnp.zeros((0,), x.dtype))
+            return mf.stencil_fused_smooth(st, taus, b, x,
+                                           with_residual=True)
         if sweeps > 0 and self.fused_smoother:
             from ..ops import smooth as fused
             out = fused.fused_smooth(
@@ -268,7 +297,15 @@ class ChebyshevPolySolver(Solver):
 
     # -- cycle fusion (AMGLevel.restrict_fused / prolongate_smooth) ----
     def smooth_restrict(self, data, b, x, sweeps: int, xfer):
-        if sweeps > 0 and self.fused_smoother:
+        if sweeps < 1:
+            return None
+        st = data.get("stencil")
+        if st is not None:
+            from ..ops import stencil as mf
+            return mf.stencil_smooth_restrict(
+                st, self._fused_taus(data, sweeps, x.dtype), b, x,
+                xfer)
+        if self.fused_smoother:
             from ..ops import smooth as fused
             return fused.fused_smooth_restrict(
                 data, b, x, self._fused_taus(data, sweeps, x.dtype),
@@ -276,7 +313,15 @@ class ChebyshevPolySolver(Solver):
         return None
 
     def smooth_corr(self, data, b, x, xc, sweeps: int, xfer):
-        if sweeps > 0 and self.fused_smoother:
+        if sweeps < 1:
+            return None
+        st = data.get("stencil")
+        if st is not None:
+            from ..ops import stencil as mf
+            return mf.stencil_corr_smooth(
+                st, self._fused_taus(data, sweeps, x.dtype), b, x, xc,
+                xfer)
+        if self.fused_smoother:
             from ..ops import smooth as fused
             return fused.fused_corr_smooth(
                 data, b, x, xc, self._fused_taus(data, sweeps, x.dtype),

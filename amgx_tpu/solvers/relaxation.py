@@ -26,7 +26,22 @@ class _FusedJacobiMixin:
     cycle residual run through the single-pass kernels of ops/smooth.py
     when the level layout supports them. `fused_smoother=0` (or any
     unsupported layout/backend) falls back to the base implementations
-    unchanged — bit-for-bit the pre-fusion computation."""
+    unchanged — bit-for-bit the pre-fusion computation.
+
+    Matrix-free levels: when the hierarchy's constant-coefficient
+    detector installed a StencilOperator on this smoother
+    (`_mf_stencil`, amg/hierarchy.py `matrix_free` knob), solve_data
+    carries the stencil INSTEAD of the dinv vector and fused slabs —
+    the A value slab and the dinv stream vanish from the level's HBM
+    footprint — and every smooth entry routes through the coefficient
+    forms in ops/stencil.py (which synthesize dinv in-register from
+    the diagonal coefficient)."""
+
+    # consulted by AMG._maybe_install_stencil: this smoother family's
+    # sweeps are expressible from stencil coefficients alone, with the
+    # diagonal inverse synthesized per `matrix_free_dinv`
+    supports_matrix_free = True
+    matrix_free_dinv = "jacobi"
 
     def _fused_eligible(self, data):
         A = data["A"]
@@ -39,6 +54,15 @@ class _FusedJacobiMixin:
 
     def solve_data(self):
         d = super().solve_data()
+        st = getattr(self, "_mf_stencil", None)
+        if st is not None:
+            # matrix-free level: the stencil payload replaces BOTH the
+            # dinv vector and the fused value slabs; the operator view
+            # drops its value slab entirely (O(levels) memory)
+            from ..ops.stencil import mf_slim
+            d["A"] = mf_slim(d["A"])
+            d["stencil"] = st
+            return d
         d["dinv"] = self._dinv
         if self.fused_smoother and self.A is not None \
                 and not getattr(self.A, "is_block", True):
@@ -49,6 +73,14 @@ class _FusedJacobiMixin:
         return d
 
     def smooth(self, data, b, x, sweeps: int):
+        st = data.get("stencil")
+        if st is not None:
+            if sweeps < 1:
+                return x
+            from ..ops import stencil as mf
+            return mf.stencil_fused_smooth(
+                st, self._fused_taus(sweeps, x.dtype), b, x,
+                with_residual=False)
         if sweeps > 0 and self._fused_eligible(data):
             out = fused.fused_smooth(
                 data, b, x, self._fused_taus(sweeps, x.dtype),
@@ -58,6 +90,12 @@ class _FusedJacobiMixin:
         return super().smooth(data, b, x, sweeps)
 
     def smooth_residual(self, data, b, x, sweeps: int):
+        st = data.get("stencil")
+        if st is not None:
+            from ..ops import stencil as mf
+            return mf.stencil_fused_smooth(
+                st, self._fused_taus(max(sweeps, 0), x.dtype), b, x,
+                with_residual=True)
         if sweeps > 0 and self._fused_eligible(data):
             out = fused.fused_smooth(
                 data, b, x, self._fused_taus(sweeps, x.dtype),
@@ -70,7 +108,14 @@ class _FusedJacobiMixin:
     def smooth_restrict(self, data, b, x, sweeps: int, xfer):
         """(x', bc) with the restriction riding the presmoother
         kernel's epilogue, or None (caller composes unfused)."""
-        if sweeps > 0 and self._fused_eligible(data):
+        if sweeps < 1:
+            return None
+        st = data.get("stencil")
+        if st is not None:
+            from ..ops import stencil as mf
+            return mf.stencil_smooth_restrict(
+                st, self._fused_taus(sweeps, x.dtype), b, x, xfer)
+        if self._fused_eligible(data):
             return fused.fused_smooth_restrict(
                 data, b, x, self._fused_taus(sweeps, x.dtype), xfer,
                 dinv=data["dinv"])
@@ -79,7 +124,14 @@ class _FusedJacobiMixin:
     def smooth_corr(self, data, b, x, xc, sweeps: int, xfer):
         """smooth(b, x + P xc) with the correction folded into the
         first kernel application, or None."""
-        if sweeps > 0 and self._fused_eligible(data):
+        if sweeps < 1:
+            return None
+        st = data.get("stencil")
+        if st is not None:
+            from ..ops import stencil as mf
+            return mf.stencil_corr_smooth(
+                st, self._fused_taus(sweeps, x.dtype), b, x, xc, xfer)
+        if self._fused_eligible(data):
             return fused.fused_corr_smooth(
                 data, b, x, xc, self._fused_taus(sweeps, x.dtype),
                 xfer, dinv=data["dinv"])
@@ -87,9 +139,15 @@ class _FusedJacobiMixin:
 
     def fused_tail_spec(self, data, sweeps: int, dtype):
         """(taus, dinv) schedule for the VMEM-resident coarse-tail
-        kernel, or None when this smoother cannot ride it."""
+        kernel, or None when this smoother cannot ride it. Matrix-free
+        levels return dinv=None — the tail kernel synthesizes the
+        diagonal inverse from the level's stencil coefficients."""
         if not self.fused_smoother or getattr(
-                data["A"], "is_block", True) or "dinv" not in data:
+                data["A"], "is_block", True):
+            return None
+        if "stencil" in data:
+            return self._fused_taus(max(sweeps, 0), dtype), None
+        if "dinv" not in data:
             return None
         return self._fused_taus(max(sweeps, 0), dtype), data["dinv"]
 
@@ -188,6 +246,7 @@ class JacobiL1Solver(_FusedJacobiMixin, Solver):
     (jacobi_l1_solver.cu analog)."""
 
     is_smoother = True
+    matrix_free_dinv = "l1"
 
     def __init__(self, cfg, scope="default", name="JACOBI_L1"):
         super().__init__(cfg, scope, name)

@@ -743,6 +743,83 @@ def bench_spgemm_plan(flagship_n: int = 128, classical_n: int = 64,
     return out
 
 
+def bench_matfree(n: int = 128, reps: int = 3, smoke: bool = False):
+    """Matrix-free GEO phase (`python bench.py matfree [--smoke]`):
+    paired replay of the SAME solve with `matrix_free=1` (constant-
+    coefficient levels route through ops/stencil.py — SMEM-coefficient
+    Pallas kernels on TPU, the XLA masked-coefficient compose on this
+    rig) against the `matrix_free=0` slab build. Two sentinel-tracked
+    numbers: `matrix_free_cycle_speedup` (warm per-cycle wall, slab
+    over matrix-free — higher is better) and
+    `matrix_free_level_bytes_ratio` (summed per-level operator
+    solve-data bytes, matrix-free over slab — lower is better; the
+    fine slab alone is ~7/8 of a 7-pt level's operator stream). Both
+    twins must converge in the SAME iteration count — the routing is a
+    numerics-preserving form change, so any drift fails the phase."""
+    from amgx_tpu.serving.cache import solve_data_bytes
+    cfg_s = (
+        "solver=FGMRES, max_iters=30, monitor_residual=1,"
+        " tolerance=1e-8, gmres_n_restart=20,"
+        " convergence=RELATIVE_INI, norm=L2,"
+        " preconditioner(amg)=AMG, amg:algorithm=AGGREGATION,"
+        " amg:selector=GEO, amg:smoother=JACOBI_L1,"
+        " amg:relaxation_factor=0.75, amg:presweeps=1,"
+        " amg:postsweeps=2, amg:max_iters=1, amg:cycle=V,"
+        " amg:max_levels=10, amg:min_coarse_rows=32,"
+        " amg:matrix_free=")
+    A = amgx.gallery.poisson("7pt", n, n, n, dtype=np.float32).init()
+    b = jnp.ones(A.num_rows, jnp.float32)
+    out = {"grid": f"{n}^3 poisson7pt", "smoke": bool(smoke)}
+    walls, iters, lv_bytes = {}, {}, {}
+    for mf in ("0", "1"):
+        slv = amgx.create_solver(Config.from_string(cfg_s + mf))
+        slv.setup(A)
+        res = slv.solve(b)                  # compile + warm caches
+        iters[mf] = max(int(res.iterations), 1)
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(slv.solve(b).x)
+            best = min(best, time.perf_counter() - t0)
+        walls[mf] = best
+        eng = slv
+        while not hasattr(eng, "amg"):
+            eng = eng.preconditioner
+        per = []
+        for ld in eng.amg.solve_data()["levels"]:
+            smd = ld.get("smoother") or {}
+            per.append({
+                "rows": int(ld["A"].num_rows),
+                "form": "matrix-free" if "stencil" in ld else "slab",
+                "operator_bytes": solve_data_bytes(
+                    {"A": ld["A"], "stencil": ld.get("stencil"),
+                     "dinv": smd.get("dinv")
+                     if isinstance(smd, dict) else None}),
+            })
+        lv_bytes[mf] = per
+        out[f"mf{mf}"] = {
+            "solve_warm_s": round(best, 4),
+            "iters": iters[mf],
+            "cycle_warm_s": round(best / iters[mf], 5),
+            "levels": per,
+        }
+        del slv
+    assert iters["0"] == iters["1"], (
+        f"matrix-free changed convergence: {iters}")
+    tot0 = sum(p["operator_bytes"] for p in lv_bytes["0"])
+    tot1 = sum(p["operator_bytes"] for p in lv_bytes["1"])
+    out["matrix_free_cycle_speedup"] = round(
+        walls["0"] / max(walls["1"], 1e-9), 3)
+    # 6 decimals: a fully matrix-free hierarchy sits at ~2e-6, which
+    # must stay a nonzero "best" for the regression sentinel's
+    # relative-tolerance compare
+    out["matrix_free_level_bytes_ratio"] = round(
+        tot1 / max(tot0, 1), 6)
+    out["slab_operator_bytes"] = int(tot0)
+    out["matrix_free_operator_bytes"] = int(tot1)
+    return out
+
+
 def bench_classical(n: int = 64):
     """PCG[f64] + classical PMIS/D2 AMG[f32] (JACOBI_L1) — the
     unstructured-path number the structured flagship does not cover.
@@ -2492,6 +2569,41 @@ if __name__ == "__main__":
             "unit": "x",
             "vs_baseline": 0.0,
             "artifact": "BENCH_spgemm.json",
+            "extra": {k: v for k, v in res.items()
+                      if not isinstance(v, (dict, list))},
+        }), flush=True)
+    elif sys.argv[1:2] == ["matfree"]:
+        # standalone matrix-free phase: `python bench.py matfree`
+        # (full: 128^3 paired replay) or `--smoke` (16^3, the tier-1
+        # functional check — must exit 0)
+        amgx.initialize()
+        smoke = "--smoke" in sys.argv[2:]
+        res = bench_matfree(n=16 if smoke else 128,
+                            reps=1 if smoke else 3, smoke=smoke)
+        res["round"] = _round_stamp()
+        res["extra"] = {
+            "matrix_free_cycle_speedup":
+                res["matrix_free_cycle_speedup"],
+            "matrix_free_level_bytes_ratio":
+                res["matrix_free_level_bytes_ratio"],
+        }
+        try:
+            import os
+            art = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BENCH_matfree.json")
+            with open(art, "w") as f:
+                json.dump(res, f, indent=1)
+                f.write("\n")
+        except Exception as e:  # pragma: no cover - bench robustness
+            res["artifact_error"] = str(e)[:120]
+        print(json.dumps({
+            "metric": "matrix-free vs slab warm cycle speedup "
+                      "(paired replay, GEO)",
+            "value": res["matrix_free_cycle_speedup"],
+            "unit": "x",
+            "vs_baseline": 0.0,
+            "artifact": "BENCH_matfree.json",
             "extra": {k: v for k, v in res.items()
                       if not isinstance(v, (dict, list))},
         }), flush=True)

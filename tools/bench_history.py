@@ -161,6 +161,14 @@ SERIES: Tuple[Tuple[str, str, float, str], ...] = (
      "(ms) — must stay within the deadline budget"),
     ("mc_dist_fused_speedup", "higher", 0.25,
      "distributed fused-vs-unfused cycle speedup (MULTICHIP)"),
+    ("matrix_free_cycle_speedup", "higher", 0.25,
+     "matrix-free vs slab warm V-cycle speedup (GEO 128^3 paired "
+     "replay, bench.py matfree — constant-coefficient levels drop "
+     "the DIA value-slab operand)"),
+    ("matrix_free_level_bytes_ratio", "lower", 0.25,
+     "summed per-level operator solve-data bytes, matrix-free over "
+     "slab build (bench.py matfree; lower = more of the hierarchy "
+     "serves from O(k) stencil coefficients)"),
 )
 
 _NUM = r"(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)"
@@ -249,7 +257,8 @@ def load_round(path: str, kind: str) -> Optional[Dict[str, Any]]:
 # `extra` dict of series-named scalars, contributing them to the
 # round even when no BENCH_r<NN>.json wrapper did
 PHASE_ARTIFACTS: Tuple[str, ...] = ("BENCH_serving.json",
-                                    "BENCH_fleet.json")
+                                    "BENCH_fleet.json",
+                                    "BENCH_matfree.json")
 
 
 def load_phase_artifact(path: str) -> Optional[Dict[str, Any]]:
