@@ -957,6 +957,20 @@ def AMGX_service_stats(svc_h):
     return RC.OK, svc.service.stats()
 
 
+@_api
+@_outputs(1)
+def AMGX_service_autotune(svc_h):
+    """rc, the online tuner's live state ({'enabled': False} with
+    autotune=0): per-fingerprint search phase, remaining shadow
+    budget, the promoted overlay (knob + deltas) and whether it was
+    restored from the hstore — the operator's view of WHAT config a
+    fingerprint serves and why (the decision trail itself is on the
+    flight recorder under the search's trace id)."""
+    svc = _get(svc_h, _CService)
+    t = svc.service._tuner
+    return RC.OK, ({"enabled": False} if t is None else t.snapshot())
+
+
 # ---------------------------------------------------------------------------
 # fleet API (amgx_tpu/serving/fleet.py): N service replicas behind one
 # fingerprint-affine submit/step/drain surface — the scale-out layer

@@ -531,6 +531,46 @@ def _register_default_parameters():
       "waste for singleton patterns and queue latency for bursts. "
       "Each rung keeps its own AOT executable (slots is part of the "
       "AOT key). '' = fixed width", "")
+    # online config autotuner (serving/autotune.py): shadow-solve
+    # search over diagnostics-suggested config deltas, per hot
+    # fingerprint. All autotune* knobs are service-layer only — they
+    # can never influence coarsening, so (like serving_*) they are
+    # excluded from the hstore config signature
+    R("autotune", int, "online per-fingerprint config autotuner: "
+      "watch hot fingerprints, generate candidate config deltas from "
+      "the diagnostics probe, SHADOW-solve them on idle bucket "
+      "capacity against the journaled workload sample, and promote a "
+      "measured iterations x wall win as that fingerprint's serving "
+      "config overlay (persisted in the hstore; demoted on live "
+      "regression). 0 (default) is bitwise inert: no tuner object, no "
+      "overlay lookup, no shadow work — trace/jaxpr parity with a "
+      "pre-autotune build", 0, BOOL01)
+    R("autotune_hot_requests", int, "hotness threshold: completed "
+      "requests a fingerprint needs before the tuner considers it "
+      "(with autotune_hot_exec_share) worth a shadow search", 8,
+      None, 1)
+    R("autotune_hot_exec_share", float, "hotness threshold: minimum "
+      "share of this service's total in-bucket execution seconds a "
+      "fingerprint must account for — a rare-but-slow or "
+      "frequent-and-slow pattern qualifies, background noise never "
+      "does", 0.1, None, 0.0, 1.0)
+    R("autotune_shadow_budget", int, "bounded search: max shadow "
+      "solves (baseline probe included) the tuner may spend per "
+      "fingerprint, ever — the search can never consume unbounded "
+      "idle capacity", 6, None, 1)
+    R("autotune_min_improvement", float, "promotion hysteresis: a "
+      "candidate's measured iterations x wall score must beat the "
+      "shadow baseline by at least this factor (and win iterations "
+      "AND wall outright) before its deltas promote to the serving "
+      "overlay", 1.2, None, 1.0)
+    R("autotune_demote_factor", float, "regression hysteresis: a "
+      "promoted fingerprint whose live exec median exceeds its "
+      "pre-promotion median by this factor (over "
+      "autotune_demote_window completions) is demoted — overlay "
+      "dropped, persisted record deleted, bucket retired", 1.5,
+      None, 1.0)
+    R("autotune_demote_window", int, "post-promotion completions the "
+      "demote watch needs before judging a regression", 4, None, 2)
     # fleet router (serving/fleet.py): N replicas behind one
     # fingerprint-affine submit/step/drain surface
     R("fleet_replicas", int, "replica count FleetRouter.build (and "

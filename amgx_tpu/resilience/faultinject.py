@@ -84,6 +84,7 @@ from typing import Optional
 KINDS = ("spmv_nan", "halo_corrupt", "galerkin_perturb",
          # service-level (host-side) chaos kinds — serving/
          "build_crash", "step_crash", "step_wedge",
+         "shadow_crash",
          "journal_corrupt", "aot_corrupt", "clock_skew",
          # fleet-level chaos kinds (whole-replica faults) — serving/
          # fleet.py + serving/health.py failover drills

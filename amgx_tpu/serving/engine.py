@@ -140,6 +140,32 @@ class BucketEngine:
             for ax, leaf in zip(self._axes_flat, d1_flat)]
         self._snap_A: Optional[CsrMatrix] = probe
         self._snap_flat = d1_flat
+        # fused admit scatter: splicing a system into its slot touches
+        # every per-slot value leaf (a deep hierarchy has ~100) plus
+        # the rhs row and each solve-state leaf — issued eagerly
+        # that's ~150 one-row scatter dispatches per admission, and at
+        # small grids the dispatch overhead DOMINATES the request's
+        # in-bucket wall. These two programs do all of it in two
+        # calls, with the old buffers donated (in-place rows, no
+        # slab copy). They are deliberately NOT _counted and NOT in
+        # the AOT bundle: trace_count/serving.retrace keep meaning
+        # "solve-program traces" (the zero-retrace restart contract),
+        # while these host-side helpers trace once per bucket build
+        # in microseconds-to-milliseconds
+        self._ps_idx = [i for i, ax in enumerate(self._axes_flat)
+                        if ax == 0]
+
+        def splice_rows(leaves, B, snap, b, slot):
+            return ([lf.at[slot].set(s)
+                     for lf, s in zip(leaves, snap)],
+                    B.at[slot].set(b))
+
+        def scatter_state(st, row, slot):
+            return {k: st[k].at[slot].set(row[k]) for k in st}
+
+        self._splice_jit = jax.jit(splice_rows, donate_argnums=(0, 1))
+        self._scatter_state = jax.jit(scatter_state,
+                                      donate_argnums=(0,))
 
     def _data_tree(self):
         return jax.tree.unflatten(self._data_treedef, self._data_flat)
@@ -310,18 +336,21 @@ class BucketEngine:
 
     def _splice_slot(self, slot: int, A: CsrMatrix, b):
         """Shared admit prologue: value-resetup snapshot spliced into
-        the per-slot data rows + the rhs scatter."""
+        the per-slot data rows + the rhs scatter — one fused donated
+        program for all per-slot leaves (slot is traced, so one trace
+        serves every slot)."""
         snap = self._snapshot_for(A)
-        for i, ax in enumerate(self._axes_flat):
-            if ax == 0:
-                self._data_flat[i] = \
-                    self._data_flat[i].at[slot].set(snap[i])
         b = jnp.asarray(b, self.dtype)
         if b.shape != (self.n,):
             raise BadParametersError(
                 f"serving: rhs shape {b.shape} does not fit the "
                 f"bucket's ({self.n},) systems")
-        self._B = self._B.at[slot].set(b)
+        rows, self._B = self._splice_jit(
+            [self._data_flat[i] for i in self._ps_idx], self._B,
+            [snap[i] for i in self._ps_idx], b,
+            jnp.asarray(slot, jnp.int32))
+        for i, leaf in zip(self._ps_idx, rows):
+            self._data_flat[i] = leaf
         return snap, b
 
     def _check_reserved(self, slot: int, occupant: Any):
@@ -362,9 +391,8 @@ class BucketEngine:
                 else jnp.asarray(x0, self.dtype)
             row = self._init1(
                 jax.tree.unflatten(self._data_treedef, snap), b, x0)
-            self._state = {
-                k: self._state[k].at[slot].set(row[k])
-                for k in self._state}
+            self._state = self._scatter_state(
+                self._state, dict(row), jnp.asarray(slot, jnp.int32))
         self.occupant[slot] = occupant
 
     def admit_resume(self, slot: int, A: CsrMatrix, b, state_row,
@@ -394,9 +422,11 @@ class BucketEngine:
                         f"serving: checkpointed state leaf {k!r} has "
                         f"{v.shape}/{v.dtype}, bucket expects "
                         f"{ref.shape[1:]}/{ref.dtype}")
-            self._state = {
-                k: self._state[k].at[slot].set(jnp.asarray(v))
-                for k, v in state_row.items()}
+            self._state = self._scatter_state(
+                self._state,
+                {k: jnp.asarray(v, self._state[k].dtype)
+                 for k, v in state_row.items()},
+                jnp.asarray(slot, jnp.int32))
         self.occupant[slot] = occupant
 
     def state_rows(self, slots: List[int]) -> Dict[int, Dict[str, Any]]:

@@ -681,6 +681,10 @@ class FleetRouter:
             _fr.record("fleet.adopt", from_replica=rid,
                        to_replica=adopter, replayed=adopted,
                        skipped=len(skipids))
+        # the victim's tuned-config overlays ride along with the
+        # journal: the fingerprints rehome to survivors, and a
+        # survivor rebuilding one must rebuild it TUNED
+        self._handoff_tuned(rid, surv)
         wall_ms = round((time.monotonic() - t0) * 1e3, 3)
         _fr.record("fleet.failover", replica=rid, event=event,
                    survivors=len(surv), queued=len(queued),
@@ -753,19 +757,55 @@ class FleetRouter:
                             for trid, ts in per.items()})
         return moved
 
+    def _handoff_tuned(self, rid: str, surv: List[str]) -> int:
+        """Hand the victim replica's promoted tuned-config overlays to
+        the survivors its fingerprints rehome to (rendezvous order —
+        the same replica the next request for that fingerprint routes
+        to). Adoption installs the overlay live AND persists it in the
+        adopter's own hstore, so the tuned config survives the
+        adopter's restarts too. Best-effort: a replica without a tuner
+        (autotune=0) exports/adopts nothing."""
+        tuner = self.replicas[rid]._tuner
+        if tuner is None or not surv:
+            return 0
+        survset = set(surv)
+        handed = 0
+        for fp, state in tuner.export_promoted().items():
+            order = sorted(
+                self.replicas,
+                key=lambda r: _rendezvous_score(fp, r), reverse=True)
+            target = next((r for r in order if r in survset), surv[0])
+            tsvc = self.replicas[target]
+            if tsvc._tuner is None:
+                continue
+            tsvc._tuner.adopt(fp, state)
+            handed += 1
+            _tm.inc("autotune.handoffs")
+            _fr.record("fleet.tuned_handoff", from_replica=rid,
+                       to_replica=target, fingerprint=fp[:24],
+                       knob=state.get("knob"))
+        return handed
+
     def drain_replica(self, rid: str) -> int:
         """Rolling-restart entry: stop NEW placements on `rid`, hand
         its queued tickets to survivors, let in-flight work finish in
         place (or hand off via the journal if the process is killed
-        anyway — the DOWN path covers that). Returns the number of
-        queued tickets handed off. The replica keeps serving its
+        anyway — the DOWN path covers that). The replica's promoted
+        tuned-config overlays hand off with the queue, so a rehomed
+        fingerprint rebuilds TUNED on its adopter. Returns the number
+        of queued tickets handed off. The replica keeps serving its
         slots; wait for `replicas[rid].idle` (or fleet drain) before
         actually restarting it."""
         if rid not in self.replicas:
             raise BadParametersError(
                 f"drain_replica: unknown replica {rid!r}")
         self.health.drain(rid)
-        return len(self._rescue_queue(rid))
+        moved = len(self._rescue_queue(rid))
+        now_m = time.monotonic()
+        surv = [r for r in self.replicas
+                if r != rid and self._healthy(r, now_m)]
+        self._handoff_tuned(rid, surv)
+        return moved
 
     def restore_replica(self, rid: str):
         """Re-enter `rid` into the rendezvous: breaker reset, error

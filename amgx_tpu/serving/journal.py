@@ -85,6 +85,10 @@ class SolveJournal:
         return os.path.join(self.directory,
                             f"pattern-{_fp_digest(fingerprint)}.npz")
 
+    def _wpath(self, fingerprint: str) -> str:
+        return os.path.join(self.directory,
+                            f"workload-{_fp_digest(fingerprint)}.npz")
+
     def _write_npz(self, path: str, arrays: Dict[str, np.ndarray]):
         """Atomic npz write, through the chaos corruption hook (the
         torn-write drill: damage lands on disk, detection is the
@@ -194,6 +198,61 @@ class SolveJournal:
                 os.remove(self._jpath(jid, ext))
             except OSError:
                 pass
+
+    def save_workload(self, fingerprint: str, A: CsrMatrix,
+                      b: np.ndarray):
+        """Retain ONE (values, rhs) sample per fingerprint — the
+        autotuner's shadow-solve input. Per-request records are
+        deleted at record_done (the journal is a crash log, not an
+        archive), so the tuner's workload persists separately: one
+        bounded file per fingerprint, overwritten by newer samples,
+        riding the pattern file record_submit already deduplicates.
+        Best-effort: a failed write only costs the tuner its
+        restart-surviving workload, never the journal's guarantees."""
+        try:
+            ppath = self._ppath(fingerprint)
+            if not os.path.exists(ppath):
+                pat = {"row_offsets": np.asarray(A.row_offsets),
+                       "col_indices": np.asarray(A.col_indices),
+                       "shape_meta": np.asarray(
+                           [A.num_rows, A.num_cols, A.block_dimx,
+                            A.block_dimy], np.int64)}
+                if A.grid_shape is not None:
+                    pat["grid_shape"] = np.asarray(A.grid_shape,
+                                                   np.int64)
+                self._write_npz(ppath, pat)
+            arrays = {"values": np.asarray(A.values),
+                      "b": np.asarray(b)}
+            if A.diag is not None:
+                arrays["diag"] = np.asarray(A.diag)
+            self._write_npz(self._wpath(fingerprint), arrays)
+        except Exception:
+            pass
+
+    def load_workload(self, fingerprint: str
+                      ) -> Optional[Tuple[CsrMatrix, np.ndarray]]:
+        """The retained (A, b) workload sample for a fingerprint, or
+        None (never saved / corrupt — corruption-tolerant like every
+        journal read)."""
+        pat = self._read_npz(self._ppath(fingerprint))
+        wl = self._read_npz(self._wpath(fingerprint))
+        if pat is None or wl is None or "row_offsets" not in pat \
+                or "values" not in wl or "b" not in wl:
+            return None
+        try:
+            nr, nc, bx, by = (int(v) for v in pat["shape_meta"])
+            gs = pat.get("grid_shape")
+            A = CsrMatrix(
+                row_offsets=pat["row_offsets"],
+                col_indices=pat["col_indices"],
+                values=wl["values"], diag=wl.get("diag"),
+                num_rows=nr, num_cols=nc,
+                block_dimx=bx, block_dimy=by,
+                grid_shape=None if gs is None
+                else tuple(int(v) for v in gs))
+        except Exception:
+            return None
+        return A, wl["b"]
 
     # -- read path ---------------------------------------------------------
     def lookup_key(self, request_key: str) -> Optional[Dict[str, Any]]:

@@ -104,6 +104,11 @@ class ServiceTicket:
     deadline_t: Optional[float]          # absolute service_now() time
     result: Optional[SolveResult] = None
     complete_t: Optional[float] = None
+    # process-CPU completion stamp (time.process_time at _complete):
+    # on shared-core deployments the wall stamps also count neighbor
+    # steal — paired latency comparisons (bench, SLO forensics) read
+    # this ruler to see only what the service itself executed
+    complete_cpu_t: Optional[float] = None
     # has this request's cache routing (hit/miss) been counted yet?
     # (once per request, at its build/admission — never per poll)
     cache_counted: bool = False
@@ -152,6 +157,7 @@ class ServiceTicket:
     def _complete(self, result: SolveResult):
         self.result = result
         self.complete_t = _now()
+        self.complete_cpu_t = time.process_time()
         self._event.set()
 
 
@@ -249,6 +255,11 @@ class SolveService:
         # recent in-bucket execution times (shed estimator window)
         import collections
         self._exec_recent = collections.deque(maxlen=64)
+        # ... and the same window PER FINGERPRINT: mixed-size traffic
+        # must not shed the small tenant on the big tenant's median —
+        # a fingerprint with its own trained window is estimated from
+        # its own history, the global window is only the cold fallback
+        self._exec_fp: Dict[str, Any] = {}
         # execution-device share factor for the feasibility estimate:
         # an in-process fleet (FleetRouter) runs N replicas on ONE
         # device, so each replica's observed exec window undercounts
@@ -269,6 +280,15 @@ class SolveService:
         self._fr_dump_reason: Optional[str] = None
         # per-tenant tallies for stats()
         self._tenants: Dict[str, Dict[str, int]] = {}
+        # online config autotuner (autotune=1): default-off — a
+        # disabled service never constructs the tuner, schedules no
+        # shadow work and applies no overlay (bitwise-inert contract,
+        # test-proven)
+        self._draining = False
+        self._tuner = None
+        if int(cfg.get("autotune", scope)):
+            from .autotune import ConfigAutotuner
+            self._tuner = ConfigAutotuner(self)
         if self.journal is not None and \
                 int(cfg.get("serving_recover", scope)):
             self.recover()
@@ -488,14 +508,19 @@ class SolveService:
             if live >= self.tenant_quota:
                 return "quota", None
         if self.shed_policy == "deadline" and deadline_s is not None:
-            est = self._estimate_latency_s()
+            est = self._estimate_latency_s(t.fingerprint)
             if est is not None and float(deadline_s) < est:
                 return "deadline", est
         return None
 
-    def _estimate_latency_s(self) -> Optional[float]:
-        """Deadline-feasibility estimate: the MEDIAN of this service's
-        recent in-bucket execution times (a bounded window, so one
+    def _estimate_latency_s(self, fingerprint: Optional[str] = None
+                            ) -> Optional[float]:
+        """Deadline-feasibility estimate: the MEDIAN of the request's
+        OWN fingerprint's recent in-bucket execution times when that
+        window is trained (mixed-size traffic: the small tenant's
+        tight deadline is judged on the small tenant's history, not a
+        global median a co-resident 256^3 tenant drags up), falling
+        back to the service-wide window (a bounded deque, so one
         cold-bucket trace outlier washes out and a restarted service
         retrains within a few requests; the process-wide
         serving.exec_s histogram p50 is the fallback before the window
@@ -503,7 +528,12 @@ class SolveService:
         depth over slot capacity), plus a 25% safety margin so
         admitted work keeps its deadline promise. None while fully
         untrained — an untrained estimator must never shed."""
-        if len(self._exec_recent) >= 3:
+        fpw = self._exec_fp.get(fingerprint) \
+            if fingerprint is not None else None
+        if fpw is not None and len(fpw) >= 3:
+            window = sorted(fpw)
+            est = window[len(window) // 2]
+        elif len(self._exec_recent) >= 3:
             window = sorted(self._exec_recent)
             est = window[len(window) // 2]
         elif self.replica:
@@ -597,9 +627,18 @@ class SolveService:
                     labels=self._hlabels(t.tenant))
         if t.admit_t is not None:
             # the in-bucket half: what the shed estimator reads
-            _tm.observe("serving.exec_s", t.complete_t - t.admit_t,
+            exec_s = t.complete_t - t.admit_t
+            _tm.observe("serving.exec_s", exec_s,
                         labels=self._hlabels(t.tenant))
-            self._exec_recent.append(t.complete_t - t.admit_t)
+            self._exec_recent.append(exec_s)
+            fpw = self._exec_fp.get(t.fingerprint)
+            if fpw is None:
+                import collections
+                fpw = collections.deque(maxlen=64)
+                self._exec_fp[t.fingerprint] = fpw
+            fpw.append(exec_s)
+            if self._tuner is not None:
+                self._tuner.note_finish(t, exec_s)
         if t.journal_id is not None \
                 and self._journal_for(t) is not None:
             # queued, not written: _finish runs under the service lock
@@ -932,10 +971,21 @@ class SolveService:
         same-fingerprint ticket, but the oldest unserved one caused
         it) and logged on the flight recorder."""
         slots = self._slots_for(t)
+        # tuned-config overlay: a promoted (or hstore-restored)
+        # fingerprint builds its bucket from the service config PLUS
+        # the tuner's deltas — real AMG knobs, so the engine's
+        # hstore/AOT keys change with them and a restarted replica
+        # restores the TUNED hierarchy (zero full setups)
+        cfg, tuned = self.cfg, None
+        if self._tuner is not None:
+            tuned = self._tuner.overlay_for(t.fingerprint)
+            if tuned is not None:
+                cfg = self._tuner.apply_overlay(self.cfg, tuned)
+                _tm.inc("autotune.overlay.applied")
         with self._tspan("serving.build", trace=t.trace_id,
                          fingerprint=t.fingerprint[:24], slots=slots):
             eng = BucketEngine(
-                self.cfg, self.scope, t.A, slots=slots,
+                cfg, self.scope, t.A, slots=slots,
                 chunk=self.chunk, dtype=t.b.dtype,
                 fingerprint=t.fingerprint, aot=self.aot,
                 hstore=self.hstore)
@@ -944,7 +994,8 @@ class SolveService:
                    slots=eng.slots,
                    wall_s=round(eng.build_time, 4),
                    aot_warm=eng.aot_warm,
-                   hier_restored=eng.hier_restored)
+                   hier_restored=eng.hier_restored,
+                   tuned=tuned is not None)
         return eng
 
     def _builder(self, t: ServiceTicket):
@@ -1246,6 +1297,11 @@ class SolveService:
             self._checkpoint()
         if self.journal is not None and self._cycle % 512 == 0:
             self.journal.prune()
+        # the tuner's tick rides the off-lock tail too: at most one
+        # shadow solve, and only when the service has idle capacity
+        # (never while draining — drain() quiesces it first)
+        if self._tuner is not None and not self._draining:
+            self._tuner.maybe_step()
         return completed
 
     def _inflight(self) -> int:
@@ -1277,23 +1333,37 @@ class SolveService:
         hold) for counts in that mode."""
         t0 = time.monotonic()
         done: List[ServiceTicket] = []
-        while not self.idle:
-            if timeout_s is not None \
-                    and time.monotonic() - t0 > timeout_s:
-                break
-            if self._thread is not None:
-                if self._thread_error is not None \
-                        and not self._thread.is_alive():
-                    # the background scheduler died: nothing will ever
-                    # step this work — surface the captured exception
-                    # on the outstanding tickets (BREAKDOWN +
-                    # ticket.error) instead of spinning to timeout
-                    done.extend(
-                        self._fail_outstanding(self._thread_error))
+        # quiesce the tuner for the duration: drain waits on
+        # PRODUCTION work only, so no new shadow solves may start
+        # while it runs (search state is kept; the search resumes
+        # after). _draining also gates the background scheduler's
+        # tuner tick, which reads the flag per cycle.
+        self._draining = True
+        if self._tuner is not None:
+            self._tuner.quiesce()
+        try:
+            while not self.idle:
+                if timeout_s is not None \
+                        and time.monotonic() - t0 > timeout_s:
                     break
-                time.sleep(0.001)
-            else:
-                done.extend(self.step())
+                if self._thread is not None:
+                    if self._thread_error is not None \
+                            and not self._thread.is_alive():
+                        # the background scheduler died: nothing will
+                        # ever step this work — surface the captured
+                        # exception on the outstanding tickets
+                        # (BREAKDOWN + ticket.error) instead of
+                        # spinning to timeout
+                        done.extend(
+                            self._fail_outstanding(self._thread_error))
+                        break
+                    time.sleep(0.001)
+                else:
+                    done.extend(self.step())
+        finally:
+            self._draining = False
+            if self._tuner is not None:
+                self._tuner.resume()
         return done
 
     def _fail_outstanding(self, err: BaseException
@@ -1404,4 +1474,6 @@ class SolveService:
                 "bucket_ladder": list(self.ladder),
                 "tenants": {k: dict(v)
                             for k, v in self._tenants.items()},
+                "autotune": {"enabled": False}
+                if self._tuner is None else self._tuner.snapshot(),
             }

@@ -235,3 +235,91 @@ def derive(diag_vec, num_levels: int, res_hist=None,
         "asymptotic_convergence_factor":
             asymptotic_convergence_factor(res_hist, tail_window),
     }
+
+
+# ---------------------------------------------------------------------------
+# diagnostics -> concrete config deltas
+# ---------------------------------------------------------------------------
+
+# the doctor's hint sentences (examples/convergence_doctor.py prints
+# them verbatim; several candidates may share one hint, so the doctor
+# dedups in order — its output predates this mapping and must not move)
+HINT_SMOOTHER = ("the smoother barely reduces the residual "
+                 "there — raise sweeps/relaxation_factor or "
+                 "switch smoother")
+HINT_CORRECTION = ("the coarse-grid correction INCREASES the "
+                   "residual — interpolation quality: lower "
+                   "strength_threshold or use D2/multipass")
+
+
+def suggest_config_deltas(diag: Optional[Dict[str, Any]]
+                          ) -> List[Dict[str, Any]]:
+    """Map a `derive()` diagnostics block to concrete config-delta
+    candidates — the single source both consumers read: the
+    convergence doctor prints each suggestion's `hint` (None for the
+    tuner-only candidates, so its output stays the historical two
+    sentences), and the serving autotuner shadow-solves each
+    suggestion's `deltas`.
+
+    Each suggestion:
+
+        {"knob": <short tag>, "hint": <doctor sentence or None>,
+         "level": <bottleneck level or None>,
+         "deltas": [{"param": <registry name>, "value": ...}, ...]}
+
+    `deltas` name registered config parameters WITHOUT scopes — the
+    applier overrides the parameter wherever the live config sets it
+    (else at the default scope, which every scope falls back to), so
+    one mapping serves any solver-tree shape. Rules:
+
+    - ineffective smoother at the bottleneck (effectiveness > 0.8):
+      swap to JACOBI_L1 (resetting relaxation_factor — an overdamped
+      factor must not ride along), or just re-damp the current one;
+    - coarse-grid correction AMPLIFYING the residual (> 1.1):
+      stock strength threshold, or D2 interpolation with row
+      truncation (interpolation-quality levers);
+    - cycle barely biting overall (asymptotic factor > 0.85): W-cycle
+      (more coarse visits per fine sweep);
+    - comfortable convergence (asymptotic factor < 0.35): trade slack
+      for bandwidth with solve_precision=float (wall lever — shadow
+      measurement decides whether the extra iterations pay for the
+      halved slab bytes).
+    """
+    out: List[Dict[str, Any]] = []
+    if not diag:
+        return out
+    levels = diag.get("levels") or []
+    bl = diag.get("bottleneck_level")
+    row = next((r for r in levels if r.get("level") == bl), None) \
+        if bl is not None else None
+    if row is not None:
+        if (row["smoother_effectiveness"] or 0) > 0.8:
+            out.append({"knob": "smoother_swap", "hint": HINT_SMOOTHER,
+                        "level": bl, "deltas": [
+                            {"param": "smoother", "value": "JACOBI_L1"},
+                            {"param": "relaxation_factor", "value": 0.9},
+                        ]})
+            out.append({"knob": "relaxation", "hint": HINT_SMOOTHER,
+                        "level": bl, "deltas": [
+                            {"param": "relaxation_factor", "value": 0.9},
+                        ]})
+        if (row["correction_reduction"] or 0) > 1.1:
+            out.append({"knob": "strength", "hint": HINT_CORRECTION,
+                        "level": bl, "deltas": [
+                            {"param": "strength_threshold",
+                             "value": 0.25},
+                        ]})
+            out.append({"knob": "interp", "hint": HINT_CORRECTION,
+                        "level": bl, "deltas": [
+                            {"param": "interpolator", "value": "D2"},
+                            {"param": "interp_max_elements", "value": 4},
+                        ]})
+    acf = diag.get("asymptotic_convergence_factor")
+    if acf is not None and acf > 0.85:
+        out.append({"knob": "cycle", "hint": None, "level": bl,
+                    "deltas": [{"param": "cycle", "value": "W"}]})
+    if acf is not None and acf < 0.35:
+        out.append({"knob": "precision", "hint": None, "level": bl,
+                    "deltas": [{"param": "solve_precision",
+                                "value": "float"}]})
+    return out

@@ -803,6 +803,51 @@ declare_gauge("fleet.health.available",
               "replicas currently able to take traffic (not down, "
               "not draining, breaker not OPEN)")
 
+# online config autotuner (serving/autotune.py, autotune=1): the
+# watch -> generate -> shadow -> promote/demote lifecycle, each
+# transition counted where it happens — with autotune=0 every series
+# below stays at zero (the bitwise-inert contract's observable half)
+declare_counter("autotune.hot",
+                "fingerprints crossing both hot thresholds "
+                "(autotune_hot_requests AND autotune_hot_exec_share) "
+                "— searches opened")
+declare_counter("autotune.candidates",
+                "candidate configs generated from shadow-baseline "
+                "diagnostics (suggest_config_deltas output, summed "
+                "over searches)")
+declare_counter("autotune.shadow.runs",
+                "completed shadow solves (baseline probes + "
+                "candidates), run only on idle capacity")
+declare_counter("autotune.shadow.errors",
+                "shadow solves that raised (absorbed: counted, backed "
+                "off, never a failed ticket)")
+declare_counter("autotune.promotions",
+                "candidate configs promoted to a fingerprint's "
+                "serving overlay (won iterations AND wall past the "
+                "autotune_min_improvement gate)")
+declare_counter("autotune.demotions",
+                "promoted overlays dropped by the live regression "
+                "watch (post-promotion exec median regressed past "
+                "autotune_demote_factor)")
+declare_counter("autotune.overlay.applied",
+                "bucket builds that applied a tuned-config overlay "
+                "(promoted or restored fingerprints)")
+declare_counter("autotune.overlay.restored",
+                "tuned-config overlays restored from the hstore's "
+                "persisted record (restart durability: resolved "
+                "before the fingerprint's first build)")
+declare_counter("autotune.handoffs",
+                "promoted overlays handed to a survivor replica "
+                "during fleet drain/failover (adopted live + "
+                "persisted in the adopter's hstore)")
+declare_gauge("autotune.tuned_fingerprints",
+              "fingerprints currently serving a promoted tuned-config "
+              "overlay")
+declare_histogram("autotune.shadow_wall_s",
+                  "wall seconds per shadow solve (setup + cold + "
+                  "measured warm pass — the idle-capacity cost of "
+                  "the search)", edges=_LATENCY_EDGES_S)
+
 # distributed comms/shard telemetry (distributed/comms.py records at
 # TRACE time — collectives are emitted by the traced program, so the
 # honest countable event is the traced exchange SITE; bytes are the

@@ -82,6 +82,7 @@ DECLARED_SPANS: Tuple[str, ...] = (
     # eager route's span), never nested inside it
     "amg.L*.rap_plan",
     "amg.L*.rap_values",
+    "amg.L*.mf_detect",
     "amg.L*.galerkin",
     "amg.L*.layout",
     "amg.L*.smoother_setup",
@@ -133,6 +134,13 @@ DECLARED_SPANS: Tuple[str, ...] = (
     # path with its whole outcome (survivors, tickets requeued,
     # fingerprints rehomed, journal adopter + replay count, wall)
     "fleet.failover",
+    # online config autotuner (serving/autotune.py): each shadow
+    # solve as a real span (the idle-capacity cost is visible on the
+    # timeline next to production work), each promote/demote/retire
+    # verdict as an instant event — both tagged with the search's
+    # trace id so the whole watch->shadow->promote chain reconstructs
+    "autotune.shadow",
+    "autotune.decision",
     # distributed comms/shard telemetry: one synthetic track per
     # shard in the Perfetto export (record_span with a per-shard tid)
     "shard.solve",

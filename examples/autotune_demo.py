@@ -1,0 +1,102 @@
+"""Online autotuner: a mistuned hot fingerprint fixed live, on idle
+capacity, with zero production traffic spent on the search.
+
+The serving layer (serving/service.py) answers "solve this, again and
+again" — but the CONFIG it serves with is whatever the operator wrote.
+The autotuner (serving/autotune.py) closes the loop the convergence
+doctor opened: when a fingerprint turns hot, it runs one diagnostics
+probe, derives candidate config deltas from the same shared mapping
+the doctor prints (telemetry/diagnostics.py `suggest_config_deltas`),
+SHADOW-solves each candidate on idle scheduler cycles, and promotes a
+winner only on a measured iterations-AND-wall improvement. The
+promoted overlay persists in the hierarchy store, so a restarted
+replica serves the tuned config from its first request.
+
+This demo serves a deliberately overdamped BLOCK_JACOBI smoother (the
+convergence-doctor classic), lets the tuner watch it turn hot, then
+prints the decision trail from the flight recorder and the before /
+after iteration counts:
+
+    python examples/autotune_demo.py
+
+Look for: the `autotune.hot` -> shadow runs -> `autotune.promote`
+flight-recorder chain, the promoted overlay (the doctor's relaxation
+hint, validated by measurement), and the re-served requests converging
+in a fraction of the iterations — with zero requests rejected or
+delayed while the search ran.
+"""
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+import amgx_tpu as amgx
+from amgx_tpu.config import Config
+from amgx_tpu.presets import BATCHED_CG
+from amgx_tpu.serving import SolveService
+from amgx_tpu.telemetry import flightrec
+
+amgx.initialize()
+
+N = 16            # 16^3 = 4.1k rows: small enough to run anywhere
+
+# the mistuning: BLOCK_JACOBI damped nearly to a standstill — every
+# request converges, slowly, and every request pays for it
+MISTUNED = (
+    BATCHED_CG + ", amg:smoother(sm2)=BLOCK_JACOBI,"
+    " sm2:max_iters=1, sm2:relaxation_factor=0.02,"
+    " serving_bucket_slots=2, serving_chunk_iters=2")
+
+root = tempfile.mkdtemp(prefix="amgx_autotune_demo_")
+cfg = Config.from_string(
+    MISTUNED + ", autotune=1, autotune_hot_requests=4,"
+    " autotune_hot_exec_share=0.0,"
+    f" serving_hierarchy_dir={root}/hier,"
+    f" serving_journal_dir={root}/journal")
+
+A = amgx.gallery.poisson("7pt", N, N, N).init()
+rng = np.random.default_rng(7)
+rhs = [rng.standard_normal(A.num_rows) for _ in range(8)]
+
+svc = SolveService(cfg)
+
+print(f"== serving {len(rhs)} requests with the mistuned config ==")
+before = [svc.submit(A, b) for b in rhs]
+svc.drain(timeout_s=600)
+pre = sorted(t.result.iterations for t in before)
+print(f"   iterations (median): {pre[len(pre) // 2]}"
+      f"   all converged: {all(t.result.converged for t in before)}")
+
+print("\n== idle cycles: the tuner probes, shadow-solves, decides ==")
+for _ in range(24):
+    svc.step()
+    if svc.stats()["autotune"]["promoted"]:
+        break
+
+snap = svc.stats()["autotune"]
+rec = next(iter(snap["fingerprints"].values()))
+print(f"   phase: {rec['phase']}   knob: {rec['knob']}"
+      f"   overlay: {rec['overlay']}")
+
+print("\n== decision trail (flight recorder) ==")
+for ev in flightrec.events():
+    if str(ev.get("kind", "")).startswith("autotune."):
+        keys = [k for k in ("knob", "deltas", "baseline_iters",
+                            "tuned_iters", "speedup_x", "decision")
+                if k in ev]
+        detail = ", ".join(f"{k}={ev[k]}" for k in keys)
+        print(f"   {ev['kind']:<22} {detail}")
+
+print("\n== the same requests, re-served under the promoted overlay ==")
+after = [svc.submit(A, b) for b in rhs]
+svc.drain(timeout_s=600)
+post = sorted(t.result.iterations for t in after)
+print(f"   iterations (median): {post[len(post) // 2]}"
+      f"   all converged: {all(t.result.converged for t in after)}")
+print(f"\n   {pre[len(pre) // 2]} -> {post[len(post) // 2]} iterations"
+      " — tuned on idle capacity, validated by shadow measurement,"
+      " persisted for the next restart.")

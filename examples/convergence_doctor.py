@@ -90,16 +90,15 @@ def doctor(tag, cfg_str):
     print(f"bottleneck level: {bl} "
           f"(level_reduction {d['bottleneck_reduction']:.3f})")
     if bl is not None:
-        row = d["levels"][bl]
+        # the shared diagnostics->deltas mapping (the serving
+        # autotuner's candidate generator reads the same suggestions);
+        # the doctor prints each distinct hint sentence once, in rule
+        # order — the historical output, now derived from one source
+        from amgx_tpu.telemetry.diagnostics import suggest_config_deltas
         hints = []
-        if (row["smoother_effectiveness"] or 0) > 0.8:
-            hints.append("the smoother barely reduces the residual "
-                         "there — raise sweeps/relaxation_factor or "
-                         "switch smoother")
-        if (row["correction_reduction"] or 0) > 1.1:
-            hints.append("the coarse-grid correction INCREASES the "
-                         "residual — interpolation quality: lower "
-                         "strength_threshold or use D2/multipass")
+        for s in suggest_config_deltas(d):
+            if s["hint"] and s["hint"] not in hints:
+                hints.append(s["hint"])
         if hints:
             print("doctor says: " + "; ".join(hints))
     return res

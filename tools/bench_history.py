@@ -169,6 +169,16 @@ SERIES: Tuple[Tuple[str, str, float, str], ...] = (
      "summed per-level operator solve-data bytes, matrix-free over "
      "slab build (bench.py matfree; lower = more of the hierarchy "
      "serves from O(k) stencil coefficients)"),
+    # ISSUE 19 online autotuner: recorded from r06 on (the
+    # shadow-solve config search lands after the matrix-free round)
+    ("autotune_speedup", "higher", 0.30,
+     "mistuned hot fingerprint re-served after shadow-validated "
+     "promotion, min of iteration and exec-wall ratios (bench.py "
+     "autotune; gate >= 2x on both)"),
+    ("autotune_shadow_p99_impact_pct", "lower_abs", 2.0,
+     "paired lockstep saturated-burst p99 delta, autotune on vs off "
+     "(abs pct gate: shadows use idle capacity only, the target is "
+     "0)"),
 )
 
 _NUM = r"(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)"
@@ -258,7 +268,8 @@ def load_round(path: str, kind: str) -> Optional[Dict[str, Any]]:
 # round even when no BENCH_r<NN>.json wrapper did
 PHASE_ARTIFACTS: Tuple[str, ...] = ("BENCH_serving.json",
                                     "BENCH_fleet.json",
-                                    "BENCH_matfree.json")
+                                    "BENCH_matfree.json",
+                                    "BENCH_autotune.json")
 
 
 def load_phase_artifact(path: str) -> Optional[Dict[str, Any]]:
