@@ -275,15 +275,18 @@ class AggregationAMGLevel(AMGLevel):
             return None
         return fn(data["smoother"], b, x, sweeps, data.get("xfer"))
 
-    def prolongate_smooth(self, data, b, x, xc, sweeps: int):
+    def prolongate_smooth(self, data, b, x, xc, sweeps: int,
+                          want_dot: bool = False):
         """Prolongation/correction folded into the postsmoother's first
-        kernel application, or None."""
+        kernel application, or None. want_dot additionally requests the
+        x'.b dot epilogue from the final kernel → (x', dot|None)."""
         if "R" in data or "P" in data or self.smoother is None:
             return None
         fn = getattr(self.smoother, "smooth_corr", None)
         if fn is None:
             return None
-        return fn(data["smoother"], b, x, xc, sweeps, data.get("xfer"))
+        return fn(data["smoother"], b, x, xc, sweeps, data.get("xfer"),
+                  want_dot=want_dot)
 
     def restrict(self, data, r):
         if "R" in data:       # distributed: explicit sharded R = P^T

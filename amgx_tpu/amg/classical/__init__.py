@@ -279,13 +279,16 @@ class ClassicalAMGLevel(AMGLevel):
             return None
         return fn(data["smoother"], b, x, sweeps, data["xfer"])
 
-    def prolongate_smooth(self, data, b, x, xc, sweeps: int):
+    def prolongate_smooth(self, data, b, x, xc, sweeps: int,
+                          want_dot: bool = False):
         """Weighted prolongation/correction (x + P xc) folded into the
-        postsmoother's first kernel application, or None."""
+        postsmoother's first kernel application, or None. want_dot
+        additionally requests the x'.b dot epilogue → (x', dot|None)."""
         fn = getattr(self.smoother, "smooth_corr", None)
         if fn is None:
             return None
-        return fn(data["smoother"], b, x, xc, sweeps, data["xfer"])
+        return fn(data["smoother"], b, x, xc, sweeps, data["xfer"],
+                  want_dot=want_dot)
 
     def restrict(self, data, r):
         return spmv(data["R"], r)

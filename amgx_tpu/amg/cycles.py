@@ -77,20 +77,33 @@ def _smooth_restrict(amg, level, data, b, x, sweeps: int):
     return x, level.restrict(data, r)
 
 
-def _prolongate_smooth(amg, level, data, b, x, xc, sweeps: int):
+def _prolongate_smooth(amg, level, data, b, x, xc, sweeps: int,
+                       want_dot: bool = False):
     """Prolongation + correction + postsmooth: with cycle_fusion,
     aggregation AND classical DIA levels fold x + P xc into the
     postsmoother kernel's first application (ops/smooth.py —
     aggregate-id gather or the weighted multi-entry CSR-row gather),
     removing the correction add's full-vector pass. Falls back to the
-    prior x + prolongate -> smooth compose bit-for-bit."""
+    prior x + prolongate -> smooth compose bit-for-bit.
+
+    With want_dot (the cycle-borne reduction, Krylov shell fusion) the
+    return is (x', dot) where dot = x'.b from the postsmoother kernel's
+    epilogue — PCG reads it as r.z since the cycle's rhs is r and its
+    output is z — or (x', None) when no fused hook carries it; the
+    want_dot kwarg is only passed to level hooks when True, so hook
+    signatures that predate it keep working un-updated."""
     if amg.cycle_fusion and sweeps > 0 and \
             "prolongate" in _fusion_caps(level, data):
-        out = level.prolongate_smooth(data, b, x, xc, sweeps)
+        if want_dot:
+            out = level.prolongate_smooth(data, b, x, xc, sweeps,
+                                          want_dot=True)
+        else:
+            out = level.prolongate_smooth(data, b, x, xc, sweeps)
         if out is not None:
             return out
     x = x + level.prolongate(data, xc)
-    return _smooth(level, data, b, x, sweeps)
+    x = _smooth(level, data, b, x, sweeps)
+    return (x, None) if want_dot else x
 
 
 def apply_coarse_solver(cs, data, bc, xc, coarsest_sweeps: int):
@@ -123,12 +136,15 @@ def _coarse_solve(amg, data, bc, xc):
                                amg.coarsest_sweeps)
 
 
-def _cycle(amg, shape: str, data, lvl: int, b, x):
+def _cycle(amg, shape: str, data, lvl: int, b, x, want_dot: bool = False):
     """FixedCycle::cycle analog. `shape` in {V, W, F}; recursion count per
-    level: V=1, W=2, F=(F then V)."""
+    level: V=1, W=2, F=(F then V). want_dot asks the ENTRY level's final
+    kernel (postsmoother or whole-cycle VMEM tail) for the x'.b dot
+    epilogue; recursion below the entry level never requests it."""
     levels = amg.levels
     if lvl == len(levels):
-        return _coarse_solve(amg, data, b, x)
+        out = _coarse_solve(amg, data, b, x)
+        return (out, None) if want_dot else out
     # convergence diagnostics (telemetry/diagnostics.py): while a probe
     # cycle is being traced, record the level's stage residual norms
     # and compose the correction/postsmooth boundary explicitly so each
@@ -142,7 +158,8 @@ def _cycle(amg, shape: str, data, lvl: int, b, x):
         # -> ... -> coarsest solve -> ... -> prolongate -> smooth) is
         # ONE pallas_call instead of ~10 tiny dispatches per cycle
         from ..ops.smooth import coarse_tail_cycle
-        out = coarse_tail_cycle(amg, shape, data, lvl, b, x)
+        out = coarse_tail_cycle(amg, shape, data, lvl, b, x,
+                                want_dot=want_dot)
         if out is not None:
             return out
     level = levels[lvl]
@@ -171,9 +188,10 @@ def _cycle(amg, shape: str, data, lvl: int, b, x):
         rec.record(lvl, 2, _level_A(ldata), x, b)
         x = _smooth(level, ldata, b, x, amg._sweeps(lvl, pre=False))
         rec.record(lvl, 3, _level_A(ldata), x, b)
-        return x
+        return (x, None) if want_dot else x
     return _prolongate_smooth(amg, level, ldata, b, x, xc,
-                              amg._sweeps(lvl, pre=False))
+                              amg._sweeps(lvl, pre=False),
+                              want_dot=want_dot)
 
 
 def _kcycle(amg, data, lvl: int, b, x, flex: bool):
@@ -271,3 +289,15 @@ def run_cycle(amg, name: str, data, b, x):
     if name == "CGF":
         return _kcycle(amg, data, 0, b, x, flex=True)
     raise ValueError(f"unknown cycle {name!r}")
+
+
+def run_cycle_dot(amg, name: str, data, b, x):
+    """Cycle application that ALSO asks for the x'.b dot epilogue from
+    the cycle's last kernel (the Krylov shell's cycle-borne r.z).
+    Returns (x', dot) with dot=None whenever the cycle cannot carry it
+    — K-cycles, diagnostics probes, unfused last levels — so callers
+    fall back to an explicit reduction."""
+    name = name.upper()
+    if name in ("V", "W", "F"):
+        return _cycle(amg, name, data, 0, b, x, want_dot=True)
+    return run_cycle(amg, name, data, b, x), None

@@ -156,9 +156,13 @@ class AMGLevel:
         or None when unsupported."""
         return None
 
-    def prolongate_smooth(self, data, b, x, xc, sweeps: int):
+    def prolongate_smooth(self, data, b, x, xc, sweeps: int,
+                          want_dot: bool = False):
         """smooth(b, x + P xc) with the correction folded into the
-        postsmoother's kernel prologue, or None when unsupported."""
+        postsmoother's kernel prologue, or None when unsupported. With
+        want_dot, (x', dot) where dot is the kernel's x'.b epilogue
+        (the Krylov shell's cycle-borne r.z) or None when the fused
+        form cannot carry it."""
         return None
 
 
@@ -943,6 +947,17 @@ class AMG:
         x = run_cycle(self, self.cycle_name, data,
                       b.astype(dt), x.astype(dt))
         return x.astype(out_dtype)
+
+    def cycle_dot(self, data, b, x):
+        """One cycle PLUS the x'.b dot epilogue from its final kernel
+        ((x', dot), dot None when unavailable). A reduced-precision
+        cycle declines the dot: the epilogue would reduce the rounded
+        product while callers need the caller-dtype x'.b, so the cheap
+        explicit reduction stays correct there."""
+        from .cycles import run_cycle_dot
+        if self._PRECISIONS[self.precision] is not None:
+            return self.cycle(data, b, x), None
+        return run_cycle_dot(self, self.cycle_name, data, b, x)
 
     # -- observability ----------------------------------------------------
     @staticmethod

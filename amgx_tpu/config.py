@@ -379,6 +379,14 @@ def _register_default_parameters():
       "into the fused coarse-tail kernel (the dispatch-latency-bound "
       "tiny-level region; levels above it keep per-level kernels)",
       65536, None, 0)
+    R("krylov_fusion", int, "fuse the Krylov shell around the cycle on "
+      "DIA operators (ops/pallas_spmv.py): the direction update, SpMV "
+      "and p.Ap run as ONE kernel with the dot as a per-block epilogue, "
+      "the x/r updates and the monitor's r.r share a second single-pass "
+      "kernel, PCG's r.z rides the cycle's last kernel, and distributed "
+      "runs pack the iteration's scalars into one psum bundle; 0 "
+      "restores the unfused SpMV/BLAS-1 composition bit-for-bit",
+      1, BOOL01)
     R("dist_cycle_fusion", int, "bring the fused smoother kernels under "
       "shard_map on distributed DIA levels (distributed/fused.py): "
       "per-shard quota slabs with the neighbor shards' halo rows folded "

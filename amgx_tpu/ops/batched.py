@@ -175,6 +175,55 @@ def affine_window_sweeps(offsets, vals_w, b_w, x_w, taus, dinv_w,
 
 
 # ---------------------------------------------------------------------------
+# Krylov shell slab forms (the custom_vmap fallbacks of the fused
+# SpMV+dot / cg_update kernels in ops/pallas_spmv.py — and the f64
+# parity reference; solve_many's vector-only batches land here)
+# ---------------------------------------------------------------------------
+
+
+def spmv_dot_multi(A: CsrMatrix, P: jax.Array, Z=None, beta=None,
+                   D=None, self_dot: bool = False):
+    """Multi-RHS form of the fused SpMV + dot shell kernel
+    (`_dia_spmv_dot_call`): optional direction-update prologue
+    P' = Z + beta*P (beta per-system), AP = A @ P', the paired dot
+    sum(d . AP) per system (d = D when a separate dot operand is
+    streamed, else P'), and optionally AP . AP (BiCGStab's t.t).
+    Returns the kernel call's tuple layout with a leading batch axis:
+    (AP, pdot[, sdot]) or, with the prologue, (P', AP, pdot[, sdot]).
+    bf16 slabs accumulate the prologue and the dots in f32 like the
+    kernel; for f32/f64 the casts fold away, making this the f64
+    parity reference."""
+    dt = P.dtype
+    cdt = _cdt(dt)
+    if Z is not None:
+        P = (Z.astype(cdt)
+             + beta[..., None].astype(cdt) * P.astype(cdt)).astype(dt)
+    AP = spmv_dia_multi(A, P)
+    dvec = (P if D is None else D).astype(cdt)
+    pdot = jnp.sum(dvec * AP.astype(cdt), axis=1)
+    out = (AP, pdot) if Z is None else (P, AP, pdot)
+    if self_dot:
+        out = out + (jnp.sum(AP.astype(cdt) ** 2, axis=1),)
+    return out
+
+
+def cg_update_multi(X: jax.Array, P: jax.Array, R: jax.Array,
+                    AP: jax.Array, alpha):
+    """Multi-RHS form of the single-pass CG update kernel
+    (`_cg_update_call`): X' = X + alpha P, R' = R - alpha AP, and the
+    per-system r'.r' dot (alpha per-system). The dot reduces the
+    UNROUNDED accumulation-dtype R' exactly like the kernel's f32
+    epilogue; outputs round back to the input dtype."""
+    dt = X.dtype
+    cdt = _cdt(dt)
+    a = alpha[..., None].astype(cdt)
+    Xn = X.astype(cdt) + a * P.astype(cdt)
+    Rn = R.astype(cdt) - a * AP.astype(cdt)
+    rr = jnp.sum(Rn * Rn, axis=1)
+    return Xn.astype(dt), Rn.astype(dt), rr
+
+
+# ---------------------------------------------------------------------------
 # cycle fusion slab forms (the custom_vmap fallbacks of the fused
 # grid-transfer / coarse-tail kernels in ops/smooth.py — and the f64
 # reference the kernel parity tests compare against)

@@ -13,6 +13,7 @@ that via the `block_size` / `use_scalar_norm` arguments.
 """
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -115,3 +116,71 @@ def norm(x, norm_type: str = "L2", block_size: int = 1,
 
 def get_norm(norm_type: str):
     return _NORMS[norm_type.upper()]
+
+
+# ---------------------------------------------------------------------------
+# Krylov shell fusion: the single-pass CG update and the packed scalar
+# collective
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _cg_update_fn():
+    """custom_vmap-wrapped single-pass CG update kernel: every vmap
+    batch (there is no matrix operand) takes the multi-RHS slab form
+    in ops/batched.py, so solve_many's update stays one slab pass."""
+
+    @jax.custom_batching.custom_vmap
+    def call(x, p, r, ap, alpha):
+        from . import pallas_spmv as _ps
+        return _ps._cg_update_call(x, p, r, ap, alpha,
+                                   interpret=_ps._FORCE_INTERPRET)
+
+    @call.def_vmap
+    def _rule(axis_size, in_batched, x, p, r, ap, alpha):
+        from .batched import cg_update_multi
+
+        def bc(v, b):
+            return v if b else jnp.broadcast_to(
+                v, (axis_size,) + jnp.shape(v))
+
+        return (cg_update_multi(
+            bc(x, in_batched[0]), bc(p, in_batched[1]),
+            bc(r, in_batched[2]), bc(ap, in_batched[3]),
+            bc(alpha, in_batched[4])), (True, True, True))
+
+    return call
+
+
+def cg_update(x, p, r, ap, alpha):
+    """Single-pass CG state update: (x + alpha p, r - alpha Ap, r'.r')
+    — the Pallas kernel streams the four vectors once and emits the
+    residual dot as a free epilogue (the monitor's norm pass); the
+    plain XLA compose (identical unfused expressions) covers f64 / CPU.
+    The rr scalar is LOCAL — distributed callers psum it (packed)."""
+    from . import pallas_spmv as _ps
+    from ..telemetry import metrics as _tm
+    if _ps.cg_update_supported(x.dtype):
+        _tm.inc("krylov.fused_dispatch")
+        return _cg_update_fn()(x, p, r, ap, alpha)
+    _tm.inc("krylov.fused_declined")
+    a = jnp.asarray(alpha).astype(x.dtype)
+    xn = x + a * p
+    rn = r - a * ap
+    # f32+ accumulation like the kernel's epilogue (rr keeps ONE dtype
+    # across the kernel/fallback routes, so loop state stays stable)
+    rc = rn.astype(jnp.promote_types(x.dtype, jnp.float32))
+    return xn, rn, jnp.vdot(rc, rc)
+
+
+def psum_bundle(scalars, axis_name: Optional[str] = None):
+    """Sum a tuple of LOCAL scalars across the mesh with ONE packed
+    collective (stack + psum — the per-iteration collective count
+    stays independent of how many dots the iteration needs); the
+    identity when no mesh axis is active. Returns the tuple back."""
+    axis_name = _axis(axis_name)
+    if not axis_name:
+        return tuple(scalars)
+    packed = jax.lax.psum(jnp.stack([jnp.asarray(s) for s in scalars]),
+                          axis_name)
+    return tuple(packed[i] for i in range(len(scalars)))

@@ -496,7 +496,7 @@ def dia_smooth_supported(A, x_dtype, n_steps: int,
 
 def _dia_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x, win_v,
                        n_steps, with_residual, has_dinv, n_blocks,
-                       slab_shift, dtype, mf=None):
+                       slab_shift, dtype, mf=None, with_dot=False):
     """Kernel body factory. Buffer coordinates: state row j = x row
     i*br - n_app*mr0 + j; vals/b/dinv compute-region row j' = x row
     i*br - (n_app-1)*mr0 + j' (so an application's output row j'
@@ -526,6 +526,8 @@ def _dia_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x, win_v,
             y_ref = refs[off]
             r_ref = refs[off + 1] if with_residual else None
             off += 2 if with_residual else 1
+            d_ref = refs[off] if with_dot else None
+            off += 1 if with_dot else 0
             xbuf, vbuf, bbuf = refs[off], refs[off + 1], refs[off + 2]
             dbuf = refs[off + 3] if has_dinv else None
             sems = refs[off + 3 + (1 if has_dinv else 0)]
@@ -536,6 +538,8 @@ def _dia_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x, win_v,
             y_ref = refs[4]
             r_ref = refs[5] if with_residual else None
             off = 6 if with_residual else 5
+            d_ref = refs[off] if with_dot else None
+            off += 1 if with_dot else 0
             xbuf, bbuf = refs[off], refs[off + 1]
             vbuf = dbuf = None
             sems = refs[off + 2]
@@ -624,15 +628,27 @@ def _dia_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x, win_v,
             r_ref[...] = jax.lax.slice_in_dim(
                 r, (n_app - 1) * mr0, (n_app - 1) * mr0 + br, 1, 0
             ).astype(dtype)
+        if with_dot:
+            # dot epilogue: the block's final-x rows against the
+            # aligned b rows (x row i*br+t <-> b-window row
+            # (n_app-1)*mr0+t) — lanes stay unreduced; the caller's
+            # cheap XLA combine sums the (nb, 128) partials
+            xb = jax.lax.slice_in_dim(
+                s, n_app * mr0, n_app * mr0 + br, 1, 0)
+            bb = jax.lax.slice_in_dim(
+                bw, (n_app - 1) * mr0, (n_app - 1) * mr0 + br, 1, 0)
+            d_ref[...] = jnp.sum(xb * bb, axis=0,
+                                 keepdims=True).astype(jnp.float32)
 
     return kernel
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "offsets", "num_rows", "with_residual", "mf", "interpret"))
+    "offsets", "num_rows", "with_residual", "mf", "with_dot",
+    "interpret"))
 def _dia_smooth_call(vals_q, dinv_q, taus, b, x, offsets, num_rows,
                      with_residual, mf=None, coeffs=None,
-                     interpret=False):
+                     with_dot=False, interpret=False):
     """Run the fused smoother kernel. `vals_q` (k, Q, 128) and `dinv_q`
     ((Q, 128) or None) are the QUOTA-PADDED operand slabs from
     ops.smooth (built once per setup, smooth_quota_rows layout); b and
@@ -640,7 +656,10 @@ def _dia_smooth_call(vals_q, dinv_q, taus, b, x, offsets, num_rows,
     pays for x). Caller must have checked dia_smooth_supported.
     Matrix-free form (`mf` spec + `coeffs` (k,)): vals_q/dinv_q are
     None — the A-operand stream vanishes and the k coefficients ride
-    SMEM next to the taus."""
+    SMEM next to the taus. `with_dot` (postsmoother-only, exclusive
+    with with_residual) appends the x'.b dot epilogue and returns
+    (x', dot) — the Krylov shell's cycle-borne r.z reduction."""
+    assert not (with_dot and with_residual)
     n_steps = taus.shape[0]
     has_dinv = dinv_q is not None
     if mf is None:
@@ -679,7 +698,8 @@ def _dia_smooth_call(vals_q, dinv_q, taus, b, x, offsets, num_rows,
 
     kernel = _dia_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x,
                                 win_v, n_steps, with_residual, has_dinv,
-                                nb, slab_shift, dtype, mf=mf)
+                                nb, slab_shift, dtype, mf=mf,
+                                with_dot=with_dot)
     if mf is None:
         n_sem = 4 if has_dinv else 3
         in_specs = [
@@ -720,14 +740,21 @@ def _dia_smooth_call(vals_q, dinv_q, taus, b, x, offsets, num_rows,
     n_out = 2 if with_residual else 1
     nbytes = ((k + 2) * win_v + win_x + n_out * br) if mf is None \
         else (2 * win_v + win_x + n_out * br)
+    out_specs_t = tuple([out_block] * n_out)
+    out_shape_t = tuple([out_shape] * n_out)
+    if with_dot:
+        out_specs_t = out_specs_t + (pl.BlockSpec(
+            (1, LANES), lambda i: (i, jnp.int32(0)),
+            memory_space=pltpu.VMEM),)
+        out_shape_t = out_shape_t + (jax.ShapeDtypeStruct(
+            (nb, LANES), jnp.float32),)
+    multi_out = with_residual or with_dot
     out = pl.pallas_call(
         kernel,
         grid=(nb,),
         in_specs=in_specs,
-        out_specs=tuple([out_block] * n_out) if with_residual
-        else out_block,
-        out_shape=tuple([out_shape] * n_out) if with_residual
-        else out_shape,
+        out_specs=out_specs_t if multi_out else out_block,
+        out_shape=out_shape_t if multi_out else out_shape,
         scratch_shapes=scratch,
         cost_estimate=pl.CostEstimate(
             flops=2 * n_app * k * nb * br * LANES,
@@ -740,23 +767,27 @@ def _dia_smooth_call(vals_q, dinv_q, taus, b, x, offsets, num_rows,
         # mode trace could outlive the forcing context
         interpret=interpret,
     )(*operands)
-    outs = out if with_residual else (out,)
+    outs = out if multi_out else (out,)
+    vec_outs = outs[:-1] if with_dot else outs
     trimmed = []
-    for o in outs:
+    for o in vec_outs:
         v = o.reshape(-1)
         trimmed.append(v[:n] if v.shape[0] != n else v)
-    return tuple(trimmed) if with_residual else trimmed[0]
+    if with_dot:
+        trimmed.append(jnp.sum(outs[-1]))
+    return tuple(trimmed) if multi_out else trimmed[0]
 
 
 def _dia_stencil_smooth_call(coeffs, taus, b, x, spec, with_residual,
-                             interpret=False):
+                             with_dot=False, interpret=False):
     """Matrix-free fused smoother: the dia_smooth kernel with the
     quota-padded vals/dinv slabs replaced by k SMEM scalars. `spec` is
     the level's StencilSpec (ops.stencil); caller must have checked
     stencil_smooth_supported."""
     return _dia_smooth_call(None, None, taus, b, x, spec.offsets,
                             spec.n, with_residual, mf=spec,
-                            coeffs=coeffs, interpret=interpret)
+                            coeffs=coeffs, with_dot=with_dot,
+                            interpret=interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -1357,7 +1388,7 @@ def _dia_stencil_smooth_restrict_call(coeffs, taus, b, x, xfer, spec,
 def _dia_prolong_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x,
                                win_v, n_steps, has_dinv, n_blocks,
                                slab_shift, ashift, pcw, mp, has_w,
-                               dtype, mf=None):
+                               dtype, mf=None, with_dot=False):
     """Kernel body factory: the dia_smooth body with a prologue that
     folds the coarse correction in — the state window becomes
     x + P xc (gather of the block's coarse window through the
@@ -1394,6 +1425,8 @@ def _dia_prolong_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x,
             off += 2
             y_ref = refs[off]
             off += 1
+            d_ref = refs[off] if with_dot else None
+            off += 1 if with_dot else 0
             xbuf, vbuf, bbuf = refs[off], refs[off + 1], refs[off + 2]
             off += 3
             dbuf = refs[off] if has_dinv else None
@@ -1409,10 +1442,13 @@ def _dia_prolong_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x,
             xcp_ref, atab_ref = refs[2], refs[3]
             coeffs_ref, pcb_ref, taus_ref = refs[4], refs[5], refs[6]
             y_ref = refs[7]
-            xbuf, bbuf = refs[8], refs[9]
+            off = 8
+            d_ref = refs[off] if with_dot else None
+            off += 1 if with_dot else 0
+            xbuf, bbuf = refs[off], refs[off + 1]
             vbuf = dbuf = wbuf = None
-            xcbuf, abuf = refs[10], refs[11]
-            sems = refs[12]
+            xcbuf, abuf = refs[off + 2], refs[off + 3]
+            sems = refs[off + 4]
 
         i = pl.program_id(0)
         slot = jax.lax.rem(i, jnp.int32(2))
@@ -1530,15 +1566,25 @@ def _dia_prolong_smooth_kernel(offsets, br, n_app, mr0, Mr0, win_x,
             s = jnp.concatenate(pieces, axis=0)
         y_ref[...] = jax.lax.slice_in_dim(
             s, n_app * mr0, n_app * mr0 + br, 1, 0).astype(dtype)
+        if with_dot:
+            # cycle-borne reduction: the postsmoothed x' against the
+            # aligned b rows — per-block (1, 128) partials, lanes
+            # combined by the caller's XLA sum
+            xb = jax.lax.slice_in_dim(
+                s, n_app * mr0, n_app * mr0 + br, 1, 0)
+            bb = jax.lax.slice_in_dim(
+                bw, (n_app - 1) * mr0, (n_app - 1) * mr0 + br, 1, 0)
+            d_ref[...] = jnp.sum(xb * bb, axis=0,
+                                 keepdims=True).astype(jnp.float32)
 
     return kernel
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "offsets", "num_rows", "mf", "interpret"))
+    "offsets", "num_rows", "mf", "with_dot", "interpret"))
 def _dia_prolong_smooth_call(vals_q, dinv_q, taus, b, x, xc, xfer,
                              offsets, num_rows, mf=None, coeffs=None,
-                             interpret=False):
+                             with_dot=False, interpret=False):
     """Fused prolongation/correction prologue + postsmoother:
     x' = smooth(b, x + P xc) after len(taus) damped sweeps. Caller
     must have checked dia_prolong_supported. Matrix-free form (`mf` +
@@ -1587,7 +1633,8 @@ def _dia_prolong_smooth_call(vals_q, dinv_q, taus, b, x, xc, xfer,
 
     kernel = _dia_prolong_smooth_kernel(
         offsets, br, n_app, mr0, Mr0, win_x, win_v, n_steps, has_dinv,
-        nb, slab_shift, ashift, pcw, xfer.mp, has_w, dtype, mf=mf)
+        nb, slab_shift, ashift, pcw, xfer.mp, has_w, dtype, mf=mf,
+        with_dot=with_dot)
     if mf is None:
         n_sem = (4 if has_dinv else 3) + 1 \
             + (2 * xfer.mp if has_w else 1)
@@ -1628,6 +1675,12 @@ def _dia_prolong_smooth_call(vals_q, dinv_q, taus, b, x, xc, xfer,
     out_specs = pl.BlockSpec((br, LANES), lambda i: (i, jnp.int32(0)),
                              memory_space=pltpu.VMEM)
     out_shape = jax.ShapeDtypeStruct((nb * br, LANES), dtype)
+    if with_dot:
+        out_specs = (out_specs, pl.BlockSpec(
+            (1, LANES), lambda i: (i, jnp.int32(0)),
+            memory_space=pltpu.VMEM))
+        out_shape = (out_shape, jax.ShapeDtypeStruct((nb, LANES),
+                                                     jnp.float32))
     scratch = [pltpu.VMEM((2, win_x, LANES), dtype)]
     if mf is None:
         scratch.append(pltpu.VMEM((2, k, win_v, LANES), dtype))
@@ -1659,19 +1712,25 @@ def _dia_prolong_smooth_call(vals_q, dinv_q, taus, b, x, xc, xfer,
         ),
         interpret=interpret,
     )(*operands)
+    if with_dot:
+        y2, dparts = y2
     y = y2.reshape(-1)
     if y.shape[0] != n:
         y = y[:n]
+    if with_dot:
+        return y, jnp.sum(dparts)
     return y
 
 
 def _dia_stencil_prolong_smooth_call(coeffs, taus, b, x, xc, xfer,
-                                     spec, interpret=False):
+                                     spec, with_dot=False,
+                                     interpret=False):
     """Matrix-free fused prolongation prologue + postsmoother. Caller
     must have checked stencil_prolong_supported."""
     return _dia_prolong_smooth_call(None, None, taus, b, x, xc, xfer,
                                     spec.offsets, spec.n, mf=spec,
-                                    coeffs=coeffs, interpret=interpret)
+                                    coeffs=coeffs, with_dot=with_dot,
+                                    interpret=interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -1812,18 +1871,26 @@ def _tail_compute(arrs, b, x, spec):
     return run(spec.shape, 0, b, x)
 
 
-def _dia_tail_kernel(spec, treedef, n_leaves, dtype):
+def _dia_tail_kernel(spec, treedef, n_leaves, dtype, with_dot=False):
     def kernel(*refs):
         arrs = jax.tree_util.tree_unflatten(
             treedef, [r[...] for r in refs[:n_leaves]])
         b, x = refs[n_leaves][...], refs[n_leaves + 1][...]
-        refs[n_leaves + 2][...] = _tail_compute(arrs, b, x,
-                                                spec).astype(dtype)
+        out = _tail_compute(arrs, b, x, spec)
+        refs[n_leaves + 2][...] = out.astype(dtype)
+        if with_dot:
+            # everything is VMEM-resident, so the x'.b reduction over
+            # rows is free; lanes combine in the caller's XLA sum
+            refs[n_leaves + 3][...] = jnp.sum(
+                out * b.astype(out.dtype), axis=0,
+                keepdims=True).astype(jnp.float32)
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("spec", "interpret"))
-def _dia_coarse_tail_call(arrs, b, x, spec, interpret=False):
+@functools.partial(jax.jit, static_argnames=("spec", "with_dot",
+                                             "interpret"))
+def _dia_coarse_tail_call(arrs, b, x, spec, with_dot=False,
+                          interpret=False):
     """One grid=(1,) pallas_call running the whole coarse-tail
     sub-cycle with every intermediate vector VMEM-resident — ~10 tiny
     kernel dispatches per cycle become one. Caller (ops.smooth
@@ -1835,7 +1902,8 @@ def _dia_coarse_tail_call(arrs, b, x, spec, interpret=False):
     x2 = jnp.zeros((l0.qc * LANES,), dtype)
     x2 = jax.lax.dynamic_update_slice(x2, x, (0,)).reshape(l0.qc, LANES)
     leaves, treedef = jax.tree_util.tree_flatten(arrs)
-    kernel = _dia_tail_kernel(spec, treedef, len(leaves), dtype)
+    kernel = _dia_tail_kernel(spec, treedef, len(leaves), dtype,
+                              with_dot=with_dot)
 
     def _spec_of(v):
         nd = len(v.shape)
@@ -1846,17 +1914,394 @@ def _dia_coarse_tail_call(arrs, b, x, spec, interpret=False):
                 * ls.qc * LANES for ls in spec.levels)
     byts = sum(int(v.size) * v.dtype.itemsize for v in leaves) \
         + 3 * l0.qc * LANES * 4
+    out_specs = pl.BlockSpec((l0.qc, LANES),
+                             lambda i: (jnp.int32(0), jnp.int32(0)),
+                             memory_space=pltpu.VMEM)
+    out_shape = jax.ShapeDtypeStruct((l0.qc, LANES), dtype)
+    if with_dot:
+        out_specs = (out_specs, pl.BlockSpec(
+            (1, LANES), lambda i: (jnp.int32(0), jnp.int32(0)),
+            memory_space=pltpu.VMEM))
+        out_shape = (out_shape, jax.ShapeDtypeStruct((1, LANES),
+                                                     jnp.float32))
     out = pl.pallas_call(
         kernel,
         grid=(1,),
         in_specs=[_spec_of(v) for v in leaves] + [_spec_of(b2),
                                                   _spec_of(x2)],
-        out_specs=pl.BlockSpec((l0.qc, LANES),
-                               lambda i: (jnp.int32(0), jnp.int32(0)),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((l0.qc, LANES), dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         cost_estimate=pl.CostEstimate(flops=flops, bytes_accessed=byts,
                                       transcendentals=0),
         interpret=interpret,
     )(*leaves, b2, x2)
+    if with_dot:
+        out, dparts = out
+        return out.reshape(-1)[:l0.n], jnp.sum(dparts)
     return out.reshape(-1)[:l0.n]
+
+
+# ---------------------------------------------------------------------------
+# Krylov shell fusion: SpMV + dot epilogues and the single-pass CG
+# update
+#
+# The fused-cycle suite stops at the preconditioner boundary: a
+# CG/PCG iteration still runs a standalone SpMV, three separate dot
+# reductions, and bare axpy updates — each a full n-vector HBM pass
+# outside the cycle. Two kernels close the shell:
+#
+# - SPMV + DOT (`_dia_spmv_dot_call`): A.p with a per-block d.Ap
+#   partial-sum epilogue ((nb, 128) partials, rows reduced in-kernel,
+#   lanes combined by a cheap XLA sum — the restriction-epilogue
+#   pattern), an optional PROLOGUE folding the direction update
+#   p = z + beta*p_prev (beta a scalar in SMEM; the halo rows
+#   recompute the update redundantly so the window stays exact), and
+#   an optional second Ap.Ap self-dot (BiCGStab's t.t). The x-window
+#   layout/DMA pipeline is the plain dia_spmv kernel's; operands
+#   follow the fused-suite dtype rules (f32/bf16 streams, f32
+#   accumulation, f32 partials).
+#
+# - CG UPDATE (`_cg_update_call`): x += alpha p and r -= alpha Ap in
+#   one auto-pipelined elementwise pass with an r'.r' dot epilogue, so
+#   the monitor's residual norm is a free by-product.
+#
+# Padding rows/lanes carry zero vectors (and zero matrix values), so
+# the partial dots are exact without masking.
+# ---------------------------------------------------------------------------
+
+
+def dia_spmv_dot_supported(A, x_dtype) -> bool:
+    """Trace-time gate for the SpMV+dot (Krylov shell) Pallas path.
+    Wider than dia_spmv_supported: bf16 operands are admitted under
+    the fused-suite rules (f32 accumulation)."""
+    if jax.default_backend() != "tpu" and not _FORCE_INTERPRET:
+        return False
+    if not smooth_dtype_ok(A, x_dtype):
+        return False
+    if A.num_rows != A.num_cols:
+        return False
+    k, rows_pad, _ = A.dia_vals.shape
+    left, halo_rows, br = _layout(A.dia_offsets, k, A.num_rows)
+    if rows_pad % br != 0:
+        return False
+    ib = jnp.dtype(x_dtype).itemsize
+    win = br + halo_rows
+    # worst-case variant: beta prologue (2 windows + p output) plus a
+    # streamed dot operand and both partial outputs
+    vmem = 2 * k * br * LANES * ib \
+        + 2 * 2 * win * LANES * ib \
+        + 2 * 3 * br * LANES * ib
+    if ib < 4:
+        vmem += (2 * win + 2 * br) * LANES * 4
+    return vmem <= _VMEM_BUDGET + 4 * 1024 * 1024
+
+
+def _dia_spmv_dot_kernel(offsets, left, br, halo_rows, n_blocks, dtype,
+                         with_beta, with_d, self_dot, mf=None):
+    """Kernel body factory. Window coordinates are the plain dia_spmv
+    kernel's (x row r lives at window row left//128 + r); the dot
+    epilogue reduces rows in-kernel and leaves the 128 lanes to the
+    caller's XLA combine. `with_d` streams a separate dot operand
+    (auto-pipelined block, no halo) in place of p itself."""
+    ro = [(left + o) // LANES for o in offsets]
+    rl = [(left + o) % LANES for o in offsets]
+    win_rows = br + halo_rows
+    prow = left // LANES
+    cdt = compute_dtype(dtype)
+
+    def kernel(*refs):
+        # refs: pp, [zp], vals|coeffs, [d], [beta], [p_out], ap,
+        #       dot, [sdot], pbuf, [zbuf], sems
+        off = 0
+        pp_ref = refs[off]
+        off += 1
+        zp_ref = refs[off] if with_beta else None
+        off += 1 if with_beta else 0
+        if mf is None:
+            vals_ref, coeffs_ref = refs[off], None
+        else:
+            vals_ref, coeffs_ref = None, refs[off]
+        off += 1
+        d_ref = refs[off] if with_d else None
+        off += 1 if with_d else 0
+        beta_ref = refs[off] if with_beta else None
+        off += 1 if with_beta else 0
+        pout_ref = refs[off] if with_beta else None
+        off += 1 if with_beta else 0
+        ap_ref, dot_ref = refs[off], refs[off + 1]
+        off += 2
+        sdot_ref = refs[off] if self_dot else None
+        off += 1 if self_dot else 0
+        pbuf = refs[off]
+        off += 1
+        zbuf = refs[off] if with_beta else None
+        off += 1 if with_beta else 0
+        sems = refs[off]
+
+        i = pl.program_id(0)
+        slot = jax.lax.rem(i, jnp.int32(2))
+
+        def dmas(s, blk):
+            base = jnp.int32(blk) * jnp.int32(br)
+            ops = [pltpu.make_async_copy(
+                pp_ref.at[pl.ds(base, win_rows)],
+                pbuf.at[jnp.int32(s)], sems.at[jnp.int32(s), 0])]
+            if with_beta:
+                ops.append(pltpu.make_async_copy(
+                    zp_ref.at[pl.ds(base, win_rows)],
+                    zbuf.at[jnp.int32(s)], sems.at[jnp.int32(s), 1]))
+            return ops
+
+        @pl.when(i == 0)
+        def _():
+            for d in dmas(0, 0):
+                d.start()
+
+        @pl.when(i + 1 < n_blocks)
+        def _():
+            for d in dmas(jax.lax.rem(i + 1, jnp.int32(2)), i + 1):
+                d.start()
+
+        for d in dmas(slot, i):
+            d.wait()
+
+        if with_beta:
+            # direction-update prologue over the WHOLE window: the
+            # halo rows feed the shifts, so they need the updated p
+            # too (redundant recompute, zero extra HBM)
+            s = zbuf[slot].astype(cdt) \
+                + beta_ref[0] * pbuf[slot].astype(cdt)
+        else:
+            s = pbuf[slot].astype(cdt)
+
+        col = jax.lax.broadcasted_iota(jnp.int32, (br, LANES), 1)
+        if mf is None:
+            def val(t):
+                return vals_ref[t].astype(cdt)
+        else:
+            row0 = i * jnp.int32(br)
+            val, _dw = _mf_block_vals(mf, coeffs_ref, row0, br, col,
+                                      cdt)
+
+        acc = jnp.zeros((br, LANES), cdt)
+        for t, _o in enumerate(offsets):
+            a = jax.lax.slice_in_dim(s, ro[t], ro[t] + br, 1, 0)
+            if rl[t] == 0:
+                w = a
+            else:
+                b2 = jax.lax.slice_in_dim(s, ro[t] + 1, ro[t] + 1 + br,
+                                          1, 0)
+                shift = LANES - rl[t]
+                wa = pltpu.roll(a, jnp.int32(shift), 1)
+                wb = pltpu.roll(b2, jnp.int32(shift), 1)
+                w = jnp.where(col < shift, wa, wb)
+            acc = acc + val(t) * w
+
+        p_blk = jax.lax.slice_in_dim(s, prow, prow + br, 1, 0)
+        if with_beta:
+            pout_ref[...] = p_blk.astype(dtype)
+        ap_ref[...] = acc.astype(dtype)
+        dvec = d_ref[...].astype(cdt) if with_d else p_blk
+        dot_ref[...] = jnp.sum(dvec * acc, axis=0,
+                               keepdims=True).astype(jnp.float32)
+        if self_dot:
+            sdot_ref[...] = jnp.sum(acc * acc, axis=0,
+                                    keepdims=True).astype(jnp.float32)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "offsets", "num_rows", "self_dot", "mf", "interpret"))
+def _dia_spmv_dot_call(dia_vals, p, z, beta, d, offsets, num_rows,
+                       self_dot=False, mf=None, coeffs=None,
+                       interpret=False):
+    """Fused SpMV + dot epilogue. Returns (Ap, d.Ap[, Ap.Ap]) with
+    d = p when no separate dot operand is streamed; with the beta
+    prologue (z is not None), p' = z + beta*p is computed in-window
+    and the returns become (p', Ap', p'.Ap'[, ...]). The dot scalars
+    are LOCAL f32 sums — distributed callers psum them (packed).
+    Caller must have checked dia_spmv_dot_supported (slab mode) or
+    the stencil twin's gate (mf mode)."""
+    with_beta = z is not None
+    with_d = d is not None
+    if mf is None:
+        k, rows_pad, _ = dia_vals.shape
+        dtype = dia_vals.dtype
+    else:
+        k = len(offsets)
+        dtype = p.dtype
+    left, halo_rows, br = _layout(offsets, k, num_rows)
+    if mf is None:
+        nb = rows_pad // br
+    else:
+        rows128 = max(1, -(-num_rows // LANES))
+        nb = -(-rows128 // br)
+        rows_pad = nb * br
+    n = num_rows
+    win_rows = br + halo_rows
+    xp_rows = rows_pad + halo_rows
+    cdt = compute_dtype(dtype)
+
+    def _pad_win(v):
+        vp = jnp.zeros((xp_rows * LANES,), dtype)
+        vp = jax.lax.dynamic_update_slice(vp, v.astype(dtype), (left,))
+        return vp.reshape(xp_rows, LANES)
+
+    in_specs = [pl.BlockSpec(memory_space=pl.ANY)]          # pp
+    operands = [_pad_win(p)]
+    if with_beta:
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))  # zp
+        operands.append(_pad_win(z))
+    if mf is None:
+        in_specs.append(pl.BlockSpec(
+            (k, br, LANES), lambda i: (jnp.int32(0), i, jnp.int32(0)),
+            memory_space=pltpu.VMEM))
+        operands.append(dia_vals)
+    else:
+        in_specs.append(pl.BlockSpec((k,), lambda i: (jnp.int32(0),),
+                                     memory_space=pltpu.SMEM))
+        operands.append(coeffs.astype(cdt))
+    if with_d:
+        dp = jnp.zeros((rows_pad * LANES,), dtype)
+        dp = jax.lax.dynamic_update_slice(dp, d.astype(dtype), (0,))
+        in_specs.append(pl.BlockSpec((br, LANES),
+                                     lambda i: (i, jnp.int32(0)),
+                                     memory_space=pltpu.VMEM))
+        operands.append(dp.reshape(rows_pad, LANES))
+    if with_beta:
+        in_specs.append(pl.BlockSpec((1,), lambda i: (jnp.int32(0),),
+                                     memory_space=pltpu.SMEM))
+        operands.append(jnp.reshape(beta, (1,)).astype(cdt))
+
+    blk = pl.BlockSpec((br, LANES), lambda i: (i, jnp.int32(0)),
+                       memory_space=pltpu.VMEM)
+    part = pl.BlockSpec((1, LANES), lambda i: (i, jnp.int32(0)),
+                        memory_space=pltpu.VMEM)
+    vec_shape = jax.ShapeDtypeStruct((rows_pad, LANES), dtype)
+    part_shape = jax.ShapeDtypeStruct((nb, LANES), jnp.float32)
+    out_specs = ([blk] if with_beta else []) + [blk, part] \
+        + ([part] if self_dot else [])
+    out_shape = ([vec_shape] if with_beta else []) \
+        + [vec_shape, part_shape] + ([part_shape] if self_dot else [])
+
+    scratch = [pltpu.VMEM((2, win_rows, LANES), dtype)]
+    if with_beta:
+        scratch.append(pltpu.VMEM((2, win_rows, LANES), dtype))
+    scratch.append(pltpu.SemaphoreType.DMA((2, 2 if with_beta else 1)))
+
+    kernel = _dia_spmv_dot_kernel(offsets, left, br, halo_rows, nb,
+                                  dtype, with_beta, with_d, self_dot,
+                                  mf=mf)
+    ib = jnp.dtype(dtype).itemsize
+    streams = (0 if mf is not None else k) + 2 * (2 if with_beta else 1) \
+        + (1 if with_d else 0)
+    outs = pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs),
+        out_shape=tuple(out_shape),
+        scratch_shapes=scratch,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * (k + 2) * nb * br * LANES,
+            bytes_accessed=streams * nb * br * LANES * ib,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(*operands)
+    idx = 0
+    res = []
+    for _ in range(2 if with_beta else 1):
+        v = outs[idx].reshape(-1)
+        res.append(v[:n] if v.shape[0] != n else v)
+        idx += 1
+    res.append(jnp.sum(outs[idx]))
+    idx += 1
+    if self_dot:
+        res.append(jnp.sum(outs[idx]))
+    return tuple(res)
+
+
+def dia_spmv_dot(A, p, z=None, beta=None, d=None, self_dot=False,
+                 interpret=False):
+    """Fused DIA SpMV + dot epilogue(s); caller must have checked
+    dia_spmv_dot_supported. See _dia_spmv_dot_call for the return
+    shapes."""
+    return _dia_spmv_dot_call(A.dia_vals, p, z, beta, d,
+                              A.dia_offsets, A.num_rows,
+                              self_dot=self_dot,
+                              interpret=interpret or _FORCE_INTERPRET)
+
+
+def cg_update_supported(x_dtype) -> bool:
+    """Trace-time gate for the single-pass CG update kernel."""
+    if jax.default_backend() != "tpu" and not _FORCE_INTERPRET:
+        return False
+    return jnp.dtype(x_dtype).name in SMOOTH_DTYPES
+
+
+def _cg_update_kernel(dtype):
+    cdt = compute_dtype(dtype)
+
+    def kernel(x_ref, p_ref, r_ref, ap_ref, alpha_ref, xo_ref, ro_ref,
+               rr_ref):
+        a = alpha_ref[0]
+        xn = x_ref[...].astype(cdt) + a * p_ref[...].astype(cdt)
+        rn = r_ref[...].astype(cdt) - a * ap_ref[...].astype(cdt)
+        xo_ref[...] = xn.astype(dtype)
+        ro_ref[...] = rn.astype(dtype)
+        rr_ref[...] = jnp.sum(rn * rn, axis=0,
+                              keepdims=True).astype(jnp.float32)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _cg_update_call(x, p, r, ap, alpha, interpret=False):
+    """Single-pass CG state update: (x + alpha p, r - alpha Ap,
+    r'.r') in one auto-pipelined elementwise kernel — the residual
+    norm the monitor wants becomes a free epilogue instead of a
+    standalone blas.norm stream. The rr scalar is the LOCAL f32 sum.
+    Caller must have checked cg_update_supported."""
+    dtype = x.dtype
+    n = x.shape[0]
+    cdt = compute_dtype(dtype)
+    rows128 = max(1, -(-n // LANES))
+    br = pick_block_rows(6, rows128)
+    nb = -(-rows128 // br)
+    rows_pad = nb * br
+
+    def padv(v):
+        vp = jnp.zeros((rows_pad * LANES,), dtype)
+        vp = jax.lax.dynamic_update_slice(vp, v.astype(dtype), (0,))
+        return vp.reshape(rows_pad, LANES)
+
+    blk = pl.BlockSpec((br, LANES), lambda i: (i, jnp.int32(0)),
+                       memory_space=pltpu.VMEM)
+    part = pl.BlockSpec((1, LANES), lambda i: (i, jnp.int32(0)),
+                        memory_space=pltpu.VMEM)
+    xo, ro, rr = pl.pallas_call(
+        _cg_update_kernel(dtype),
+        grid=(nb,),
+        in_specs=[blk, blk, blk, blk,
+                  pl.BlockSpec((1,), lambda i: (jnp.int32(0),),
+                               memory_space=pltpu.SMEM)],
+        out_specs=(blk, blk, part),
+        out_shape=(jax.ShapeDtypeStruct((rows_pad, LANES), dtype),
+                   jax.ShapeDtypeStruct((rows_pad, LANES), dtype),
+                   jax.ShapeDtypeStruct((nb, LANES), jnp.float32)),
+        cost_estimate=pl.CostEstimate(
+            flops=5 * nb * br * LANES,
+            bytes_accessed=6 * nb * br * LANES
+            * jnp.dtype(dtype).itemsize,
+            transcendentals=0),
+        interpret=interpret,
+    )(padv(x), padv(p), padv(r), padv(ap),
+      jnp.reshape(alpha, (1,)).astype(cdt))
+    xv = xo.reshape(-1)
+    rv = ro.reshape(-1)
+    if xv.shape[0] != n:
+        xv = xv[:n]
+        rv = rv[:n]
+    return xv, rv, jnp.sum(rr)

@@ -670,17 +670,30 @@ def _fused_restrict_fn(has_dinv: bool):
     return call
 
 
+def _xb_dot(y, b):
+    """The x'.b dot epilogue's XLA twin (the cycle-borne r.z of the
+    Krylov shell): accumulation-dtype reduction over the last axis, so
+    the batched/vmapped routes agree with the kernel's f32 partials."""
+    cdt = _ps.compute_dtype(y.dtype)
+    return jnp.sum(y.astype(cdt) * b.astype(cdt), axis=-1)
+
+
 @functools.lru_cache(maxsize=None)
-def _fused_corr_fn(has_dinv: bool):
-    """custom_vmap-wrapped prolongation-prologue+postsmooth call."""
+def _fused_corr_fn(has_dinv: bool, with_dot: bool = False):
+    """custom_vmap-wrapped prolongation-prologue+postsmooth call.
+    `with_dot` appends the x'.b dot epilogue (the Krylov shell's
+    cycle-borne r.z reduction — b IS the preconditioner rhs r and x'
+    IS z, so x'.b = r.z) and makes every route return (x', dot)."""
     tu = jax.tree_util
+    ob = (True, True) if with_dot else True
 
     if has_dinv:
         @jax.custom_batching.custom_vmap
         def call(A, xfer, vals_q, dinv_q, dinv, taus, b, x, xc):
             return _ps._dia_prolong_smooth_call(
                 vals_q, dinv_q, taus, b, x, xc, xfer, A.dia_offsets,
-                A.num_rows, interpret=_ps._FORCE_INTERPRET)
+                A.num_rows, with_dot=with_dot,
+                interpret=_ps._FORCE_INTERPRET)
 
         @call.def_vmap
         def _rule(axis_size, in_batched, A, xfer, vals_q, dinv_q, dinv,
@@ -695,21 +708,26 @@ def _fused_corr_fn(has_dinv: bool):
                     x, (axis_size,) + x.shape)
                 XC = xc if xc_b else jnp.broadcast_to(
                     xc, (axis_size,) + xc.shape)
-                return corr_smooth_dia_multi(A, B, X, XC, taus, dinv,
-                                             xfer), True
+                y = corr_smooth_dia_multi(A, B, X, XC, taus, dinv,
+                                          xfer)
+                return ((y, _xb_dot(y, B)) if with_dot else y), ob
+
+            def fn(A_, xf_, vq_, dq_, dv_, t_, b_, x_, xc_):
+                y_ = _xla_corr_single(A_, t_, b_, x_, xc_, dv_, xf_)
+                return (y_, _xb_dot(y_, b_)) if with_dot else y_
+
             axes = tuple(tu.tree_map(lambda bb: 0 if bb else None, ib)
                          for ib in in_batched)
-            fn = lambda A_, xf_, vq_, dq_, dv_, t_, b_, x_, xc_: \
-                _xla_corr_single(A_, t_, b_, x_, xc_, dv_, xf_)  # noqa: E731
             y = jax.vmap(fn, in_axes=axes, axis_size=axis_size)(
                 A, xfer, vals_q, dinv_q, dinv, taus, b, x, xc)
-            return y, True
+            return y, ob
     else:
         @jax.custom_batching.custom_vmap
         def call(A, xfer, vals_q, taus, b, x, xc):
             return _ps._dia_prolong_smooth_call(
                 vals_q, None, taus, b, x, xc, xfer, A.dia_offsets,
-                A.num_rows, interpret=_ps._FORCE_INTERPRET)
+                A.num_rows, with_dot=with_dot,
+                interpret=_ps._FORCE_INTERPRET)
 
         @call.def_vmap
         def _rule(axis_size, in_batched, A, xfer, vals_q, taus, b, x,
@@ -724,15 +742,19 @@ def _fused_corr_fn(has_dinv: bool):
                     x, (axis_size,) + x.shape)
                 XC = xc if xc_b else jnp.broadcast_to(
                     xc, (axis_size,) + xc.shape)
-                return corr_smooth_dia_multi(A, B, X, XC, taus, None,
-                                             xfer), True
+                y = corr_smooth_dia_multi(A, B, X, XC, taus, None,
+                                          xfer)
+                return ((y, _xb_dot(y, B)) if with_dot else y), ob
+
+            def fn(A_, xf_, vq_, t_, b_, x_, xc_):
+                y_ = _xla_corr_single(A_, t_, b_, x_, xc_, None, xf_)
+                return (y_, _xb_dot(y_, b_)) if with_dot else y_
+
             axes = tuple(tu.tree_map(lambda bb: 0 if bb else None, ib)
                          for ib in in_batched)
-            fn = lambda A_, xf_, vq_, t_, b_, x_, xc_: \
-                _xla_corr_single(A_, t_, b_, x_, xc_, None, xf_)  # noqa: E731
             y = jax.vmap(fn, in_axes=axes, axis_size=axis_size)(
                 A, xfer, vals_q, taus, b, x, xc)
-            return y, True
+            return y, ob
 
     return call
 
@@ -745,13 +767,13 @@ def _restrict_call(A, fused, xfer, taus, b, x, dinv):
                                      b, x)
 
 
-def _corr_call(A, fused, xfer, taus, b, x, xc, dinv):
+def _corr_call(A, fused, xfer, taus, b, x, xc, dinv, with_dot=False):
     if dinv is not None:
-        return _fused_corr_fn(True)(
+        return _fused_corr_fn(True, with_dot)(
             A, xfer, fused["vals_q"], fused["dinv_q"], dinv, taus, b,
             x, xc)
-    return _fused_corr_fn(False)(A, xfer, fused["vals_q"], taus, b, x,
-                                 xc)
+    return _fused_corr_fn(False, with_dot)(A, xfer, fused["vals_q"],
+                                           taus, b, x, xc)
 
 
 def _transfer_ready(data, xfer, dinv):
@@ -801,12 +823,17 @@ def fused_smooth_restrict(data, b, x, taus, xfer, dinv=None):
                           head, dinv)
 
 
-def fused_corr_smooth(data, b, x, xc, taus, xfer, dinv=None):
+def fused_corr_smooth(data, b, x, xc, taus, xfer, dinv=None,
+                      want_dot=False):
     """Fused prolongation/correction + postsmooth: x' after len(taus)
     damped sweeps starting from x + P xc (the correction folded into
     the first kernel's prologue), or None when no fused plan applies.
     Oversized schedules run the prologue chunk first, then chain plain
-    fused sweep chunks."""
+    fused sweep chunks. `want_dot` asks for the cycle-borne x'.b dot
+    (PCG's r.z) from the LAST kernel's epilogue: the single-call route
+    returns (x', dot); the chunked route returns (x', None) — the dot
+    would have to ride a mid-chain kernel, so the caller reduces it
+    with one standalone pass instead."""
     ready = _transfer_ready(data, xfer, dinv)
     if ready is None:
         return None
@@ -820,14 +847,16 @@ def fused_corr_smooth(data, b, x, xc, taus, xfer, dinv=None):
     sup_p = functools.partial(_ps.dia_prolong_supported, A, x.dtype,
                               xfer=xfer)
     if sup_p(n_steps):
-        return _corr_call(A, fused, xfer, taus, b, x, xc, dinv)
+        return _corr_call(A, fused, xfer, taus, b, x, xc, dinv,
+                          with_dot=want_dot)
     head = next((c for c in range(
         min(n_steps - 1, _ps.SMOOTH_MAX_APPS), 0, -1) if sup_p(c)), 0)
     if not head or not _ps.dia_smooth_supported(A, x.dtype, 1, False):
         return None
     x = _corr_call(A, fused, xfer, taus[:head], b, x, xc, dinv)
-    return dia_fused_smooth(A, fused, b, x, taus[head:], dinv=dinv,
-                            with_residual=False)
+    x = dia_fused_smooth(A, fused, b, x, taus[head:], dinv=dinv,
+                         with_residual=False)
+    return (x, None) if want_dot else x
 
 
 # ---------------------------------------------------------------------------
@@ -841,16 +870,19 @@ def _tail_single_xla(arrs, b, x, spec):
 
 
 @functools.lru_cache(maxsize=None)
-def _tail_fn(spec):
+def _tail_fn(spec, with_dot: bool = False):
     """custom_vmap-wrapped coarse-tail call for one static TailSpec:
     vector-only batches (solve_many's shared-hierarchy shape) take the
     slab form in ops/batched.py; batched hierarchies (multi-matrix
-    solves) take the vmapped XLA compose."""
+    solves) take the vmapped XLA compose. `with_dot` appends the x'.b
+    dot epilogue (cycle-borne r.z) on every route."""
     tu = jax.tree_util
+    ob = (True, True) if with_dot else True
 
     @jax.custom_batching.custom_vmap
     def call(arrs, b, x):
         return _ps._dia_coarse_tail_call(arrs, b, x, spec,
+                                         with_dot=with_dot,
                                          interpret=_ps._FORCE_INTERPRET)
 
     @call.def_vmap
@@ -861,13 +893,17 @@ def _tail_fn(spec):
             from .batched import tail_cycle_multi
             B = b if b_b else jnp.broadcast_to(b, (axis_size,) + b.shape)
             X = x if x_b else jnp.broadcast_to(x, (axis_size,) + x.shape)
-            return tail_cycle_multi(arrs, B, X, spec), True
+            y = tail_cycle_multi(arrs, B, X, spec)
+            return ((y, _xb_dot(y, B)) if with_dot else y), ob
+
+        def one(a_, b_, x_):
+            y_ = _tail_single_xla(a_, b_, x_, spec)
+            return (y_, _xb_dot(y_, b_)) if with_dot else y_
+
         axes = tuple(tu.tree_map(lambda bb: 0 if bb else None, ib)
                      for ib in in_batched)
-        y = jax.vmap(lambda a_, b_, x_: _tail_single_xla(a_, b_, x_,
-                                                         spec),
-                     in_axes=axes, axis_size=axis_size)(arrs, b, x)
-        return y, True
+        y = jax.vmap(one, in_axes=axes, axis_size=axis_size)(arrs, b, x)
+        return y, ob
 
     return call
 
@@ -882,7 +918,8 @@ def _tail_taus(taus, dtype):
     return taus.astype(dtype), n
 
 
-def coarse_tail_cycle(amg, shape: str, data, lvl: int, b, x):
+def coarse_tail_cycle(amg, shape: str, data, lvl: int, b, x,
+                      want_dot=False):
     """Run the whole sub-cycle at levels >= lvl as ONE pallas_call with
     every intermediate vector VMEM-resident, or None when the tail is
     ineligible (caller recurses per level). Eligible when: fixed cycle
@@ -890,7 +927,9 @@ def coarse_tail_cycle(amg, shape: str, data, lvl: int, b, x):
     transfer+fused slabs and a fused-capable smoother, the coarse
     solver is NOSOLVER or exposes its dense inverse, the entry level is
     under cycle_fusion_tail_rows, and everything fits the VMEM budget
-    together."""
+    together. `want_dot` (Krylov shell) makes the megakernel also emit
+    the x'.b dot — the whole-cycle-resident case's cycle-borne r.z —
+    and the return becomes (x', dot)."""
     if shape not in ("V", "W", "F") or not fused_runtime_on():
         return None
     if jnp.dtype(x.dtype).name not in _ps.SMOOTH_DTYPES:
@@ -996,4 +1035,4 @@ def coarse_tail_cycle(amg, shape: str, data, lvl: int, b, x):
     # per-level activity table reads it back (telemetry/report.py)
     prev = getattr(amg, "_tail_entry_level", None)
     amg._tail_entry_level = lvl if prev is None else min(prev, lvl)
-    return _tail_fn(spec)(tuple(arrs), b, x)
+    return _tail_fn(spec, want_dot)(tuple(arrs), b, x)

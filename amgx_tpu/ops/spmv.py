@@ -16,6 +16,8 @@ einsum so XLA can batch them onto the MXU.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -168,6 +170,129 @@ def spmv(A, x: jax.Array) -> jax.Array:
     if A.ell_cols is not None:
         return _fault.corrupt_spmv(spmv_ell(A, x))
     return _fault.corrupt_spmv(spmv_csr_segsum(A, x))
+
+
+# ---------------------------------------------------------------------------
+# Krylov shell fusion dispatch: SpMV with dot epilogue (+ optional
+# direction-update prologue). The Pallas kernel runs under the same
+# custom_vmap contract as the fused smoother suite: vector-only vmap
+# batches (solve_many) take the multi-RHS slab forms in ops/batched.py,
+# batched matrices take the vmapped XLA compose. The returned dot
+# scalars are LOCAL sums — distributed callers psum them (packed,
+# blas.psum_bundle).
+# ---------------------------------------------------------------------------
+
+
+def _spmv_pdot_xla(A, p, z, beta):
+    """Unfused XLA compose of the prologue variant — exactly the
+    pre-fusion expressions, so the f64 route of a `krylov_fusion=1`
+    solver reproduces the unfused arithmetic identically."""
+    p = (z + beta * p).astype(p.dtype)
+    ap = spmv(A, p)
+    return p, ap, jnp.vdot(p, ap)
+
+
+def _spmv_ddot_xla(A, p, d, self_dot):
+    ap = spmv(A, p)
+    out = (ap, jnp.vdot(d, ap))
+    if self_dot:
+        out = out + (jnp.vdot(ap, ap),)
+    return out
+
+
+def _bcast(v, batched, axis_size):
+    return v if batched else jnp.broadcast_to(
+        v, (axis_size,) + jnp.shape(v))
+
+
+@functools.lru_cache(maxsize=None)
+def _spmv_pdot_fn():
+    tu = jax.tree_util
+
+    @jax.custom_batching.custom_vmap
+    def call(A, p, z, beta):
+        from .pallas_spmv import dia_spmv_dot
+        return dia_spmv_dot(A, p, z=z, beta=beta)
+
+    @call.def_vmap
+    def _rule(axis_size, in_batched, A, p, z, beta):
+        mat_b = any(tu.tree_leaves(in_batched[0]))
+        if not mat_b:
+            from .batched import spmv_dot_multi
+            return (spmv_dot_multi(
+                A, _bcast(p, in_batched[1], axis_size),
+                _bcast(z, in_batched[2], axis_size),
+                _bcast(beta, in_batched[3], axis_size)),
+                (True, True, True))
+        axes = tuple(tu.tree_map(lambda bb: 0 if bb else None, ib)
+                     for ib in in_batched)
+        y = jax.vmap(_spmv_pdot_xla, in_axes=axes,
+                     axis_size=axis_size)(A, p, z, beta)
+        return y, (True, True, True)
+
+    return call
+
+
+@functools.lru_cache(maxsize=None)
+def _spmv_ddot_fn(self_dot: bool):
+    tu = jax.tree_util
+    ob = (True,) * (3 if self_dot else 2)
+
+    @jax.custom_batching.custom_vmap
+    def call(A, p, d):
+        from .pallas_spmv import dia_spmv_dot
+        return dia_spmv_dot(A, p, d=d, self_dot=self_dot)
+
+    @call.def_vmap
+    def _rule(axis_size, in_batched, A, p, d):
+        mat_b = any(tu.tree_leaves(in_batched[0]))
+        if not mat_b:
+            from .batched import spmv_dot_multi
+            return (spmv_dot_multi(
+                A, _bcast(p, in_batched[1], axis_size),
+                D=_bcast(d, in_batched[2], axis_size),
+                self_dot=self_dot), ob)
+        axes = tuple(tu.tree_map(lambda bb: 0 if bb else None, ib)
+                     for ib in in_batched)
+        fn = lambda A_, p_, d_: _spmv_ddot_xla(A_, p_, d_, self_dot)  # noqa: E731
+        y = jax.vmap(fn, in_axes=axes, axis_size=axis_size)(A, p, d)
+        return y, ob
+
+    return call
+
+
+def _shell_kernel_ok(A, dtype) -> bool:
+    from .pallas_spmv import dia_spmv_dot_supported
+    return (isinstance(A, CsrMatrix) and not A.is_block
+            and getattr(A, "dia_vals", None) is not None
+            and dia_spmv_dot_supported(A, dtype))
+
+
+def spmv_pdot(A, p, z, beta):
+    """Fused direction-update + SpMV + dot: p' = z + beta p,
+    Ap' = A @ p', and the LOCAL p'.Ap' scalar — one HBM pass over p/z
+    plus the values stream when the Pallas shell kernel applies, the
+    exact unfused XLA compose otherwise (f64, CPU, non-DIA layouts,
+    distributed operators)."""
+    from ..telemetry import metrics as _tm
+    if _shell_kernel_ok(A, p.dtype):
+        _tm.inc("krylov.fused_dispatch")
+        return _spmv_pdot_fn()(A, p, z, beta)
+    _tm.inc("krylov.fused_declined")
+    return _spmv_pdot_xla(A, p, z, beta)
+
+
+def spmv_ddot(A, p, d, self_dot: bool = False):
+    """Fused SpMV + dot against a streamed operand: Ap = A @ p with
+    the LOCAL d.Ap scalar (and Ap.Ap when `self_dot` — BiCGStab's
+    t.s / t.t pair) from the kernel epilogue; the exact unfused XLA
+    compose otherwise."""
+    from ..telemetry import metrics as _tm
+    if _shell_kernel_ok(A, p.dtype):
+        _tm.inc("krylov.fused_dispatch")
+        return _spmv_ddot_fn(self_dot)(A, p, d)
+    _tm.inc("krylov.fused_declined")
+    return _spmv_ddot_xla(A, p, d, self_dot)
 
 
 def multiply(A: CsrMatrix, x: jax.Array, view: str = "OWNED") -> jax.Array:

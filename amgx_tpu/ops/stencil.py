@@ -412,14 +412,22 @@ def _restrict_fn(spec):
     return call
 
 
+def _xb_dot(y, b):
+    """XLA twin of the x'.b dot epilogue (cycle-borne r.z),
+    accumulation-dtype like the kernel's f32 partials."""
+    cdt = _ps.compute_dtype(y.dtype)
+    return jnp.vdot(y.astype(cdt), b.astype(cdt))
+
+
 @functools.lru_cache(maxsize=None)
-def _corr_fn(spec):
+def _corr_fn(spec, with_dot: bool = False):
     tu = jax.tree_util
+    ob = (True, True) if with_dot else True
 
     @jax.custom_batching.custom_vmap
     def call(coeffs, taus, b, x, xc, xfer):
         return _ps._dia_stencil_prolong_smooth_call(
-            coeffs, taus, b, x, xc, xfer, spec,
+            coeffs, taus, b, x, xc, xfer, spec, with_dot=with_dot,
             interpret=_ps._FORCE_INTERPRET)
 
     @call.def_vmap
@@ -434,11 +442,12 @@ def _corr_fn(spec):
             # content region of the quota-padded aggregate-id slab
             aggc = jax.lax.slice_in_dim(
                 xf_.atab, aqf, aqf + rows, 1, 0).reshape(-1)[:spec.n]
-            return _xla_corr(spec, c_, t_, b_, x_, xc_, aggc)
+            y_ = _xla_corr(spec, c_, t_, b_, x_, xc_, aggc)
+            return (y_, _xb_dot(y_, b_)) if with_dot else y_
 
         y = jax.vmap(one, in_axes=axes, axis_size=axis_size)(
             coeffs, taus, b, x, xc, xfer)
-        return y, True
+        return y, ob
 
     return call
 
@@ -520,10 +529,14 @@ def stencil_smooth_restrict(st: StencilOperator, taus, b, x, xfer):
                               head, xfer)
 
 
-def stencil_corr_smooth(st: StencilOperator, taus, b, x, xc, xfer):
+def stencil_corr_smooth(st: StencilOperator, taus, b, x, xc, xfer,
+                        want_dot: bool = False):
     """Matrix-free prolongation/correction prologue + postsmooth: x'
     starting from x + P xc, or None when no fused transfer plan
-    applies."""
+    applies. With want_dot, returns (x', dot) where dot is the x'.b
+    epilogue (the cycle-borne r.z); the head-chunked route declines
+    the dot — returns (x', None) — since only the final application
+    could carry it and that is the plain smoother kernel."""
     if xfer is None or xfer.ptab is not None:
         return None
     spec = st.spec()
@@ -532,15 +545,122 @@ def stencil_corr_smooth(st: StencilOperator, taus, b, x, xc, xfer):
     if n_steps < 1:
         return None
     if stencil_prolong_supported(spec, x.dtype, n_steps, xfer):
-        return _corr_fn(spec)(st.coeffs, taus, b, x, xc, xfer)
+        return _corr_fn(spec, want_dot)(st.coeffs, taus, b, x, xc, xfer)
     head = next((c for c in range(
         min(n_steps - 1, _ps.SMOOTH_MAX_APPS), 0, -1)
         if stencil_prolong_supported(spec, x.dtype, c, xfer)), 0)
     if not head:
         return None
     x = _corr_fn(spec)(st.coeffs, taus[:head], b, x, xc, xfer)
-    return stencil_fused_smooth(st, taus[head:], b, x,
-                                with_residual=False)
+    x = stencil_fused_smooth(st, taus[head:], b, x,
+                             with_residual=False)
+    return (x, None) if want_dot else x
+
+
+# ---------------------------------------------------------------------------
+# Krylov shell fusion: coeffs-mode SpMV + dot twin
+# ---------------------------------------------------------------------------
+
+
+def stencil_spmv_dot_supported(spec, x_dtype) -> bool:
+    """Trace-time gate for the coeffs-mode SpMV+dot shell kernel: the
+    slab gate's VMEM model minus the vanished values stream, plus the
+    mask/coordinate working set."""
+    if not _runtime_on() or not _dtype_ok(x_dtype):
+        return False
+    k = len(spec.offsets)
+    left, halo_rows, br = _ps._layout(spec.offsets, k, spec.n)
+    ib = jnp.dtype(x_dtype).itemsize
+    win = br + halo_rows
+    vmem = 2 * 2 * win * _ps.LANES * ib \
+        + 2 * 3 * br * _ps.LANES * ib \
+        + _ps._MF_WORK_ROWS * br * _ps.LANES * 4
+    if ib < 4:
+        vmem += (2 * win + 2 * br) * _ps.LANES * 4
+    return vmem <= _ps._VMEM_BUDGET + 4 * 1024 * 1024
+
+
+def _xla_spmv_dot(spec, coeffs, p, z, beta, d, self_dot):
+    """Unfused masked-coefficient compose of the shell kernel — the
+    f64 / batched route; the dots are plain vdots, so the f64 parity
+    reference is the exact unfused arithmetic."""
+    if z is not None:
+        p = (z + beta * p).astype(p.dtype)
+    ap = _apply_vec(spec, coeffs, p)
+    dvec = p if d is None else d
+    out = (ap, jnp.vdot(dvec, ap)) if z is None \
+        else (p, ap, jnp.vdot(dvec, ap))
+    if self_dot:
+        out = out + (jnp.vdot(ap, ap),)
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def _spmv_pdot_mf_fn(spec):
+    tu = jax.tree_util
+
+    @jax.custom_batching.custom_vmap
+    def call(coeffs, p, z, beta):
+        if stencil_spmv_dot_supported(spec, p.dtype):
+            return _ps._dia_spmv_dot_call(
+                None, p, z, beta, None, spec.offsets, spec.n,
+                mf=spec, coeffs=coeffs,
+                interpret=_ps._FORCE_INTERPRET)
+        return _xla_spmv_dot(spec, coeffs, p, z, beta, None, False)
+
+    @call.def_vmap
+    def _rule(axis_size, in_batched, coeffs, p, z, beta):
+        # no value stream exists to share, so every batch (coefficient
+        # or vector) takes the vmapped masked compose
+        axes = tuple(tu.tree_map(lambda bb: 0 if bb else None, ib)
+                     for ib in in_batched)
+        fn = lambda c_, p_, z_, b_: _xla_spmv_dot(  # noqa: E731
+            spec, c_, p_, z_, b_, None, False)
+        y = jax.vmap(fn, in_axes=axes, axis_size=axis_size)(
+            coeffs, p, z, beta)
+        return y, (True, True, True)
+
+    return call
+
+
+@functools.lru_cache(maxsize=None)
+def _spmv_ddot_mf_fn(spec, self_dot: bool):
+    tu = jax.tree_util
+    ob = (True,) * (3 if self_dot else 2)
+
+    @jax.custom_batching.custom_vmap
+    def call(coeffs, p, d):
+        if stencil_spmv_dot_supported(spec, p.dtype):
+            return _ps._dia_spmv_dot_call(
+                None, p, None, None, d, spec.offsets, spec.n,
+                self_dot=self_dot, mf=spec, coeffs=coeffs,
+                interpret=_ps._FORCE_INTERPRET)
+        return _xla_spmv_dot(spec, coeffs, p, None, None, d, self_dot)
+
+    @call.def_vmap
+    def _rule(axis_size, in_batched, coeffs, p, d):
+        axes = tuple(tu.tree_map(lambda bb: 0 if bb else None, ib)
+                     for ib in in_batched)
+        fn = lambda c_, p_, d_: _xla_spmv_dot(  # noqa: E731
+            spec, c_, p_, None, None, d_, self_dot)
+        y = jax.vmap(fn, in_axes=axes, axis_size=axis_size)(
+            coeffs, p, d)
+        return y, ob
+
+    return call
+
+
+def stencil_spmv_pdot(st: StencilOperator, p, z, beta):
+    """Coeffs-mode twin of ops.spmv.spmv_pdot: p' = z + beta p,
+    Ap' and the LOCAL p'.Ap' scalar with NO A value stream at all
+    (masks synthesized from k SMEM scalars)."""
+    return _spmv_pdot_mf_fn(st.spec())(st.coeffs, p, z, beta)
+
+
+def stencil_spmv_ddot(st: StencilOperator, p, d, self_dot: bool = False):
+    """Coeffs-mode twin of ops.spmv.spmv_ddot: Ap and the LOCAL d.Ap
+    (and Ap.Ap when `self_dot`) from the kernel epilogue."""
+    return _spmv_ddot_mf_fn(st.spec(), self_dot)(st.coeffs, p, d)
 
 
 # ---------------------------------------------------------------------------

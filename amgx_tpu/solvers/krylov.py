@@ -14,7 +14,7 @@ import jax.numpy as jnp
 
 from .. import registry
 from ..ops import blas
-from ..ops.spmv import spmv
+from ..ops.spmv import spmv, spmv_pdot, spmv_ddot
 from .base import Solver
 
 
@@ -22,11 +22,47 @@ def _safe_div(a, b):
     return a / jnp.where(b == 0, 1.0, b) * (b != 0)
 
 
+def _ldot(a, b):
+    """LOCAL dot in f32+ accumulation (the epilogue dtype of the fused
+    shell kernels); fused iterations finish their LOCAL scalars with
+    ONE packed collective (blas.psum_bundle) instead of per-dot psums."""
+    cdt = jnp.promote_types(a.dtype, jnp.float32)
+    return jnp.vdot(a.astype(cdt), b.astype(cdt))
+
+
 class _KrylovBase(Solver):
+    def __init__(self, cfg, scope="default", name="?"):
+        super().__init__(cfg, scope, name)
+        # Krylov shell fusion (ops/spmv.spmv_pdot / blas.cg_update /
+        # the preconditioner's cycle-borne r.z): 0 restores the
+        # unfused SpMV + BLAS-1 composition bit-for-bit
+        self.krylov_fusion = bool(int(cfg.get("krylov_fusion", scope)))
+
     def _precond(self, data, r):
         if self.preconditioner is not None:
             return self.preconditioner.apply(data["precond"], r)
         return r
+
+    def _precond_dot(self, data, r):
+        """(z, LOCAL r.z): the dot rides the preconditioner
+        application's last kernel when it can (AMG cycle_dot — the
+        cycle's output IS z and its rhs IS r), the explicit local
+        reduction otherwise; identity preconditioner gives (r, r.r)."""
+        if self.preconditioner is None:
+            return r, _ldot(r, r)
+        z, d = self.preconditioner.apply_dot(data["precond"], r)
+        if d is None:
+            d = _ldot(r, z)
+        return z, d
+
+    def _l2_scalar_norm(self) -> bool:
+        """True when the driver's monitored norm is the plain scalar L2
+        — the only shape a solver-maintained r.r scalar can stand in
+        for (internal_res_norm)."""
+        if self.norm_type.upper() != "L2":
+            return False
+        bs = self.A.block_dimx if self.A is not None else 1
+        return bs <= 1 or self.use_scalar_norm
 
 
 @registry.solvers.register("CG")
@@ -34,9 +70,19 @@ class CGSolver(_KrylovBase):
     """Unpreconditioned conjugate gradients (cg_solver.cu)."""
 
     def solve_init(self, data, b, x, r):
+        if self.krylov_fusion:
+            # fused state seeds the direction-update PROLOGUE: the
+            # first iteration's p' = z + beta p with z=r, beta=0, p=0
+            # reproduces the unfused p0 = r inside the SpMV kernel
+            (rz,) = blas.psum_bundle((_ldot(r, r),))
+            return {"p": jnp.zeros_like(r),
+                    "beta": jnp.zeros((), rz.dtype), "rz": rz,
+                    **self._guard_init()}
         return {"p": r, "rz": blas.dot(r, r), **self._guard_init()}
 
     def solve_iteration(self, data, b, st):
+        if self.krylov_fusion:
+            return self._fused_iteration(data, st)
         A = data["A"]
         x, r, p, rz = st["x"], st["r"], st["p"], st["rz"]
         Ap = spmv(A, p)
@@ -55,6 +101,33 @@ class CGSolver(_KrylovBase):
             out["breakdown"] = pAp <= 0
         return out
 
+    def _fused_iteration(self, data, st):
+        """Two single-pass kernels per iteration: (p', Ap', p'.Ap')
+        with the direction update folded in as a prologue, then
+        (x', r', r'.r') — every n-vector is read once per kernel and
+        the iteration's scalars psum in at most two packed bundles."""
+        A = data["A"]
+        x, r, rz = st["x"], st["r"], st["rz"]
+        p, Ap, pAp = spmv_pdot(A, st["p"], r, st["beta"])
+        (pAp,) = blas.psum_bundle((pAp,))
+        alpha = _safe_div(rz, pAp)
+        x, r, rr = blas.cg_update(x, p, r, Ap, alpha)
+        (rz_new,) = blas.psum_bundle((rr,))
+        beta = _safe_div(rz_new, rz)
+        out = {**st, "x": x, "r": r, "p": p, "rz": rz_new,
+               "beta": beta}
+        if self.health_guards:
+            out["breakdown"] = pAp <= 0
+        return out
+
+    def internal_res_norm(self, state):
+        # CG's rz IS r.r — the monitored scalar L2 norm squared — on
+        # BOTH routes, so the driver's standalone blas.norm(r)
+        # full-vector pass is dead code under the monitor
+        if not self._l2_scalar_norm():
+            return None
+        return jnp.sqrt(state["rz"])
+
 
 @registry.solvers.register("PCG")
 class PCGSolver(_KrylovBase):
@@ -63,11 +136,19 @@ class PCGSolver(_KrylovBase):
     uses_preconditioner = True
 
     def solve_init(self, data, b, x, r):
+        if self.krylov_fusion:
+            z, rz_l = self._precond_dot(data, r)
+            rr, rz = blas.psum_bundle((_ldot(r, r), rz_l))
+            return {"p": jnp.zeros_like(r), "z": z,
+                    "beta": jnp.zeros((), rz.dtype), "rz": rz,
+                    "rr": rr, **self._guard_init()}
         z = self._precond(data, r)
         return {"p": z, "z": z, "rz": blas.dot(r, z),
                 **self._guard_init()}
 
     def solve_iteration(self, data, b, st):
+        if self.krylov_fusion:
+            return self._fused_iteration(data, st)
         A = data["A"]
         x, r, p, rz = st["x"], st["r"], st["p"], st["rz"]
         Ap = spmv(A, p)
@@ -84,6 +165,34 @@ class PCGSolver(_KrylovBase):
             out["breakdown"] = pAp <= 0
         return out
 
+    def _fused_iteration(self, data, st):
+        """Fused-hierarchy PCG iteration: the p-update+SpMV+p.Ap
+        kernel, the x/r-update+r.r kernel, and r.z riding the
+        preconditioner cycle's last kernel — zero standalone
+        full-vector reductions, and the post-alpha scalars (r.r, r.z)
+        share ONE packed psum."""
+        A = data["A"]
+        x, r, rz = st["x"], st["r"], st["rz"]
+        p, Ap, pAp = spmv_pdot(A, st["p"], st["z"], st["beta"])
+        (pAp,) = blas.psum_bundle((pAp,))
+        alpha = _safe_div(rz, pAp)
+        x, r, rr = blas.cg_update(x, p, r, Ap, alpha)
+        z, rz_l = self._precond_dot(data, r)
+        rr, rz_new = blas.psum_bundle((rr, rz_l))
+        beta = _safe_div(rz_new, rz)
+        out = {**st, "x": x, "r": r, "p": p, "z": z, "rz": rz_new,
+               "rr": rr, "beta": beta}
+        if self.health_guards:
+            out["breakdown"] = pAp <= 0
+        return out
+
+    def internal_res_norm(self, state):
+        # the fused route's r.r exits the x/r-update kernel's epilogue
+        # — the monitor's norm costs zero extra passes
+        if "rr" not in state or not self._l2_scalar_norm():
+            return None
+        return jnp.sqrt(state["rr"])
+
 
 @registry.solvers.register("PCGF")
 class PCGFSolver(_KrylovBase):
@@ -93,11 +202,19 @@ class PCGFSolver(_KrylovBase):
     uses_preconditioner = True
 
     def solve_init(self, data, b, x, r):
+        if self.krylov_fusion:
+            z, rz_l = self._precond_dot(data, r)
+            rr, rz = blas.psum_bundle((_ldot(r, r), rz_l))
+            return {"p": jnp.zeros_like(r), "z": z,
+                    "beta": jnp.zeros((), rz.dtype), "rz": rz,
+                    "rr": rr, **self._guard_init()}
         z = self._precond(data, r)
         return {"p": z, "z": z, "r_old": r, "rz": blas.dot(r, z),
                 **self._guard_init()}
 
     def solve_iteration(self, data, b, st):
+        if self.krylov_fusion:
+            return self._fused_iteration(data, st)
         A = data["A"]
         x, r, p, rz = st["x"], st["r"], st["p"], st["rz"]
         Ap = spmv(A, p)
@@ -116,18 +233,51 @@ class PCGFSolver(_KrylovBase):
             out["breakdown"] = pAp <= 0
         return out
 
+    def _fused_iteration(self, data, st):
+        """Fused flexible PCG: same two shell kernels + cycle-borne
+        r.z as PCG; the Polak-Ribiere numerator <z, r_new - r> is the
+        one reduction the kernels cannot absorb (it needs the OLD r
+        after the new one exists) and packs into the same psum bundle."""
+        A = data["A"]
+        x, r, rz = st["x"], st["r"], st["rz"]
+        p, Ap, pAp = spmv_pdot(A, st["p"], st["z"], st["beta"])
+        (pAp,) = blas.psum_bundle((pAp,))
+        alpha = _safe_div(rz, pAp)
+        x, r_new, rr = blas.cg_update(x, p, r, Ap, alpha)
+        z, rz_l = self._precond_dot(data, r_new)
+        dz_l = _ldot(r_new - r, z)
+        rr, rz_new, dz = blas.psum_bundle((rr, rz_l, dz_l))
+        beta = _safe_div(dz, rz)
+        out = {**st, "x": x, "r": r_new, "p": p, "z": z, "rz": rz_new,
+               "rr": rr, "beta": beta}
+        if self.health_guards:
+            out["breakdown"] = pAp <= 0
+        return out
+
+    def internal_res_norm(self, state):
+        if "rr" not in state or not self._l2_scalar_norm():
+            return None
+        return jnp.sqrt(state["rr"])
+
 
 @registry.solvers.register("BICGSTAB")
 class BiCGStabSolver(_KrylovBase):
     """BiCGStab (bicgstab_solver.cu)."""
 
     def solve_init(self, data, b, x, r):
-        one = jnp.ones((), r.dtype)
+        if self.krylov_fusion:
+            (rho,) = blas.psum_bundle((_ldot(r, r),))
+            one = jnp.ones((), rho.dtype)
+        else:
+            rho = blas.dot(r, r)
+            one = jnp.ones((), r.dtype)
         return {"r_tld": r, "p": r, "v": jnp.zeros_like(r),
-                "rho": blas.dot(r, r), "alpha": one, "omega": one,
+                "rho": rho, "alpha": one, "omega": one,
                 **self._guard_init()}
 
     def solve_iteration(self, data, b, st):
+        if self.krylov_fusion:
+            return self._fused_iteration(data, st)
         A = data["A"]
         x, r = st["x"], st["r"]
         r_tld, p, rho = st["r_tld"], st["p"], st["rho"]
@@ -149,6 +299,33 @@ class BiCGStabSolver(_KrylovBase):
             out["breakdown"] = (rho_new == 0) | (omega == 0)
         return out
 
+    def _fused_iteration(self, data, st):
+        """Both SpMVs carry their dots as kernel epilogues: r_tld.v
+        with v = A p, and the t.s / t.t PAIR with t = A s (self_dot)
+        — four standalone full-vector reductions become two epilogue
+        reads plus the one rho dot the kernels cannot see."""
+        A = data["A"]
+        x, r = st["x"], st["r"]
+        r_tld, p, rho = st["r_tld"], st["p"], st["rho"]
+        v, rtv = spmv_ddot(A, p, r_tld)
+        (rtv,) = blas.psum_bundle((rtv,))
+        alpha = _safe_div(rho, rtv)
+        s = r - alpha.astype(r.dtype) * v
+        t, ts, tt = spmv_ddot(A, s, s, self_dot=True)
+        ts, tt = blas.psum_bundle((ts, tt))
+        omega = _safe_div(ts, tt)
+        w = omega.astype(r.dtype)
+        x = x + alpha.astype(r.dtype) * p + w * s
+        r = s - w * t
+        (rho_new,) = blas.psum_bundle((_ldot(r_tld, r),))
+        beta = _safe_div(rho_new * alpha, rho * omega)
+        p = r + beta.astype(r.dtype) * (p - w * v)
+        out = {**st, "x": x, "r": r, "p": p, "v": v, "rho": rho_new,
+               "alpha": alpha, "omega": omega}
+        if self.health_guards:
+            out["breakdown"] = (rho_new == 0) | (omega == 0)
+        return out
+
 
 @registry.solvers.register("PBICGSTAB")
 class PBiCGStabSolver(_KrylovBase):
@@ -157,12 +334,19 @@ class PBiCGStabSolver(_KrylovBase):
     uses_preconditioner = True
 
     def solve_init(self, data, b, x, r):
-        one = jnp.ones((), r.dtype)
+        if self.krylov_fusion:
+            (rho,) = blas.psum_bundle((_ldot(r, r),))
+            one = jnp.ones((), rho.dtype)
+        else:
+            rho = blas.dot(r, r)
+            one = jnp.ones((), r.dtype)
         return {"r_tld": r, "p": r, "v": jnp.zeros_like(r),
-                "rho": blas.dot(r, r), "alpha": one, "omega": one,
+                "rho": rho, "alpha": one, "omega": one,
                 **self._guard_init()}
 
     def solve_iteration(self, data, b, st):
+        if self.krylov_fusion:
+            return self._fused_iteration(data, st)
         A = data["A"]
         x, r = st["x"], st["r"]
         r_tld, rho = st["r_tld"], st["rho"]
@@ -179,6 +363,36 @@ class PBiCGStabSolver(_KrylovBase):
         rho_new = blas.dot(r_tld, r)
         beta = _safe_div(rho_new * alpha, rho * omega)
         p = r + beta * (p - omega * v)
+        out = {**st, "x": x, "r": r, "p": p, "v": v, "rho": rho_new,
+               "alpha": alpha, "omega": omega}
+        if self.health_guards:
+            out["breakdown"] = (rho_new == 0) | (omega == 0)
+        return out
+
+    def _fused_iteration(self, data, st):
+        """Preconditioned twin of BiCGStab's fused iteration: both
+        SpMVs act on preconditioned vectors while the dot operands
+        (r_tld, s) stream through the kernels' epilogue slot."""
+        A = data["A"]
+        x, r = st["x"], st["r"]
+        r_tld, rho = st["r_tld"], st["rho"]
+        p = st["p"]
+        p_hat = self._precond(data, p)
+        v, rtv = spmv_ddot(A, p_hat, r_tld)
+        (rtv,) = blas.psum_bundle((rtv,))
+        alpha = _safe_div(rho, rtv)
+        a = alpha.astype(r.dtype)
+        s = r - a * v
+        s_hat = self._precond(data, s)
+        t, ts, tt = spmv_ddot(A, s_hat, s, self_dot=True)
+        ts, tt = blas.psum_bundle((ts, tt))
+        omega = _safe_div(ts, tt)
+        w = omega.astype(r.dtype)
+        x = x + a * p_hat + w * s_hat
+        r = s - w * t
+        (rho_new,) = blas.psum_bundle((_ldot(r_tld, r),))
+        beta = _safe_div(rho_new * alpha, rho * omega)
+        p = r + beta.astype(r.dtype) * (p - w * v)
         out = {**st, "x": x, "r": r, "p": p, "v": v, "rho": rho_new,
                "alpha": alpha, "omega": omega}
         if self.health_guards:

@@ -312,7 +312,8 @@ class ChebyshevPolySolver(Solver):
                 xfer)
         return None
 
-    def smooth_corr(self, data, b, x, xc, sweeps: int, xfer):
+    def smooth_corr(self, data, b, x, xc, sweeps: int, xfer,
+                    want_dot: bool = False):
         if sweeps < 1:
             return None
         st = data.get("stencil")
@@ -320,12 +321,12 @@ class ChebyshevPolySolver(Solver):
             from ..ops import stencil as mf
             return mf.stencil_corr_smooth(
                 st, self._fused_taus(data, sweeps, x.dtype), b, x, xc,
-                xfer)
+                xfer, want_dot=want_dot)
         if self.fused_smoother:
             from ..ops import smooth as fused
             return fused.fused_corr_smooth(
                 data, b, x, xc, self._fused_taus(data, sweeps, x.dtype),
-                xfer)
+                xfer, want_dot=want_dot)
         return None
 
     def fused_tail_spec(self, data, sweeps: int, dtype):

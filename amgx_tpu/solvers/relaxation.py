@@ -121,20 +121,23 @@ class _FusedJacobiMixin:
                 dinv=data["dinv"])
         return None
 
-    def smooth_corr(self, data, b, x, xc, sweeps: int, xfer):
+    def smooth_corr(self, data, b, x, xc, sweeps: int, xfer,
+                    want_dot: bool = False):
         """smooth(b, x + P xc) with the correction folded into the
-        first kernel application, or None."""
+        first kernel application, or None. want_dot additionally
+        requests the x'.b dot epilogue → (x', dot|None)."""
         if sweeps < 1:
             return None
         st = data.get("stencil")
         if st is not None:
             from ..ops import stencil as mf
             return mf.stencil_corr_smooth(
-                st, self._fused_taus(sweeps, x.dtype), b, x, xc, xfer)
+                st, self._fused_taus(sweeps, x.dtype), b, x, xc, xfer,
+                want_dot=want_dot)
         if self._fused_eligible(data):
             return fused.fused_corr_smooth(
                 data, b, x, xc, self._fused_taus(sweeps, x.dtype),
-                xfer, dinv=data["dinv"])
+                xfer, dinv=data["dinv"], want_dot=want_dot)
         return None
 
     def fused_tail_spec(self, data, sweeps: int, dtype):

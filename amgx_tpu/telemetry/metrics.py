@@ -533,6 +533,20 @@ declare_counter("fusion.declined_dtype",
                 "SMOOTH_DTYPES) — the config fell back to the unfused "
                 "composition; see SolveReport levels[].fused_routing")
 
+# Krylov shell fusion routing (ops/spmv.spmv_pdot / spmv_ddot,
+# ops/blas.cg_update): trace-time counts of which route the shell's
+# fused call sites actually took — a krylov_fusion=1 solve whose
+# operator silently falls off the kernels (non-DIA layout, blocks,
+# f64, VMEM overrun) pays 2-3x the n-vector HBM passes per iteration
+declare_counter("krylov.fused_dispatch",
+                "Krylov shell dispatches routed to the single-pass "
+                "Pallas kernels (SpMV+dot, CG update) at trace time")
+declare_counter("krylov.fused_declined",
+                "Krylov shell dispatches that fell back to the "
+                "unfused-expression XLA compose (non-DIA/block "
+                "operator, off-whitelist dtype, or VMEM gate) — same "
+                "results, more HBM passes per iteration")
+
 # GEO Galerkin CSR-structure device cache (amg/aggregation/galerkin.py):
 # a miss at 256^3 re-uploads ~1 GB of structure arrays per warm setup
 declare_counter("amg.geo_struct_cache.hit",

@@ -820,6 +820,164 @@ def bench_matfree(n: int = 128, reps: int = 3, smoke: bool = False):
     return out
 
 
+def _krylov_pass_census(slv, b):
+    """Per-iteration HBM-pass census of ONE traced solve_iteration
+    (trace-only, kernels routed through the interpreter gate so the
+    TPU dispatch decisions are visible on any rig): Pallas kernels by
+    name, standalone full-vector reductions outside kernel bodies, and
+    the count of full-n-vector operands/results touched by
+    compute-bearing leaf eqns (arithmetic/reduction XLA ops plus
+    kernel I/O; call wrappers and layout-only plumbing excluded — see
+    the walk below) — the n-vector HBM-pass proxy the shell fusion
+    cuts."""
+    import jax.core as jc
+    from amgx_tpu.ops import pallas_spmv as _ps
+    with _ps.force_pallas_interpret():
+        d = slv.solve_data()
+        st = {"x": jnp.zeros_like(b), "r": b}
+        st.update(slv.solve_init(d, b, jnp.zeros_like(b), b))
+        jaxpr = jax.make_jaxpr(
+            lambda dd, ss: slv.solve_iteration(dd, b, ss))(d, st)
+    nvec = b.size
+    kernels = {}
+    for nm in _re.findall(r'name="?([A-Za-z_0-9]+)"?', str(jaxpr)):
+        if nm.startswith(("_dia", "_cg")):
+            kernels[nm] = kernels.get(nm, 0) + 1
+
+    def subs(eqn):
+        for p in eqn.params.values():
+            for q in (p if isinstance(p, (tuple, list)) else (p,)):
+                if isinstance(q, jc.ClosedJaxpr):
+                    yield q.jaxpr
+                elif isinstance(q, jc.Jaxpr):
+                    yield q
+
+    counts = {"reductions": 0, "passes": 0}
+    # call-like wrappers re-bind their operands to an inner jaxpr whose
+    # leaf eqns are counted anyway — counting the wrapper boundary too
+    # would double-bill every vector that crosses a pjit/scan/custom
+    # wrapper (and the fused helpers carry more wrapper layers than the
+    # plain composition, so the double-billing is knob-asymmetric)
+    wrappers = ("pjit", "closed_call", "custom_jvp_call",
+                "custom_vjp_call", "custom_vmap_call", "scan", "while",
+                "cond", "remat", "checkpoint")
+    # pure layout plumbing is also excluded from the pass count: on
+    # XLA:TPU reshape/transpose/broadcast are metadata and the lane-pad
+    # dynamic_update_slice copies fuse into their producer, so none of
+    # them is an HBM round trip — and the kernel route necessarily
+    # carries more of this plumbing (every pallas operand is padded to
+    # lane multiples), which would bill the fused knob for free ops
+    layout = ("reshape", "transpose", "broadcast_in_dim", "slice",
+              "dynamic_slice", "dynamic_update_slice", "pad",
+              "squeeze", "concatenate", "convert_element_type",
+              "copy")
+
+    def walk(jx):
+        for eq in jx.eqns:
+            if eq.primitive.name not in wrappers \
+                    and eq.primitive.name not in layout:
+                counts["passes"] += sum(
+                    1 for v in list(eq.invars) + list(eq.outvars)
+                    if getattr(v, "aval", None) is not None
+                    and v.aval.size >= nvec)
+            if eq.primitive.name == "pallas_call":
+                continue
+            if eq.primitive.name in ("reduce_sum", "reduce_max",
+                                     "reduce_min", "dot_general") \
+                    and any(getattr(v, "aval", None) is not None
+                            and v.aval.size >= nvec
+                            for v in eq.invars):
+                counts["reductions"] += 1
+            for sub in subs(eq):
+                walk(sub)
+
+    walk(jaxpr.jaxpr)
+    return {"kernels": kernels,
+            "standalone_reductions": counts["reductions"],
+            "n_vector_passes": counts["passes"]}
+
+
+def bench_krylov(n: int = 128, reps: int = 3, smoke: bool = False,
+                 northstar: bool = True):
+    """Krylov-shell phase (`python bench.py krylov [--smoke]`): paired
+    replay of the SAME PCG + GEO-aggregation AMG solve with
+    `krylov_fusion=1` (the spmv+p.Ap and cg_update+r.r single-pass
+    shell kernels plus the cycle-borne r.z epilogue) against `=0` (the
+    unfused SpMV + BLAS-1 composition). Sentinel-tracked number:
+    `krylov_fused_speedup` (warm solve wall, unfused over fused —
+    higher is better). Both twins must converge in the SAME iteration
+    count — the shell fusion is a numerics-preserving form change, so
+    any drift fails the phase. The artifact also records the
+    per-iteration HBM pass census of one traced iteration per knob
+    (kernel inventory, standalone full-vector reductions, n-vector
+    operand touches). Full mode adds the northstar 256^3 shape on TPU
+    (the shape the ROADMAP's 512^3/1024^3 target sits behind); off-TPU
+    the kernels decline to the identical-expression XLA fallback, so
+    the rig records ~1.0x with the census still proving the TPU
+    dispatch."""
+    cfg_s = (
+        "solver=PCG, max_iters=80, monitor_residual=1,"
+        " tolerance=1e-8, convergence=RELATIVE_INI, norm=L2,"
+        " preconditioner(amg)=AMG, amg:algorithm=AGGREGATION,"
+        " amg:selector=GEO, amg:smoother=JACOBI_L1,"
+        " amg:relaxation_factor=0.75, amg:presweeps=1,"
+        " amg:postsweeps=2, amg:max_iters=1, amg:cycle=V,"
+        " amg:max_levels=10, amg:min_coarse_rows=32,"
+        " krylov_fusion=")
+    shapes = [n]
+    if northstar and not smoke and jax.default_backend() == "tpu":
+        shapes.append(256)
+    out = {"smoke": bool(smoke)}
+    for nn in shapes:
+        A = amgx.gallery.poisson("7pt", nn, nn, nn,
+                                 dtype=np.float32).init()
+        b = jnp.ones(A.num_rows, jnp.float32)
+        row = {}
+        iters = {}
+        walls = {}
+        for kf in ("0", "1"):
+            slv = amgx.create_solver(Config.from_string(cfg_s + kf))
+            slv.setup(A)
+            # census BEFORE the first solve: the aggregation level
+            # memoizes its fused transfer slabs on first level_data()
+            # use, keyed to whether the fused runtime was on at that
+            # moment. Tracing under the interpreter gate first memoizes
+            # the TPU-shaped structure (coarse tail eligible) — the
+            # same structure a real TPU solve would freeze. An off-TPU
+            # solve first would memoize slabs=None and the census would
+            # report the rig's fallback cycle instead of the dispatch.
+            census = _krylov_pass_census(slv, b)
+            res = slv.solve(b)              # compile + warm caches
+            iters[kf] = max(int(res.iterations), 1)
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(slv.solve(b).x)
+                best = min(best, time.perf_counter() - t0)
+            walls[kf] = best
+            row[f"fusion{kf}"] = {
+                "solve_warm_s": round(best, 4),
+                "iters": iters[kf],
+                "iter_warm_s": round(best / iters[kf], 6),
+                "census": census,
+            }
+            del slv
+        assert iters["0"] == iters["1"], (
+            f"krylov_fusion changed convergence at {nn}^3: {iters}")
+        row["speedup"] = round(walls["0"] / max(walls["1"], 1e-9), 3)
+        out[f"{nn}^3"] = row
+    head = out[f"{n}^3"]
+    out["grid"] = f"{n}^3 poisson7pt"
+    out["krylov_fused_speedup"] = head["speedup"]
+    out["krylov_fused_passes"] = \
+        head["fusion1"]["census"]["n_vector_passes"]
+    out["krylov_unfused_passes"] = \
+        head["fusion0"]["census"]["n_vector_passes"]
+    out["krylov_fused_standalone_reductions"] = \
+        head["fusion1"]["census"]["standalone_reductions"]
+    return out
+
+
 def bench_classical(n: int = 64):
     """PCG[f64] + classical PMIS/D2 AMG[f32] (JACOBI_L1) — the
     unstructured-path number the structured flagship does not cover.
@@ -2228,6 +2386,30 @@ def main():
     _checkpoint()
     gc.collect()
 
+    # Krylov-shell phase: paired krylov_fusion=1 vs 0 replay (PCG +
+    # GEO AMG) — the fused SpMV+dot / cg_update shell's warm-solve
+    # speedup plus the per-iteration HBM pass census
+    try:
+        old = signal.signal(signal.SIGALRM, _on_alarm)
+        signal.alarm(300)
+        try:
+            kr = bench_krylov()
+            extra["krylov_shell"] = kr
+            extra["krylov_fused_speedup"] = \
+                kr["krylov_fused_speedup"]
+            extra["krylov_fused_passes"] = kr["krylov_fused_passes"]
+            extra["krylov_unfused_passes"] = \
+                kr["krylov_unfused_passes"]
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)
+    except _Budget:  # pragma: no cover - timing dependent
+        extra["krylov_error"] = "wall-clock budget exceeded"
+    except Exception as e:  # pragma: no cover - bench robustness
+        extra["krylov_error"] = str(e)[:200]
+    _checkpoint()
+    gc.collect()
+
     # batched-serving phase: cheap (32^3, f64 CG+AggAMG), guarded like
     # the other optional phases so the JSON line always prints
     try:
@@ -2819,6 +3001,40 @@ if __name__ == "__main__":
             "unit": "x",
             "vs_baseline": 0.0,
             "artifact": "BENCH_matfree.json",
+            "extra": {k: v for k, v in res.items()
+                      if not isinstance(v, (dict, list))},
+        }), flush=True)
+    elif sys.argv[1:2] == ["krylov"]:
+        # standalone Krylov-shell phase: `python bench.py krylov`
+        # (full: 128^3 paired replay, + northstar 256^3 on TPU) or
+        # `--smoke` (16^3, the tier-1 functional check — must exit 0)
+        amgx.initialize()
+        smoke = "--smoke" in sys.argv[2:]
+        res = bench_krylov(n=16 if smoke else 128,
+                           reps=1 if smoke else 3, smoke=smoke)
+        res["round"] = _round_stamp()
+        res["extra"] = {
+            "krylov_fused_speedup": res["krylov_fused_speedup"],
+            "krylov_fused_passes": res["krylov_fused_passes"],
+            "krylov_unfused_passes": res["krylov_unfused_passes"],
+        }
+        try:
+            import os
+            art = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BENCH_krylov.json")
+            with open(art, "w") as f:
+                json.dump(res, f, indent=1)
+                f.write("\n")
+        except Exception as e:  # pragma: no cover - bench robustness
+            res["artifact_error"] = str(e)[:120]
+        print(json.dumps({
+            "metric": "fused vs unfused Krylov-shell warm solve "
+                      "speedup (paired replay, PCG+AMG)",
+            "value": res["krylov_fused_speedup"],
+            "unit": "x",
+            "vs_baseline": 0.0,
+            "artifact": "BENCH_krylov.json",
             "extra": {k: v for k, v in res.items()
                       if not isinstance(v, (dict, list))},
         }), flush=True)

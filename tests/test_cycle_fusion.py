@@ -13,8 +13,6 @@ jaxpr HBM-pass proof (<= 2 kernels per fused smoothed DIA level
 including its grid transfers, 1 kernel for the tail, zero standalone
 restrict/prolongate/correction ops outside the kernels); and the
 cycle_fusion=0 escape hatch reproducing the PR 4 composition."""
-import re
-
 import numpy as np
 import pytest
 import jax
@@ -26,6 +24,8 @@ from amgx_tpu.config import Config
 from amgx_tpu.ops import pallas_spmv as ps
 from amgx_tpu.ops import smooth as fused
 from amgx_tpu.ops.spmv import spmv
+
+import _census
 
 amgx.initialize()
 
@@ -226,38 +226,9 @@ def _trace_cycle(extra_cfg="", n=16):
     return pc.amg, jaxpr
 
 
-def _kernel_counts(jaxpr):
-    names = re.findall(r"name=\"?([A-Za-z_0-9]+)\"?", str(jaxpr))
-    out = {}
-    for nm in names:
-        for key in ("_dia_smooth_restrict_call", "_dia_prolong_smooth_call",
-                    "_dia_coarse_tail_call", "_dia_smooth_call",
-                    "_dia_spmv_call"):
-            if nm == key:
-                out[key] = out.get(key, 0) + 1
-    return out
-
-
-def _outer_prims(closed_jaxpr):
-    """All primitive names reachable from the cycle trace WITHOUT
-    descending into pallas_call bodies — what runs as standalone XLA
-    ops between the kernels."""
-    prims = []
-
-    def walk(jaxpr):
-        for eqn in jaxpr.eqns:
-            if eqn.primitive.name == "pallas_call":
-                continue
-            prims.append(eqn.primitive.name)
-            for p in eqn.params.values():
-                for q in (p if isinstance(p, (tuple, list)) else (p,)):
-                    if isinstance(q, jax.core.ClosedJaxpr):
-                        walk(q.jaxpr)
-                    elif isinstance(q, jax.core.Jaxpr):
-                        walk(q)
-
-    walk(closed_jaxpr.jaxpr)
-    return prims
+# jaxpr census helpers shared across the fusion suites (tests/_census.py)
+_kernel_counts = _census.kernel_counts
+_outer_prims = _census.outer_prims
 
 
 def test_jaxpr_proof_fused_cycle_kernel_budget():

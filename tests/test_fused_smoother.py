@@ -11,8 +11,6 @@ retrace when smooth_residual is enabled; and the HBM-pass regression
 tooling: jaxpr inspection of the traced cycle asserting the fused path
 removes the standalone residual SpMV at smoothed levels."""
 import dataclasses
-import re
-
 import numpy as np
 import pytest
 import jax
@@ -24,6 +22,8 @@ from amgx_tpu.config import Config
 from amgx_tpu.ops import pallas_spmv as ps
 from amgx_tpu.ops import smooth as fused
 from amgx_tpu.ops.spmv import spmv
+
+import _census
 
 amgx.initialize()
 
@@ -238,7 +238,7 @@ def _cycle_pallas_counts(extra_cfg=""):
         jaxpr = str(jax.make_jaxpr(
             lambda bb, xx: pc.amg.cycle(d["amg"], bb, xx))(
                 b, jnp.zeros_like(b)))
-    names = re.findall(r"name=\"?([A-Za-z_0-9]+)\"?", jaxpr)
+    names = _census.KERNEL_NAME_RE.findall(jaxpr)
     fused_calls = sum(1 for nm in names if "dia_smooth" in nm)
     plain = sum(1 for nm in names if "dia_spmv" in nm)
     return len(pc.amg.levels), fused_calls, plain

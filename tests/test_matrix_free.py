@@ -9,7 +9,6 @@ value-resetup coefficient refresh, GeoRapPlan.coarse_coeffs, and the
 serving-cache footprint of a matrix-free hierarchy.
 """
 import dataclasses
-import re
 
 import numpy as np
 import pytest
@@ -24,6 +23,8 @@ import amgx_tpu.ops.stencil as stencil
 from amgx_tpu.ops import smooth as fused
 from amgx_tpu.ops.spmv import spmv
 from amgx_tpu.solvers.relaxation import safe_recip, l1_strengthened_diag
+
+import _census
 
 amgx.initialize()
 
@@ -384,12 +385,9 @@ def _trace_cycle(extra="", n=12):
     return amg, jaxpr
 
 
+# shared census helper (tests/_census.py)
 def _slab_consts(jaxpr, k):
-    """Constants shaped like a k-diagonal DIA value slab (k, rows,
-    128) — the operand the matrix-free form must not carry."""
-    return [v.aval.shape for v in jaxpr.consts
-            if np.ndim(v) == 3 and np.shape(v)[0] == k
-            and np.shape(v)[-1] == ps.LANES]
+    return _census.slab_consts(jaxpr, k, lanes=ps.LANES)
 
 
 class TestJaxprCensus:
@@ -434,8 +432,7 @@ class TestJaxprCensus:
             assert res.converged
             xs[mf] = res.x
             kernels[mf] = set(
-                nm for nm in re.findall(r"name=\"?([A-Za-z_0-9]+)\"?",
-                                        str(jaxpr))
+                nm for nm in _census.kernel_names(jaxpr)
                 if nm.startswith("_dia_"))
         assert kernels["1"], kernels
         assert kernels["1"] == kernels["0"], kernels

@@ -169,6 +169,16 @@ SERIES: Tuple[Tuple[str, str, float, str], ...] = (
      "summed per-level operator solve-data bytes, matrix-free over "
      "slab build (bench.py matfree; lower = more of the hierarchy "
      "serves from O(k) stencil coefficients)"),
+    # ISSUE 20 Krylov-shell fusion: recorded from r07 on (the
+    # spmv+dot / cg_update shell kernels land after the autotuner
+    # round). Off-TPU rigs record ~1.0x (the kernels decline to the
+    # identical-expression XLA fallback), so the tolerance brackets
+    # rig noise around that floor until the TPU rounds take over
+    ("krylov_fused_speedup", "higher", 0.25,
+     "fused vs unfused Krylov-shell warm solve speedup (bench.py "
+     "krylov — paired krylov_fusion=1/0 replay of PCG + GEO AMG on "
+     "the flagship 128^3 shape; the spmv+p.Ap and cg_update+r.r "
+     "single-pass kernels plus the cycle-borne r.z epilogue)"),
     # ISSUE 19 online autotuner: recorded from r06 on (the
     # shadow-solve config search lands after the matrix-free round)
     ("autotune_speedup", "higher", 0.30,
@@ -269,7 +279,8 @@ def load_round(path: str, kind: str) -> Optional[Dict[str, Any]]:
 PHASE_ARTIFACTS: Tuple[str, ...] = ("BENCH_serving.json",
                                     "BENCH_fleet.json",
                                     "BENCH_matfree.json",
-                                    "BENCH_autotune.json")
+                                    "BENCH_autotune.json",
+                                    "BENCH_krylov.json")
 
 
 def load_phase_artifact(path: str) -> Optional[Dict[str, Any]]:
